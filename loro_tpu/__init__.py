@@ -1,0 +1,85 @@
+"""loro-tpu: a TPU-native CRDT framework with the capabilities of Loro.
+
+Collaborative JSON containers (Fugue rich text, List, MovableList,
+LWW-Map, MovableTree, Counter) with causal-DAG history, version vectors,
+time travel, snapshots and a columnar wire format.  The merge engine is
+reformulated as JAX/XLA kernels over columnar op arrays and vmapped
+across documents (loro_tpu.parallel.fleet) so a collaboration backend
+reconciles an entire document fleet in one XLA launch.
+"""
+
+from .core.ids import ContainerID, ContainerType, ID, IdSpan, TreeID
+from .core.version import Frontiers, VersionRange, VersionVector
+from .core.change import Change, Op, Side
+from .doc import (
+    DecodeError,
+    EncodeMode,
+    ExportMode,
+    ImportStatus,
+    LoroDoc,
+    LoroError,
+)
+from .event import (
+    ContainerDiff,
+    CounterDiff,
+    Delete,
+    Delta,
+    DocDiff,
+    EventTriggerKind,
+    Insert,
+    MapDiff,
+    Retain,
+    TreeDiff,
+    TreeDiffAction,
+    TreeDiffItem,
+)
+from .models.handlers import (
+    CounterHandler,
+    Handler,
+    ListHandler,
+    MapHandler,
+    MovableListHandler,
+    TextHandler,
+    TreeHandler,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "LoroDoc",
+    "LoroError",
+    "DecodeError",
+    "ExportMode",
+    "EncodeMode",
+    "ImportStatus",
+    "ContainerID",
+    "ContainerType",
+    "ID",
+    "IdSpan",
+    "TreeID",
+    "Frontiers",
+    "VersionVector",
+    "VersionRange",
+    "Change",
+    "Op",
+    "Side",
+    "Delta",
+    "Retain",
+    "Insert",
+    "Delete",
+    "MapDiff",
+    "TreeDiff",
+    "TreeDiffAction",
+    "TreeDiffItem",
+    "CounterDiff",
+    "DocDiff",
+    "ContainerDiff",
+    "EventTriggerKind",
+    "TextHandler",
+    "ListHandler",
+    "MapHandler",
+    "MovableListHandler",
+    "TreeHandler",
+    "CounterHandler",
+    "Handler",
+]

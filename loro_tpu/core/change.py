@@ -1,0 +1,203 @@
+"""Op and Change model.
+
+reference: crates/loro-internal/src/{op.rs,op/content.rs,change.rs}.
+
+Design departure from the reference (deliberate, TPU-first): sequence
+(Text/List/MovableList) inserts ship the Fugue tree placement
+`(parent_id, side)` computed at the source replica, instead of
+origin_left/origin_right pairs.  Integration then needs no sequential
+origin-scan: a batch of inserts is placed by sorting `(parent, side,
+peer, counter)` keys — which maps directly onto device sort + list-rank
+kernels (loro_tpu/ops/fugue_batch.py).  Semantics are the Fugue tree
+algorithm (Weidner & Kleppmann), matching the reference's Fugue text
+CRDT behavior (crates/loro-internal/src/container/richtext/tracker.rs).
+
+Each op consumes a contiguous counter range of the change:
+- SeqInsert of n items consumes n counters (one id per element, RLE run)
+- all other ops consume 1 counter.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from .ids import ID, ContainerID, Counter, IdSpan, Lamport, PeerID, TreeID
+from .version import Frontiers
+
+
+class Side(enum.IntEnum):
+    Left = 0
+    Right = 1
+
+
+@dataclass(frozen=True)
+class StyleAnchor:
+    """A rich-text style anchor element (Peritext-style, mirroring the
+    reference's StyleStart/StyleEnd rope anchors in
+    container/richtext/fugue_span.rs RichtextChunk::StyleAnchor)."""
+
+    key: str
+    value: Any
+    is_start: bool
+    # expand behavior: whether text inserted at the boundary inherits the
+    # style ("before"/"after"/"both"/"none" — reference: ExpandType)
+    info: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Op contents
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MapSet:
+    key: str
+    value: Any  # LoroValue; None+deleted=True encodes key deletion
+    deleted: bool = False
+
+
+@dataclass(frozen=True)
+class SeqInsert:
+    """Insert `len(content)` elements as a Fugue run.
+
+    parent=None means root (beginning of sequence); side is the Fugue
+    child side relative to parent.  Element j of the run has id
+    (peer, op_counter + j); for j>0 its implicit parent is element j-1,
+    side Right (runs are right-spines, identical to the reference's RLE
+    FugueSpan runs)."""
+
+    parent: Optional[ID]
+    side: Side
+    content: Union[str, Tuple[Any, ...], StyleAnchor]
+
+    def n_elems(self) -> int:
+        if isinstance(self.content, StyleAnchor):
+            return 1
+        return len(self.content)
+
+
+@dataclass(frozen=True)
+class SeqDelete:
+    """Tombstone the elements in `spans` (ids of elements, not positions)."""
+
+    spans: Tuple[IdSpan, ...]
+
+
+@dataclass(frozen=True)
+class TreeMove:
+    """Create/move/delete a tree node.  parent semantics:
+    None = root child; DELETED_TREE_PARENT sentinel = trash.
+    reference: diff_calc/tree.rs MoveLamportAndID."""
+
+    target: TreeID
+    parent: Optional[TreeID]
+    position: Optional[bytes]  # fractional index among siblings
+    is_create: bool = False
+    is_delete: bool = False
+
+
+@dataclass(frozen=True)
+class CounterIncr:
+    delta: float
+
+
+@dataclass(frozen=True)
+class MovableSet:
+    elem: ID  # element id (id of the insert op element)
+    value: Any
+
+
+@dataclass(frozen=True)
+class MovableMove:
+    """Move element `elem` to a new Fugue position (this op's id becomes
+    the new position element's id)."""
+
+    elem: ID
+    parent: Optional[ID]
+    side: Side
+
+
+@dataclass(frozen=True)
+class UnknownContent:
+    """Forward-compatibility payload (reference ContainerType::Unknown)."""
+
+    kind: int
+    data: bytes
+
+
+OpContent = Union[
+    MapSet, SeqInsert, SeqDelete, TreeMove, CounterIncr, MovableSet, MovableMove, UnknownContent
+]
+
+
+@dataclass(frozen=True)
+class Op:
+    """One operation inside a change.  `counter` is absolute (peer-wide)."""
+
+    counter: Counter
+    container: ContainerID
+    content: OpContent
+
+    def atom_len(self) -> int:
+        c = self.content
+        if isinstance(c, SeqInsert):
+            return c.n_elems()
+        return 1
+
+    @property
+    def ctr_end(self) -> Counter:
+        return self.counter + self.atom_len()
+
+
+@dataclass
+class Change:
+    """A batch of causally-contiguous ops by one peer.
+    reference: change.rs:28-39."""
+
+    id: ID  # (peer, first counter)
+    lamport: Lamport
+    deps: Frontiers
+    ops: List[Op]
+    timestamp: int = 0
+    message: Optional[str] = None
+
+    @property
+    def peer(self) -> PeerID:
+        return self.id.peer
+
+    @property
+    def ctr_start(self) -> Counter:
+        return self.id.counter
+
+    @property
+    def ctr_end(self) -> Counter:
+        return self.ops[-1].ctr_end if self.ops else self.id.counter
+
+    def atom_len(self) -> int:
+        return self.ctr_end - self.ctr_start
+
+    @property
+    def lamport_end(self) -> Lamport:
+        return self.lamport + self.atom_len()
+
+    def id_span(self) -> IdSpan:
+        return IdSpan(self.peer, self.ctr_start, self.ctr_end)
+
+    def last_id(self) -> ID:
+        return ID(self.peer, self.ctr_end - 1)
+
+    def can_merge_right(self, other: "Change", merge_interval_s: int) -> bool:
+        """Whether `other` can be RLE-merged onto self (same peer,
+        contiguous counters, dep-on-self, close timestamps).
+        reference: change merging in oplog/change_store."""
+        return (
+            other.peer == self.peer
+            and other.ctr_start == self.ctr_end
+            and other.lamport == self.lamport_end
+            and len(other.deps) == 1
+            and next(iter(other.deps)) == self.last_id()
+            and abs(other.timestamp - self.timestamp) <= merge_interval_s
+            and other.message is None
+            and self.message is None
+        )

@@ -1,0 +1,202 @@
+"""Primitive identifier types.
+
+TPU-native re-design of the reference's `loro-common` id types
+(reference: crates/loro-common/src/lib.rs — `ID`, `IdLp`, `IdFull`,
+`ContainerID`, `ContainerType`, `TreeID`).  Host-side these are light
+Python values; device-side ids are split into (peer_index, counter)
+i32 columns with a per-batch peer dictionary (see loro_tpu/ops/columnar.py).
+"""
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional, Tuple, Union
+
+PeerID = int  # u64 semantics; Python int holds it natively
+Counter = int  # i32 semantics
+Lamport = int  # u32 semantics
+
+# Sentinel used for "no id" in columnar encodings.
+NONE_PEER = 0xFFFF_FFFF_FFFF_FFFF
+
+
+class ID(NamedTuple):
+    """An op id: (peer, counter).  reference: loro-common/src/id.rs."""
+
+    peer: PeerID
+    counter: Counter
+
+    def inc(self, delta: int) -> "ID":
+        return ID(self.peer, self.counter + delta)
+
+    def __str__(self) -> str:  # e.g. "12@7" mirrors the reference's display
+        return f"{self.counter}@{self.peer}"
+
+    @staticmethod
+    def parse(s: str) -> "ID":
+        c, p = s.split("@")
+        return ID(int(p), int(c))
+
+
+class IdLp(NamedTuple):
+    """Lamport-keyed id used for LWW ordering (reference: lib.rs:525)."""
+
+    lamport: Lamport
+    peer: PeerID
+
+    def __str__(self) -> str:
+        return f"L{self.lamport}@{self.peer}"
+
+
+class IdFull(NamedTuple):
+    """Id with both counter and lamport (reference: lib.rs:573)."""
+
+    peer: PeerID
+    counter: Counter
+    lamport: Lamport
+
+    @property
+    def id(self) -> ID:
+        return ID(self.peer, self.counter)
+
+    @property
+    def idlp(self) -> IdLp:
+        return IdLp(self.lamport, self.peer)
+
+
+class IdSpan(NamedTuple):
+    """A contiguous counter span on one peer: [start, end).
+
+    reference: loro-common/src/span.rs.
+    """
+
+    peer: PeerID
+    start: Counter
+    end: Counter
+
+    def __len__(self) -> int:
+        return max(0, self.end - self.start)
+
+    def contains(self, id: ID) -> bool:
+        return id.peer == self.peer and self.start <= id.counter < self.end
+
+
+class ContainerType(enum.IntEnum):
+    """The seven container kinds (reference: loro-common/src/lib.rs:737)."""
+
+    Map = 0
+    List = 1
+    Text = 2
+    Tree = 3
+    MovableList = 4
+    Counter = 5
+    Unknown = 6
+
+    @staticmethod
+    def from_name(name: str) -> "ContainerType":
+        return _CT_BY_NAME[name]
+
+
+_CT_BY_NAME = {c.name: c for c in ContainerType}
+
+
+class ContainerID:
+    """Root("name", type) or Normal(peer, counter, type).
+
+    reference: loro-common/src/lib.rs:591.  Hashable + totally ordered so
+    it can key host dictionaries and sort deterministically into columnar
+    dictionaries for the device.
+    """
+
+    __slots__ = ("name", "peer", "counter", "ctype", "_hash")
+
+    def __init__(
+        self,
+        ctype: ContainerType,
+        name: Optional[str] = None,
+        peer: Optional[PeerID] = None,
+        counter: Optional[Counter] = None,
+    ):
+        self.ctype = ContainerType(ctype)
+        self.name = name
+        self.peer = peer
+        self.counter = counter
+        if (name is None) == (peer is None):
+            raise ValueError("ContainerID is either Root(name) or Normal(peer,counter)")
+        self._hash = hash((self.ctype, name, peer, counter))
+
+    # -- constructors -------------------------------------------------
+    @staticmethod
+    def root(name: str, ctype: ContainerType) -> "ContainerID":
+        return ContainerID(ctype, name=name)
+
+    @staticmethod
+    def normal(peer: PeerID, counter: Counter, ctype: ContainerType) -> "ContainerID":
+        return ContainerID(ctype, peer=peer, counter=counter)
+
+    # -- predicates ---------------------------------------------------
+    @property
+    def is_root(self) -> bool:
+        return self.name is not None
+
+    # -- protocol -----------------------------------------------------
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, ContainerID)
+            and self.ctype == other.ctype
+            and self.name == other.name
+            and self.peer == other.peer
+            and self.counter == other.counter
+        )
+
+    def _key(self) -> Tuple:
+        # roots sort before normals; deterministic across processes
+        if self.is_root:
+            return (0, self.name, int(self.ctype))
+        return (1, self.peer, self.counter, int(self.ctype))
+
+    def __lt__(self, other: "ContainerID") -> bool:
+        return self._key() < other._key()
+
+    def __repr__(self) -> str:
+        if self.is_root:
+            return f"cid:root-{self.name}:{self.ctype.name}"
+        return f"cid:{self.counter}@{self.peer}:{self.ctype.name}"
+
+    __str__ = __repr__
+
+    @staticmethod
+    def parse(s: str) -> "ContainerID":
+        """Parse the `cid:` string form (mirrors reference's TryFrom<&str>)."""
+        if not s.startswith("cid:"):
+            raise ValueError(f"not a container id: {s!r}")
+        body, _, tname = s[4:].rpartition(":")
+        ctype = ContainerType.from_name(tname)
+        if body.startswith("root-"):
+            return ContainerID.root(body[5:], ctype)
+        c, _, p = body.partition("@")
+        return ContainerID.normal(int(p), int(c), ctype)
+
+
+class TreeID(NamedTuple):
+    """Node id in a movable tree (reference: loro-common/src/lib.rs:1172)."""
+
+    peer: PeerID
+    counter: Counter
+
+    @property
+    def id(self) -> ID:
+        return ID(self.peer, self.counter)
+
+    def __str__(self) -> str:
+        return f"{self.counter}@{self.peer}"
+
+    @staticmethod
+    def parse(s: str) -> "TreeID":
+        c, p = s.split("@")
+        return TreeID(int(p), int(c))
+
+
+IdOrRoot = Union[ID, None]

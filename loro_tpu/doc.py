@@ -1,0 +1,491 @@
+"""LoroDoc: the document runtime.
+
+reference: crates/loro-internal/src/loro.rs (import/export dispatch,
+checkout, fork) + crates/loro/src/lib.rs (public API).  A doc owns an
+OpLog (history), a DocState (materialized state), an Observer, and the
+single active transaction slot (reference lib.rs:142-172).
+"""
+from __future__ import annotations
+
+import random
+import zlib
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from .codec import json_schema as jcodec
+from .config import Configure
+from .core.change import Change
+from .core.ids import ContainerID, ContainerType, ID, PeerID
+from .core.version import Frontiers, VersionRange, VersionVector
+from .event import (
+    ContainerDiff,
+    Delta,
+    DocDiff,
+    EventTriggerKind,
+    MapDiff,
+    Observer,
+    TreeDiff,
+)
+from .models.handlers import (
+    CounterHandler,
+    Handler,
+    ListHandler,
+    MapHandler,
+    MovableListHandler,
+    TextHandler,
+    TreeHandler,
+    make_handler,
+)
+from .oplog.oplog import OpLog
+from .state import DocState, compose_many
+from .txn import Transaction
+
+MAGIC = b"LTPU"
+FORMAT_VERSION = 1
+
+
+class EncodeMode(Enum):
+    JsonUpdates = 1
+    JsonSnapshot = 2
+    ColumnarUpdates = 3
+    ColumnarSnapshot = 4
+    ShallowSnapshot = 5
+
+
+class ExportMode:
+    """reference: encoding.rs ExportMode."""
+
+    class Snapshot:
+        pass
+
+    @dataclass
+    class Updates:
+        from_vv: Optional[VersionVector] = None
+
+    @dataclass
+    class UpdatesInRange:
+        from_vv: VersionVector
+        to_vv: VersionVector
+
+    @dataclass
+    class ShallowSnapshot:
+        frontiers: Frontiers
+
+    @dataclass
+    class SnapshotAt:
+        frontiers: Frontiers
+
+    class StateOnly:
+        pass
+
+
+@dataclass
+class ImportStatus:
+    """reference: encoding.rs:227 ImportStatus."""
+
+    success: VersionRange
+    pending: Optional[VersionRange]
+
+
+class LoroError(Exception):
+    pass
+
+
+class DecodeError(LoroError):
+    pass
+
+
+class LoroDoc:
+    def __init__(self, peer: Optional[PeerID] = None):
+        self.peer: PeerID = peer if peer is not None else random.getrandbits(63)
+        self.oplog = OpLog()
+        self.state = DocState()
+        self.observer = Observer()
+        self.config = Configure()
+        self._txn: Optional[Transaction] = None
+        self._detached = False
+        self._local_update_subs: List[Callable[[bytes], None]] = []
+        self._peer_id_change_subs: List[Callable[[PeerID], None]] = []
+        self._pre_commit_subs: List[Callable[["Transaction"], None]] = []
+        self._first_commit_from_peer_subs: List[Callable[[PeerID], None]] = []
+        self._seen_peers: set = set()
+
+    # ------------------------------------------------------------------
+    # identity & mode
+    # ------------------------------------------------------------------
+    def set_peer_id(self, peer: PeerID) -> None:
+        if self._txn is not None and not self._txn.is_empty():
+            raise LoroError("cannot change peer id with uncommitted ops")
+        self.peer = peer
+        for cb in self._peer_id_change_subs:
+            cb(peer)
+
+    def is_detached(self) -> bool:
+        return self._detached
+
+    def detach(self) -> None:
+        self.commit()
+        self._detached = True
+
+    def attach(self) -> None:
+        self.checkout_to_latest()
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+    def get_text(self, name: str) -> TextHandler:
+        return TextHandler(self, ContainerID.root(name, ContainerType.Text))
+
+    def get_list(self, name: str) -> ListHandler:
+        return ListHandler(self, ContainerID.root(name, ContainerType.List))
+
+    def get_map(self, name: str) -> MapHandler:
+        return MapHandler(self, ContainerID.root(name, ContainerType.Map))
+
+    def get_movable_list(self, name: str) -> MovableListHandler:
+        return MovableListHandler(self, ContainerID.root(name, ContainerType.MovableList))
+
+    def get_tree(self, name: str) -> TreeHandler:
+        return TreeHandler(self, ContainerID.root(name, ContainerType.Tree))
+
+    def get_counter(self, name: str) -> CounterHandler:
+        return CounterHandler(self, ContainerID.root(name, ContainerType.Counter))
+
+    def get_container(self, cid: Union[ContainerID, str]) -> Handler:
+        if isinstance(cid, str):
+            cid = ContainerID.parse(cid)
+        return make_handler(self, cid)
+
+    # ------------------------------------------------------------------
+    # transactions
+    # ------------------------------------------------------------------
+    def _txn_apply(self, cid: ContainerID, content) -> int:
+        if self._detached and not self.config.editable_detached_mode:
+            raise LoroError("doc is detached; checkout_to_latest() or enable editable_detached_mode")
+        if self._txn is None:
+            self._txn = Transaction(self)
+        return self._txn.apply(cid, content)
+
+    def commit(self, origin: str = "", message: Optional[str] = None) -> None:
+        """Commit the implicit transaction (reference: txn.rs:426)."""
+        txn = self._txn
+        if txn is None or txn.is_empty():
+            self._txn = None
+            return
+        if message is not None:
+            txn.message = message
+        for cb in self._pre_commit_subs:
+            cb(txn)
+        change = txn.build_change()
+        assert change is not None
+        self._txn = None
+        self.oplog.import_local_change(change)
+        self.state.vv.extend_to_include(change.id_span())
+        self.state.frontiers = self.oplog.frontiers
+        if change.peer not in self._seen_peers:
+            self._seen_peers.add(change.peer)
+            for cb in self._first_commit_from_peer_subs:
+                cb(change.peer)
+        # events
+        if self.observer.has_subscribers() and txn.diffs:
+            self._emit(txn.diffs, origin or txn.origin, EventTriggerKind.Local, txn.start_frontiers)
+        # local update push (reference: txn.rs:78-90 subscribe_local_update)
+        if self._local_update_subs:
+            payload = self._encode_changes([change], EncodeMode.JsonUpdates)
+            for cb in self._local_update_subs:
+                cb(payload)
+
+    # ------------------------------------------------------------------
+    # events
+    # ------------------------------------------------------------------
+    def _emit(
+        self,
+        diffs: Dict[ContainerID, List],
+        origin: str,
+        by: EventTriggerKind,
+        from_frontiers: Frontiers,
+    ) -> None:
+        cds: List[ContainerDiff] = []
+        for cid, lst in diffs.items():
+            if not lst:
+                continue
+            d = compose_many(lst)
+            if hasattr(d, "is_empty") and d.is_empty():
+                continue
+            cds.append(ContainerDiff(cid, self.state.path_of(cid), d))
+        if not cds:
+            return
+        cds.sort(key=lambda cd: (self.state.depth_of(cd.id), cd.path))
+        self.observer.emit(DocDiff(origin, by, from_frontiers, self.state.frontiers, cds))
+
+    def subscribe(self, cid: ContainerID, cb) -> Callable[[], None]:
+        return self.observer.subscribe(cid, cb)
+
+    def subscribe_root(self, cb) -> Callable[[], None]:
+        return self.observer.subscribe_root(cb)
+
+    def subscribe_local_update(self, cb: Callable[[bytes], None]) -> Callable[[], None]:
+        self._local_update_subs.append(cb)
+        return lambda: self._local_update_subs.remove(cb)
+
+    def subscribe_peer_id_change(self, cb: Callable[[PeerID], None]) -> Callable[[], None]:
+        self._peer_id_change_subs.append(cb)
+        return lambda: self._peer_id_change_subs.remove(cb)
+
+    def subscribe_pre_commit(self, cb) -> Callable[[], None]:
+        self._pre_commit_subs.append(cb)
+        return lambda: self._pre_commit_subs.remove(cb)
+
+    def subscribe_first_commit_from_peer(self, cb) -> Callable[[], None]:
+        self._first_commit_from_peer_subs.append(cb)
+        return lambda: self._first_commit_from_peer_subs.remove(cb)
+
+    # ------------------------------------------------------------------
+    # import / export
+    # ------------------------------------------------------------------
+    def export(self, mode=None) -> bytes:
+        """Export per ExportMode (reference: loro.rs:2096 dispatch)."""
+        self.commit()
+        if mode is None or isinstance(mode, ExportMode.Snapshot) or mode is ExportMode.Snapshot:
+            return self._encode_changes(
+                self.oplog.changes_in_causal_order(), EncodeMode.JsonSnapshot
+            )
+        if isinstance(mode, ExportMode.Updates):
+            vv = mode.from_vv or VersionVector()
+            return self._encode_changes(self.oplog.changes_since(vv), EncodeMode.JsonUpdates, vv)
+        if isinstance(mode, ExportMode.UpdatesInRange):
+            chs = self.oplog.changes_between(mode.from_vv, mode.to_vv)
+            return self._encode_changes(chs, EncodeMode.JsonUpdates, mode.from_vv)
+        if isinstance(mode, ExportMode.SnapshotAt):
+            to_vv = self.oplog.dag.frontiers_to_vv(mode.frontiers)
+            chs = self.oplog.changes_between(VersionVector(), to_vv)
+            return self._encode_changes(chs, EncodeMode.JsonSnapshot)
+        raise LoroError(f"unsupported export mode {mode}")
+
+    def export_snapshot(self) -> bytes:
+        return self.export(ExportMode.Snapshot)
+
+    def export_updates(self, from_vv: Optional[VersionVector] = None) -> bytes:
+        return self.export(ExportMode.Updates(from_vv))
+
+    def _encode_changes(
+        self, changes: List[Change], mode: EncodeMode, start_vv: Optional[VersionVector] = None
+    ) -> bytes:
+        payload = jcodec.dumps(
+            jcodec.export_json_updates(changes, start_vv or VersionVector(), self.oplog.vv.copy())
+        )
+        crc = zlib.crc32(payload)
+        header = MAGIC + bytes([FORMAT_VERSION, mode.value]) + crc.to_bytes(4, "little")
+        return header + payload
+
+    def import_(self, data: bytes, origin: str = "import") -> ImportStatus:
+        """reference: loro.rs:568 LoroDoc::import."""
+        self.commit()
+        changes = self._decode(data)
+        return self._import_changes(changes, origin)
+
+    import_bytes = import_
+
+    def _decode(self, data: bytes) -> List[Change]:
+        if len(data) < 10 or data[:4] != MAGIC:
+            raise DecodeError("bad magic")
+        version, mode_b = data[4], data[5]
+        if version > FORMAT_VERSION:
+            raise DecodeError(f"unsupported format version {version}")
+        crc = int.from_bytes(data[6:10], "little")
+        payload = data[10:]
+        if zlib.crc32(payload) != crc:
+            raise DecodeError("checksum mismatch")
+        try:
+            mode = EncodeMode(mode_b)
+        except ValueError as e:
+            raise DecodeError(f"unknown encode mode {mode_b}") from e
+        if mode in (EncodeMode.JsonUpdates, EncodeMode.JsonSnapshot):
+            try:
+                return jcodec.import_json_updates(jcodec.loads(payload))
+            except (KeyError, ValueError, TypeError) as e:
+                raise DecodeError(f"malformed payload: {e}") from e
+        if mode in (EncodeMode.ColumnarUpdates, EncodeMode.ColumnarSnapshot):
+            from .codec import binary as bcodec
+
+            try:
+                return bcodec.decode_changes(payload)
+            except Exception as e:
+                raise DecodeError(f"malformed columnar payload: {e}") from e
+        raise DecodeError(f"unsupported mode {mode}")
+
+    def _import_changes(self, changes: List[Change], origin: str) -> ImportStatus:
+        applied, pending = self.oplog.import_changes(changes)
+        success = VersionRange()
+        for ch in applied:
+            success.extend_to_include(ch.id_span())
+        if applied and not self._detached:
+            record = self.observer.has_subscribers()
+            from_f = self.state.frontiers
+            diffs = self.state.apply_changes(applied, record=record)
+            self.state.frontiers = self.oplog.frontiers
+            if record and diffs:
+                self._emit(diffs, origin, EventTriggerKind.Import, from_f)
+            else:
+                self.state.frontiers = self.oplog.frontiers
+        return ImportStatus(success, pending if not pending.is_empty() else None)
+
+    def import_json_updates(self, json_obj) -> ImportStatus:
+        """reference: loro.rs:873 import_json_updates."""
+        if isinstance(json_obj, (str, bytes)):
+            import json as _json
+
+            json_obj = _json.loads(json_obj)
+        return self._import_changes(jcodec.import_json_updates(json_obj), "import")
+
+    def export_json_updates(
+        self, start_vv: Optional[VersionVector] = None, end_vv: Optional[VersionVector] = None
+    ):
+        self.commit()
+        start_vv = start_vv or VersionVector()
+        end_vv = end_vv or self.oplog.vv.copy()
+        chs = self.oplog.changes_between(start_vv, end_vv)
+        return jcodec.export_json_updates(chs, start_vv, end_vv)
+
+    # ------------------------------------------------------------------
+    # versions
+    # ------------------------------------------------------------------
+    def oplog_vv(self) -> VersionVector:
+        return self.oplog.vv.copy()
+
+    def oplog_frontiers(self) -> Frontiers:
+        return self.oplog.frontiers
+
+    def state_vv(self) -> VersionVector:
+        return self.state.vv.copy()
+
+    def state_frontiers(self) -> Frontiers:
+        return self.state.frontiers
+
+    def vv_to_frontiers(self, vv: VersionVector) -> Frontiers:
+        return self.oplog.dag.vv_to_frontiers(vv)
+
+    def frontiers_to_vv(self, f: Frontiers) -> VersionVector:
+        return self.oplog.dag.frontiers_to_vv(f)
+
+    # ------------------------------------------------------------------
+    # time travel
+    # ------------------------------------------------------------------
+    def checkout_to_latest(self) -> None:
+        self.checkout(self.oplog.frontiers)
+        self._detached = False
+
+    def checkout(self, frontiers: Frontiers) -> None:
+        """reference: loro.rs:1625.  Sets detached mode unless the target
+        is the latest version."""
+        self.commit()
+        target_vv = self.oplog.dag.frontiers_to_vv(frontiers)
+        cur_vv = self.state.vv
+        record = self.observer.has_subscribers()
+        old_values = self._container_values() if record else None
+        from_f = self.state.frontiers
+        if cur_vv <= target_vv:
+            chs = self.oplog.changes_between(cur_vv, target_vv)
+            self.state.apply_changes(chs, record=False)
+        else:
+            # retreat: rebuild state from scratch up to target_vv
+            new_state = DocState()
+            chs = self.oplog.changes_between(VersionVector(), target_vv)
+            new_state.apply_changes(chs, record=False)
+            self.state = new_state
+        self.state.vv = target_vv.copy()
+        self.state.frontiers = frontiers
+        # checkout always detaches (reference loro.rs:1625); only
+        # checkout_to_latest re-attaches
+        self._detached = True
+        if record:
+            diffs = self._value_level_diffs(old_values)
+            if diffs:
+                self._emit(diffs, "checkout", EventTriggerKind.Checkout, from_f)
+
+    def _container_values(self) -> Dict[ContainerID, Any]:
+        return {cid: st.get_value() for cid, st in self.state.states.items()}
+
+    def _value_level_diffs(self, old_values: Dict[ContainerID, Any]) -> Dict[ContainerID, List]:
+        """Value-level diffs for checkout events (exact for map/counter,
+        positional for sequences via difflib).  TODO(round2): replay-based
+        exact deltas like the reference's persistent DiffCalculator."""
+        import difflib
+
+        out: Dict[ContainerID, List] = {}
+        all_cids = set(old_values) | set(self.state.states)
+        for cid in all_cids:
+            old_v = old_values.get(cid)
+            st = self.state.states.get(cid)
+            new_v = st.get_value() if st else None
+            if old_v == new_v:
+                continue
+            if cid.ctype == ContainerType.Map:
+                d = MapDiff()
+                old_m = old_v or {}
+                new_m = new_v or {}
+                for k in new_m:
+                    if old_m.get(k) != new_m[k] or k not in old_m:
+                        d.updated[k] = new_m[k]
+                for k in old_m:
+                    if k not in new_m:
+                        d.deleted.add(k)
+                out[cid] = [d]
+            elif cid.ctype == ContainerType.Counter:
+                from .event import CounterDiff
+
+                out[cid] = [CounterDiff((new_v or 0.0) - (old_v or 0.0))]
+            elif cid.ctype == ContainerType.Text:
+                old_s, new_s = old_v or "", new_v or ""
+                delta = Delta()
+                sm = difflib.SequenceMatcher(a=old_s, b=new_s, autojunk=False)
+                for tag, i1, i2, j1, j2 in sm.get_opcodes():
+                    if tag == "equal":
+                        delta.retain(i2 - i1)
+                    else:
+                        if tag in ("replace", "delete"):
+                            delta.delete(i2 - i1)
+                        if tag in ("replace", "insert"):
+                            delta.insert(new_s[j1:j2])
+                out[cid] = [delta.chop()]
+            elif cid.ctype in (ContainerType.List, ContainerType.MovableList):
+                delta = Delta()
+                old_l, new_l = old_v or [], new_v or []
+                delta.delete(len(old_l))
+                delta.insert(tuple(new_l))
+                out[cid] = [delta.chop()]
+            elif cid.ctype == ContainerType.Tree:
+                if st is not None:
+                    td = st.to_diff()
+                    out[cid] = [td]
+        return out
+
+    # ------------------------------------------------------------------
+    # fork
+    # ------------------------------------------------------------------
+    def fork(self) -> "LoroDoc":
+        """Deep copy at current version (reference: fork.rs)."""
+        new = LoroDoc()
+        new.import_(self.export(ExportMode.Snapshot), origin="fork")
+        return new
+
+    def fork_at(self, frontiers: Frontiers) -> "LoroDoc":
+        new = LoroDoc()
+        new.import_(self.export(ExportMode.SnapshotAt(frontiers)), origin="fork")
+        return new
+
+    # ------------------------------------------------------------------
+    # values
+    # ------------------------------------------------------------------
+    def get_value(self) -> Dict[str, Any]:
+        return self.state.get_value()
+
+    def get_deep_value(self) -> Dict[str, Any]:
+        return self.state.get_deep_value()
+
+    def diagnose_size(self) -> Dict[str, int]:
+        return self.oplog.diagnose_size()
+
+    def __len__(self) -> int:
+        return len(self.state.states)

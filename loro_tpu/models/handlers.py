@@ -1,0 +1,429 @@
+"""Typed container handlers — the user-facing mutation API.
+
+reference: crates/loro-internal/src/handler.rs (TextHandler, MapHandler,
+ListHandler, MovableListHandler, TreeHandler + loro/src counter.rs) and
+handler/text_update.rs (diff-based `update`).
+"""
+from __future__ import annotations
+
+import difflib
+from typing import Any, Dict, List, Optional, Sequence, TYPE_CHECKING
+
+from ..core.change import (
+    CounterIncr,
+    MapSet,
+    MovableMove,
+    MovableSet,
+    SeqDelete,
+    SeqInsert,
+    Side,
+    StyleAnchor,
+    TreeMove,
+)
+from ..core.ids import ContainerID, ContainerType, ID, TreeID
+from ..utils.fractional_index import key_between
+from ..core.value import validate_value
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..doc import LoroDoc
+
+
+class Handler:
+    CT: ContainerType
+
+    def __init__(self, doc: "LoroDoc", cid: ContainerID):
+        assert cid.ctype == self.CT, f"{cid} is not a {self.CT.name}"
+        self.doc = doc
+        self.cid = cid
+
+    @property
+    def id(self) -> ContainerID:
+        return self.cid
+
+    @property
+    def _state(self):
+        return self.doc.state.get_or_create(self.cid)
+
+    def _apply(self, content) -> int:
+        return self.doc._txn_apply(self.cid, content)
+
+    def get_value(self):
+        return self._state.get_value()
+
+    def get_deep_value(self):
+        return self.doc.state._deep(self._state)
+
+    def is_attached(self) -> bool:
+        return True
+
+    def _child_handler(self, cid: ContainerID) -> "Handler":
+        return make_handler(self.doc, cid)
+
+    def subscribe(self, cb):
+        return self.doc.subscribe(self.cid, cb)
+
+
+class TextHandler(Handler):
+    CT = ContainerType.Text
+
+    # -- reads --------------------------------------------------------
+    def to_string(self) -> str:
+        return self._state.get_value()
+
+    def get_richtext_value(self) -> List[dict]:
+        return self._state.get_richtext_value()
+
+    def __len__(self) -> int:
+        return len(self._state)
+
+    @property
+    def length(self) -> int:
+        return len(self._state)
+
+    def char_at(self, pos: int) -> str:
+        e = self._state.seq.elem_at(pos)
+        if e is None:
+            raise IndexError(pos)
+        return e.content
+
+    def slice(self, start: int, end: int) -> str:
+        return self.to_string()[start:end]
+
+    # -- writes -------------------------------------------------------
+    def insert(self, pos: int, s: str) -> None:
+        if not s:
+            return
+        if pos > len(self._state):
+            raise IndexError(f"insert pos {pos} > len {len(self._state)}")
+        parent, side = self._state.seq.placement_for_visible_pos(pos)
+        self._apply(SeqInsert(parent, side, s))
+
+    def delete(self, pos: int, length: int) -> None:
+        if length <= 0:
+            return
+        if pos + length > len(self._state):
+            raise IndexError(f"delete [{pos},{pos+length}) > len {len(self._state)}")
+        spans = self._state.seq.id_range_of_visible(pos, length)
+        self._apply(SeqDelete(tuple(spans)))
+
+    def push(self, s: str) -> None:
+        self.insert(len(self._state), s)
+
+    def mark(self, start: int, end: int, key: str, value: Any) -> None:
+        """Style [start, end) with key=value.  Emits a start anchor at
+        `start` and an end anchor after `end-1` as two consecutive ops
+        (ids (p,c) and (p,c+1) — the pairing invariant TextState relies
+        on)."""
+        if end <= start:
+            return
+        st = self._state
+        if end > len(st):
+            raise IndexError(f"mark [{start},{end}) > len {len(st)}")
+        parent, side = st.seq.placement_for_visible_pos(start)
+        c1 = self._apply(SeqInsert(parent, side, StyleAnchor(key, value, True)))
+        last_char = st.seq.elem_at(end - 1)
+        assert last_char is not None
+        parent2, side2 = st.seq.placement_after_elem(last_char.id)
+        self._apply(SeqInsert(parent2, side2, StyleAnchor(key, value, False)))
+
+    def unmark(self, start: int, end: int, key: str) -> None:
+        self.mark(start, end, key, None)
+
+    def update(self, new_text: str) -> None:
+        """Minimal-diff update (reference: handler/text_update.rs Myers)."""
+        old = self.to_string()
+        if old == new_text:
+            return
+        sm = difflib.SequenceMatcher(a=old, b=new_text, autojunk=False)
+        # apply right-to-left so positions stay valid
+        ops = [op for op in sm.get_opcodes() if op[0] != "equal"]
+        for tag, i1, i2, j1, j2 in reversed(ops):
+            if tag in ("replace", "delete"):
+                self.delete(i1, i2 - i1)
+            if tag in ("replace", "insert"):
+                self.insert(i1, new_text[j1:j2])
+
+
+class ListHandler(Handler):
+    CT = ContainerType.List
+
+    def __len__(self) -> int:
+        return len(self._state)
+
+    @property
+    def length(self) -> int:
+        return len(self._state)
+
+    def get(self, index: int):
+        v = self._state.get(index)
+        if isinstance(v, ContainerID):
+            return self._child_handler(v)
+        return v
+
+    def insert(self, pos: int, *values: Any) -> None:
+        if not values:
+            return
+        if pos > len(self._state):
+            raise IndexError(f"insert pos {pos} > len {len(self._state)}")
+        for v in values:
+            validate_value(v)
+        parent, side = self._state.seq.placement_for_visible_pos(pos)
+        self._apply(SeqInsert(parent, side, tuple(values)))
+
+    def push(self, *values: Any) -> None:
+        self.insert(len(self._state), *values)
+
+    def delete(self, pos: int, length: int) -> None:
+        if length <= 0:
+            return
+        if pos + length > len(self._state):
+            raise IndexError(f"delete [{pos},{pos+length}) > len {len(self._state)}")
+        spans = self._state.seq.id_range_of_visible(pos, length)
+        self._apply(SeqDelete(tuple(spans)))
+
+    def insert_container(self, pos: int, ctype: ContainerType) -> Handler:
+        parent, side = self._state.seq.placement_for_visible_pos(pos)
+        # op counter == element id == child container id
+        marker = _ChildMarker(ctype)
+        counter = self._apply(SeqInsert(parent, side, (marker,)))
+        cid = marker.cid
+        assert cid is not None
+        return self._child_handler(cid)
+
+    def push_container(self, ctype: ContainerType) -> Handler:
+        return self.insert_container(len(self._state), ctype)
+
+
+class _ChildMarker:
+    """Placeholder replaced by the real child ContainerID at txn apply
+    time (the id needs the op counter, which only the txn knows)."""
+
+    __slots__ = ("ctype", "cid")
+
+    def __init__(self, ctype: ContainerType):
+        self.ctype = ctype
+        self.cid: Optional[ContainerID] = None
+
+
+class MapHandler(Handler):
+    CT = ContainerType.Map
+
+    def get(self, key: str):
+        entry = self._state.get_entry(key)
+        if entry is None:
+            return None
+        if isinstance(entry.value, ContainerID):
+            return self._child_handler(entry.value)
+        return entry.value
+
+    def set(self, key: str, value: Any) -> None:
+        validate_value(value)
+        self._apply(MapSet(key, value))
+
+    def delete(self, key: str) -> None:
+        self._apply(MapSet(key, None, deleted=True))
+
+    def keys(self) -> List[str]:
+        return sorted(self._state.get_value().keys())
+
+    def values(self) -> List[Any]:
+        v = self._state.get_value()
+        return [v[k] for k in sorted(v)]
+
+    def __len__(self) -> int:
+        return len(self._state.get_value())
+
+    def __contains__(self, key: str) -> bool:
+        return self._state.get_entry(key) is not None
+
+    def set_container(self, key: str, ctype: ContainerType) -> Handler:
+        marker = _ChildMarker(ctype)
+        self._apply(MapSet(key, marker))
+        assert marker.cid is not None
+        return self._child_handler(marker.cid)
+
+
+class MovableListHandler(Handler):
+    CT = ContainerType.MovableList
+
+    def __len__(self) -> int:
+        return len(self._state)
+
+    @property
+    def length(self) -> int:
+        return len(self._state)
+
+    def get(self, index: int):
+        v = self._state.get(index)
+        if isinstance(v, ContainerID):
+            return self._child_handler(v)
+        return v
+
+    def insert(self, pos: int, *values: Any) -> None:
+        if not values:
+            return
+        if pos > len(self._state):
+            raise IndexError(f"insert pos {pos} > len {len(self._state)}")
+        for v in values:
+            validate_value(v)
+        parent, side = self._state.seq.placement_for_visible_pos(pos)
+        self._apply(SeqInsert(parent, side, tuple(values)))
+
+    def push(self, *values: Any) -> None:
+        self.insert(len(self._state), *values)
+
+    def delete(self, pos: int, length: int) -> None:
+        if length <= 0:
+            return
+        if pos + length > len(self._state):
+            raise IndexError(f"delete [{pos},{pos+length}) > len {len(self._state)}")
+        st = self._state
+        spans = []
+        for i in range(pos, pos + length):
+            sid = st.slot_id_at(i)
+            assert sid is not None
+            spans.append(sid)
+        from ..core.ids import IdSpan
+
+        rle = []
+        for sid in spans:
+            if rle and rle[-1].peer == sid.peer and rle[-1].end == sid.counter:
+                rle[-1] = IdSpan(sid.peer, rle[-1].start, sid.counter + 1)
+            else:
+                rle.append(IdSpan(sid.peer, sid.counter, sid.counter + 1))
+        self._apply(SeqDelete(tuple(rle)))
+
+    def set(self, pos: int, value: Any) -> None:
+        validate_value(value)
+        eid = self._state.elem_id_at(pos)
+        if eid is None:
+            raise IndexError(pos)
+        self._apply(MovableSet(eid, value))
+
+    def move(self, from_pos: int, to_pos: int) -> None:
+        """Move the element at from_pos so it ends up at to_pos
+        (reference: MovableListHandler::mov)."""
+        if from_pos == to_pos:
+            return
+        st = self._state
+        eid = st.elem_id_at(from_pos)
+        if eid is None:
+            raise IndexError(from_pos)
+        # placement computed against the list *without* the moved element:
+        # target index in the post-move list maps to a boundary in the
+        # current list skipping the source slot
+        anchor = to_pos if to_pos < from_pos else to_pos + 1
+        parent, side = st.seq.placement_for_visible_pos(anchor)
+        self._apply(MovableMove(eid, parent, side))
+
+    def set_container(self, pos: int, ctype: ContainerType) -> Handler:
+        eid = self._state.elem_id_at(pos)
+        if eid is None:
+            raise IndexError(pos)
+        marker = _ChildMarker(ctype)
+        self._apply(MovableSet(eid, marker))
+        assert marker.cid is not None
+        return self._child_handler(marker.cid)
+
+    def insert_container(self, pos: int, ctype: ContainerType) -> Handler:
+        parent, side = self._state.seq.placement_for_visible_pos(pos)
+        marker = _ChildMarker(ctype)
+        self._apply(SeqInsert(parent, side, (marker,)))
+        assert marker.cid is not None
+        return self._child_handler(marker.cid)
+
+
+class TreeHandler(Handler):
+    CT = ContainerType.Tree
+
+    def create(self, parent: Optional[TreeID] = None, index: Optional[int] = None) -> TreeID:
+        pos = self._position_for(parent, index)
+        marker = _TreeTargetMarker()
+        counter = self._apply(TreeMove(marker, parent, pos, is_create=True))  # type: ignore[arg-type]
+        return TreeID(self.doc.peer, counter)
+
+    def move(self, target: TreeID, parent: Optional[TreeID], index: Optional[int] = None) -> None:
+        if parent is not None and not self._state.contains(parent):
+            raise ValueError(f"parent {parent} not in tree")
+        pos = self._position_for(parent, index, moving=target)
+        self._apply(TreeMove(target, parent, pos))
+
+    def mov_to_root(self, target: TreeID) -> None:
+        self.move(target, None)
+
+    def delete(self, target: TreeID) -> None:
+        self._apply(TreeMove(target, None, None, is_delete=True))
+
+    def _position_for(
+        self, parent: Optional[TreeID], index: Optional[int], moving: Optional[TreeID] = None
+    ) -> bytes:
+        sibs = [t for t in self._state.children_of(parent) if t != moving]
+        positions = [self._state.nodes[t].position for t in sibs]
+        if index is None or index >= len(sibs):
+            lo = positions[-1] if positions else None
+            return key_between(lo, None)
+        hi = positions[index]
+        lo = positions[index - 1] if index > 0 else None
+        if lo is not None and hi is not None and lo >= hi:
+            # degenerate duplicate keys (concurrent same-position): nudge
+            return key_between(lo, None)
+        return key_between(lo, hi)
+
+    # -- reads --------------------------------------------------------
+    def contains(self, target: TreeID) -> bool:
+        return self._state.contains(target)
+
+    def children(self, parent: Optional[TreeID] = None) -> List[TreeID]:
+        return self._state.children_of(parent)
+
+    def roots(self) -> List[TreeID]:
+        return self._state.roots()
+
+    def parent(self, target: TreeID) -> Optional[TreeID]:
+        return self._state.parent_of(target)
+
+    def get_meta(self, target: TreeID) -> MapHandler:
+        if not self._state.contains(target) and target not in self._state.nodes:
+            raise ValueError(f"{target} not in tree")
+        return self._child_handler(self._state.meta_cid(target))  # type: ignore[return-value]
+
+    def nodes(self) -> List[TreeID]:
+        return [t for t in self._state.nodes if self._state.contains(t)]
+
+    def fractional_index(self, target: TreeID) -> Optional[bytes]:
+        n = self._state.nodes.get(target)
+        return n.position if n else None
+
+
+class _TreeTargetMarker:
+    """Placeholder for a tree-create target (id = the op's own id)."""
+
+    __slots__ = ()
+
+
+class CounterHandler(Handler):
+    CT = ContainerType.Counter
+
+    def increment(self, delta: float = 1.0) -> None:
+        self._apply(CounterIncr(float(delta)))
+
+    def decrement(self, delta: float = 1.0) -> None:
+        self._apply(CounterIncr(-float(delta)))
+
+    @property
+    def value(self) -> float:
+        return self._state.get_value()
+
+
+_HANDLER_BY_TYPE = {
+    ContainerType.Text: TextHandler,
+    ContainerType.List: ListHandler,
+    ContainerType.Map: MapHandler,
+    ContainerType.MovableList: MovableListHandler,
+    ContainerType.Tree: TreeHandler,
+    ContainerType.Counter: CounterHandler,
+}
+
+
+def make_handler(doc: "LoroDoc", cid: ContainerID) -> Handler:
+    return _HANDLER_BY_TYPE[cid.ctype](doc, cid)

@@ -1,0 +1,295 @@
+"""FugueSeq: the shared sequence CRDT for Text / List / MovableList.
+
+Mirrors the role of the reference's Fugue tracker
+(crates/loro-internal/src/container/richtext/tracker.rs +
+tracker/crdt_rope.rs) but with a different, TPU-first formulation:
+
+* Ops ship the Fugue **tree placement** `(parent, side)` decided at the
+  source replica (see core/change.py).  Integration is then pure tree
+  insertion with deterministic sibling order `(peer, counter)` — no
+  origin-scan — so a batch of inserts integrates on device by sorting
+  `(parent, side, peer, counter)` keys + list ranking
+  (loro_tpu/ops/fugue_batch.py).  This host class is the sequential
+  engine and the differential oracle for those kernels.
+
+* Local placement rule (Fugue, Weidner & Kleppmann "The Art of the
+  Fugue"): inserting after visible element `a`:
+    - `a` has no right children  -> (a, Right)
+    - else                       -> (succ(a), Left)
+  where succ(a) is a's immediate tree-traversal successor (tombstones
+  included).  succ(a) necessarily has no left children yet, so the new
+  element lands exactly at the intended position; concurrent same-spot
+  inserts become siblings ordered by id.
+
+Order maintenance is an order-statistic treap (utils/treap.py), the
+analog of the reference's generic-btree rope.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.change import Side, StyleAnchor
+from ..core.ids import ID, Counter, IdSpan, PeerID
+from ..utils.treap import Treap, TreapNode
+
+ROOT = None  # fugue-parent sentinel for root children
+
+
+class SeqElem(TreapNode):
+    """One sequence element (char / list value / anchor / position)."""
+
+    __slots__ = (
+        "peer",
+        "counter",
+        "content",
+        "deleted",
+        "fparent",  # Optional[SeqElem]; None = root child
+        "fside",  # Side
+        "l_children",  # List[SeqElem] sorted by (peer, counter)
+        "r_children",
+        "lamport",
+    )
+
+    def __init__(
+        self,
+        peer: PeerID,
+        counter: Counter,
+        content: Any,
+        fparent: Optional["SeqElem"],
+        fside: Side,
+        lamport: int = 0,
+    ):
+        self.peer = peer
+        self.counter = counter
+        self.content = content
+        self.deleted = False
+        self.fparent = fparent
+        self.fside = fside
+        self.l_children: List[SeqElem] = []
+        self.r_children: List[SeqElem] = []
+        self.lamport = lamport
+        is_anchor = isinstance(content, StyleAnchor)
+        self.init_treap(0 if is_anchor else 1)
+
+    @property
+    def id(self) -> ID:
+        return ID(self.peer, self.counter)
+
+    @property
+    def sib_key(self) -> Tuple[PeerID, Counter]:
+        return (self.peer, self.counter)
+
+    @property
+    def is_anchor(self) -> bool:
+        return isinstance(self.content, StyleAnchor)
+
+    def base_width(self) -> int:
+        return 0 if self.is_anchor else 1
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{self.counter}@{self.peer} {self.content!r}{' DEL' if self.deleted else ''}>"
+
+
+class FugueSeq:
+    """The sequence CRDT.  All mutation goes through local_* (source
+    replica) or integrate_* (both local apply and remote merge)."""
+
+    def __init__(self) -> None:
+        self.treap = Treap()
+        self.by_id: Dict[Tuple[PeerID, Counter], SeqElem] = {}
+        self.root_children: List[SeqElem] = []  # sorted by sib_key; side=Right
+
+    # ------------------------------------------------------------------
+    # tree navigation
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _subtree_last(x: SeqElem) -> SeqElem:
+        while x.r_children:
+            x = x.r_children[-1]
+        return x
+
+    @staticmethod
+    def _subtree_first(x: SeqElem) -> SeqElem:
+        while x.l_children:
+            x = x.l_children[0]
+        return x
+
+    # ------------------------------------------------------------------
+    # local placement (source replica)
+    # ------------------------------------------------------------------
+    def placement_for_visible_pos(self, k: int) -> Tuple[Optional[ID], Side]:
+        """Compute the Fugue (parent, side) for a local insert at visible
+        position k (0..visible_len)."""
+        if k == 0:
+            f = self.treap.first()
+            if f is None:
+                return None, Side.Right
+            return f.id, Side.Left  # type: ignore[union-attr]
+        a = self.treap.find_visible(k - 1)
+        assert a is not None, f"insert pos {k} out of range"
+        return self._placement_after(a)
+
+    def _placement_after(self, a: SeqElem) -> Tuple[Optional[ID], Side]:
+        if not a.r_children:
+            return a.id, Side.Right
+        succ = Treap.successor(a)
+        assert succ is not None and not succ.l_children  # immediate successor
+        return succ.id, Side.Left  # type: ignore[union-attr]
+
+    def placement_after_elem(self, elem_id: ID) -> Tuple[Optional[ID], Side]:
+        """Placement immediately after a known element (used by
+        MovableList move and style-anchor insertion)."""
+        return self._placement_after(self.by_id[(elem_id.peer, elem_id.counter)])
+
+    # ------------------------------------------------------------------
+    # integration (local + remote)
+    # ------------------------------------------------------------------
+    def integrate_insert(
+        self,
+        peer: PeerID,
+        counter: Counter,
+        parent: Optional[ID],
+        side: Side,
+        contents: Sequence[Any],
+        lamport: int = 0,
+    ) -> Tuple[int, List[SeqElem]]:
+        """Insert a run of elements with ids (peer, counter+j).  Element 0
+        is placed per (parent, side); element j>0 chains as Right child of
+        element j-1 (RLE right-spine, like the reference's FugueSpan runs).
+        Returns (visible position of first element, created elems)."""
+        first = SeqElem(peer, counter, contents[0], None, side, lamport)
+        self._place(first, parent, side)
+        elems = [first]
+        prev = first
+        for j in range(1, len(contents)):
+            e = SeqElem(peer, counter + j, contents[j], prev, Side.Right, lamport + j)
+            # prev was just created: appending keeps (peer,counter) order
+            prev.r_children.append(e)
+            self.treap.insert_after(prev, e)
+            self.by_id[(peer, counter + j)] = e
+            elems.append(e)
+            prev = e
+        pos = self.treap.visible_rank(first)
+        return pos, elems
+
+    def _place(self, n: SeqElem, parent: Optional[ID], side: Side) -> None:
+        """Fugue tree insertion with sibling order (peer, counter)."""
+        if parent is None:
+            sibs = self.root_children
+            parent_elem = None
+        else:
+            parent_elem = self.by_id[(parent.peer, parent.counter)]
+            sibs = parent_elem.r_children if side == Side.Right else parent_elem.l_children
+        n.fparent = parent_elem
+        n.fside = side
+        i = bisect.bisect_left(sibs, n.sib_key, key=lambda e: e.sib_key)
+        sibs.insert(i, n)
+        if side == Side.Right:
+            if i == 0:
+                pred = parent_elem  # may be None (root): insert at beginning
+                if parent is None:
+                    # root children: first sibling -> very beginning unless
+                    # there are smaller siblings (i==0 means none)
+                    pred = None
+            else:
+                pred = self._subtree_last(sibs[i - 1])
+            self.treap.insert_after(pred, n)
+        else:
+            if i > 0:
+                pred = self._subtree_last(sibs[i - 1])
+                self.treap.insert_after(pred, n)
+            else:
+                # new leftmost of parent's subtree: before old subtree-first
+                assert parent_elem is not None
+                old_first = parent_elem
+                # subtree-first along remaining l_children (excluding n)
+                cur = parent_elem
+                while True:
+                    lc = [c for c in cur.l_children if c is not n]
+                    if not lc:
+                        break
+                    cur = lc[0]
+                old_first = cur
+                pred = Treap.predecessor(old_first)
+                self.treap.insert_after(pred, n)
+        self.by_id[(n.peer, n.counter)] = n
+
+    def integrate_delete(self, spans: Iterable[IdSpan]) -> List[Tuple[int, int]]:
+        """Tombstone elements by id.  Returns visible (pos, len) ranges
+        that disappeared (merged, descending-safe order of single units)."""
+        removed: List[Tuple[int, int]] = []
+        for span in spans:
+            for c in range(span.start, span.end):
+                e = self.by_id.get((span.peer, c))
+                if e is None or e.deleted:
+                    continue
+                pos = self.treap.visible_rank(e)
+                had = e.vis_w
+                e.deleted = True
+                self.treap.set_visible(e, 0)
+                if had:
+                    removed.append((pos, 1))
+        return _merge_removed(removed)
+
+    def set_visible(self, elem: SeqElem, vis_w: int) -> None:
+        """Directly control an element's visible width (MovableList uses
+        this for slot-winner bookkeeping)."""
+        self.treap.set_visible(elem, vis_w)
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+    @property
+    def visible_len(self) -> int:
+        return self.treap.visible_len
+
+    @property
+    def total_len(self) -> int:
+        return self.treap.total_len
+
+    def visible_elems(self) -> Iterable[SeqElem]:
+        for e in self.treap:
+            if e.vis_w:
+                yield e
+
+    def all_elems(self) -> Iterable[SeqElem]:
+        return iter(self.treap)
+
+    def elem_at(self, k: int) -> Optional[SeqElem]:
+        n = self.treap.find_visible(k)
+        return n  # type: ignore[return-value]
+
+    def id_range_of_visible(self, k: int, length: int) -> List[IdSpan]:
+        """Ids of the visible elements in [k, k+length) as RLE spans —
+        the payload of a SeqDelete op."""
+        spans: List[IdSpan] = []
+        e = self.treap.find_visible(k)
+        n = 0
+        while e is not None and n < length:
+            if e.vis_w:
+                if spans and spans[-1].peer == e.peer and spans[-1].end == e.counter:
+                    spans[-1] = IdSpan(e.peer, spans[-1].start, e.counter + 1)
+                else:
+                    spans.append(IdSpan(e.peer, e.counter, e.counter + 1))
+                n += 1
+            e = Treap.successor(e)  # type: ignore[assignment]
+        return spans
+
+    def visible_index_of(self, elem_id: ID) -> Optional[int]:
+        e = self.by_id.get((elem_id.peer, elem_id.counter))
+        if e is None or not e.vis_w:
+            return None
+        return self.treap.visible_rank(e)
+
+
+def _merge_removed(removed: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """Merge unit removals into ranges.  Successive deletions at the same
+    visible position (forward sweep) collapse into one range."""
+    out: List[Tuple[int, int]] = []
+    for pos, ln in removed:
+        if out and out[-1][0] == pos:
+            out[-1] = (pos, out[-1][1] + ln)
+        else:
+            out.append((pos, ln))
+    return out

@@ -1,0 +1,213 @@
+"""Movable tree container state (Kleppmann-style movable tree).
+
+reference: crates/loro-internal/src/state/tree_state.rs +
+diff_calc/tree.rs.  Semantics: all moves are applied in global
+(lamport, peer, counter) order; a move whose new parent lies inside the
+target's own subtree at that moment is a no-op (`effected = false`,
+tree.rs:499-508).  Deletion is a move under the TRASH sentinel.
+Sibling order is (fractional_index, (lamport, peer)) — tree.rs:592-595.
+
+Out-of-(lamport)-order arrivals trigger a replay of the move log — the
+same sorted-replay the batched device kernel performs with a
+pointer-doubling ancestor check (loro_tpu/ops/tree_batch.py).
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.change import Op, TreeMove
+from ..core.ids import ContainerID, ContainerType, TreeID
+from ..event import Diff, TreeDiff, TreeDiffAction, TreeDiffItem
+from .base import ContainerState
+
+TRASH = TreeID(0xFFFF_FFFF_FFFF_FFFF, -1)  # deleted-subtree sentinel parent
+
+
+class TreeNode:
+    __slots__ = ("parent", "position", "move_key")
+
+    def __init__(self, parent: Optional[TreeID], position: Optional[bytes], move_key: Tuple):
+        self.parent = parent  # None = root child, TRASH = deleted
+        self.position = position
+        self.move_key = move_key  # (lamport, peer, counter) of effective move
+
+
+class TreeState(ContainerState):
+    def __init__(self, cid: ContainerID):
+        super().__init__(cid)
+        self.nodes: Dict[TreeID, TreeNode] = {}
+        # full move log sorted by (lamport, peer, counter); replayed on
+        # out-of-order arrivals (rare) and by the device kernel (always)
+        self.moves: List[Tuple[Tuple[int, int, int], TreeMove]] = []
+
+    # ------------------------------------------------------------------
+    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+        c = op.content
+        assert isinstance(c, TreeMove)
+        key = (lamport, peer, op.counter)
+        entry = (key, c)
+        if not self.moves or self.moves[-1][0] < key:
+            self.moves.append(entry)
+            return self._apply_move(key, c)
+        # out-of-order in lamport: insert into log and replay
+        i = bisect.bisect_left(self.moves, key, key=lambda e: e[0])
+        if i < len(self.moves) and self.moves[i][0] == key:
+            return None  # duplicate
+        self.moves.insert(i, entry)
+        return self._replay_all()
+
+    def _apply_move(self, key: Tuple, c: TreeMove) -> Optional[Diff]:
+        target = c.target
+        parent = TRASH if c.is_delete else c.parent
+        if parent is not None and parent != TRASH and self._creates_cycle(target, parent):
+            return None  # not effected
+        was = self.nodes.get(target)
+        was_alive = was is not None and not self._is_deleted(target)
+        self.nodes[target] = TreeNode(parent, c.position, key)
+        now_alive = not self._is_deleted(target)
+        d = TreeDiff()
+        if was_alive and not now_alive:
+            d.items.append(TreeDiffItem(target, TreeDiffAction.Delete))
+        elif now_alive and not was_alive:
+            d.items.append(
+                TreeDiffItem(target, TreeDiffAction.Create, parent, self.index_of(target), c.position)
+            )
+        elif was_alive and now_alive:
+            d.items.append(
+                TreeDiffItem(target, TreeDiffAction.Move, parent, self.index_of(target), c.position)
+            )
+        else:
+            return None  # dead -> dead: invisible
+        return d
+
+    def _replay_all(self) -> Optional[Diff]:
+        """Rebuild node table by replaying the sorted move log, then diff
+        old vs new tables (reference retreat/forward, tree.rs:230-396)."""
+        old = {t: (n.parent, n.position) for t, n in self.nodes.items() if not self._is_deleted(t)}
+        self.nodes = {}
+        for key, c in self.moves:
+            target = c.target
+            parent = TRASH if c.is_delete else c.parent
+            if parent is not None and parent != TRASH and self._creates_cycle(target, parent):
+                continue
+            self.nodes[target] = TreeNode(parent, c.position, key)
+        d = TreeDiff()
+        new_alive = {t for t in self.nodes if not self._is_deleted(t)}
+        for t in old:
+            if t not in new_alive:
+                d.items.append(TreeDiffItem(t, TreeDiffAction.Delete))
+        for t in sorted(new_alive, key=self._depth):
+            n = self.nodes[t]
+            if t not in old:
+                d.items.append(
+                    TreeDiffItem(t, TreeDiffAction.Create, n.parent, self.index_of(t), n.position)
+                )
+            elif old[t] != (n.parent, n.position):
+                d.items.append(
+                    TreeDiffItem(t, TreeDiffAction.Move, n.parent, self.index_of(t), n.position)
+                )
+        return d if d.items else None
+
+    # ------------------------------------------------------------------
+    def _creates_cycle(self, target: TreeID, new_parent: TreeID) -> bool:
+        """True if target is an ancestor of new_parent (or equal)."""
+        cur: Optional[TreeID] = new_parent
+        seen = 0
+        while cur is not None and cur != TRASH:
+            if cur == target:
+                return True
+            node = self.nodes.get(cur)
+            cur = node.parent if node else None
+            seen += 1
+            if seen > len(self.nodes) + 1:  # corrupted cycle guard
+                return True
+        return False
+
+    def _is_deleted_parent(self, parent: Optional[TreeID]) -> bool:
+        return parent == TRASH or (parent is not None and self._is_deleted(parent))
+
+    def _is_deleted(self, t: TreeID) -> bool:
+        cur: Optional[TreeID] = t
+        while cur is not None:
+            if cur == TRASH:
+                return True
+            node = self.nodes.get(cur)
+            if node is None:
+                return False
+            cur = node.parent
+        return False
+
+    def _depth(self, t: TreeID) -> int:
+        d = 0
+        node = self.nodes.get(t)
+        while node is not None and node.parent is not None and node.parent != TRASH:
+            d += 1
+            node = self.nodes.get(node.parent)
+        return d
+
+    # -- queries ------------------------------------------------------
+    def children_of(self, parent: Optional[TreeID]) -> List[TreeID]:
+        kids = [
+            (n.position or b"", n.move_key, t)
+            for t, n in self.nodes.items()
+            if n.parent == parent and not self._is_deleted(t)
+        ]
+        kids.sort(key=lambda x: (x[0], x[1]))
+        return [t for _, _, t in kids]
+
+    def index_of(self, t: TreeID) -> int:
+        n = self.nodes.get(t)
+        if n is None or self._is_deleted(t):
+            return -1
+        sibs = self.children_of(n.parent)
+        return sibs.index(t)
+
+    def parent_of(self, t: TreeID) -> Optional[TreeID]:
+        n = self.nodes.get(t)
+        return n.parent if n else None
+
+    def contains(self, t: TreeID) -> bool:
+        return t in self.nodes and not self._is_deleted(t)
+
+    def roots(self) -> List[TreeID]:
+        return self.children_of(None)
+
+    def meta_cid(self, t: TreeID) -> ContainerID:
+        """Every tree node owns a meta map container keyed by its id
+        (reference: tree node `meta` handler)."""
+        return ContainerID.normal(t.peer, t.counter, ContainerType.Map)
+
+    def get_value(self) -> List[dict]:
+        """Flat node list (id, parent, index, fractional_index, meta cid),
+        matching the reference's tree value shape."""
+        out = []
+        queue: List[Optional[TreeID]] = [None]
+        while queue:
+            parent = queue.pop(0)
+            for i, t in enumerate(self.children_of(parent)):
+                n = self.nodes[t]
+                out.append(
+                    {
+                        "id": str(t),
+                        "parent": str(parent) if parent is not None else None,
+                        "index": i,
+                        "fractional_index": (n.position or b"").hex(),
+                        "meta": self.meta_cid(t),
+                    }
+                )
+                queue.append(t)
+        return out
+
+    def to_diff(self) -> Diff:
+        d = TreeDiff()
+        stack = [(None, t) for t in reversed(self.roots())]
+        while stack:
+            parent, t = stack.pop()
+            n = self.nodes[t]
+            d.items.append(
+                TreeDiffItem(t, TreeDiffAction.Create, parent, self.index_of(t), n.position)
+            )
+            for c in reversed(self.children_of(t)):
+                stack.append((t, c))
+        return d

@@ -1,0 +1,266 @@
+"""OpLog: append-only causal op history.
+
+reference: crates/loro-internal/src/oplog.rs + oplog/pending_changes.rs +
+oplog/change_store.rs.  Host-side store: per-peer sorted change lists
+(the columnar block encoding lives in loro_tpu/codec/; the device-facing
+SoA extraction lives in loro_tpu/ops/columnar.py).
+"""
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core.change import Change, Op, SeqDelete, SeqInsert, StyleAnchor
+from ..core.ids import ID, Counter, IdSpan, Lamport, PeerID
+from ..core.version import Frontiers, VersionRange, VersionVector
+from .dag import AppDag, DiffMode
+
+
+@dataclass
+class PendingChanges:
+    """Changes whose deps aren't satisfied yet, keyed by a missing dep id.
+    reference: oplog/pending_changes.rs."""
+
+    by_missing: Dict[ID, List[Change]] = field(default_factory=dict)
+
+    def park(self, missing: ID, change: Change) -> None:
+        self.by_missing.setdefault(missing, []).append(change)
+
+    def take_unlocked(self, vv: VersionVector) -> List[Change]:
+        """Pop every parked change whose trigger dep is now satisfied."""
+        out: List[Change] = []
+        for key in [k for k in self.by_missing if vv.includes(k)]:
+            out.extend(self.by_missing.pop(key))
+        return out
+
+    def pending_range(self) -> VersionRange:
+        vr = VersionRange()
+        for lst in self.by_missing.values():
+            for ch in lst:
+                vr.extend_to_include(ch.id_span())
+        return vr
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self.by_missing.values())
+
+
+class OpLog:
+    """Append-only causal history: changes + DAG + pending queue."""
+
+    def __init__(self) -> None:
+        self.dag = AppDag()
+        self.changes: Dict[PeerID, List[Change]] = {}
+        self._starts: Dict[PeerID, List[Counter]] = {}
+        self.pending = PendingChanges()
+        self.next_lamport: Lamport = 0
+
+    # -- queries ------------------------------------------------------
+    @property
+    def vv(self) -> VersionVector:
+        return self.dag.vv
+
+    @property
+    def frontiers(self) -> Frontiers:
+        return self.dag.frontiers
+
+    def is_empty(self) -> bool:
+        return not self.changes and len(self.pending) == 0
+
+    def change_at(self, id: ID) -> Optional[Change]:
+        starts = self._starts.get(id.peer)
+        if not starts:
+            return None
+        i = bisect.bisect_right(starts, id.counter) - 1
+        if i < 0:
+            return None
+        ch = self.changes[id.peer][i]
+        return ch if ch.ctr_start <= id.counter < ch.ctr_end else None
+
+    def total_ops(self) -> int:
+        return self.vv.total_ops()
+
+    def total_changes(self) -> int:
+        return sum(len(v) for v in self.changes.values())
+
+    # -- local commit -------------------------------------------------
+    def next_counter(self, peer: PeerID) -> Counter:
+        return self.vv.get(peer)
+
+    def import_local_change(self, change: Change) -> None:
+        """Single mutation point for local commits
+        (reference: oplog.rs:191-220 insert_new_change)."""
+        assert change.ctr_start == self.vv.get(change.peer), "non-contiguous local change"
+        for d in change.deps:
+            assert self.dag.contains(d), f"local change dep missing: {d}"
+        self._insert_change(change)
+
+    # -- remote import ------------------------------------------------
+    def import_changes(self, changes: Iterable[Change]) -> Tuple[List[Change], VersionRange]:
+        """Import remote changes: dedup known spans, park dep-missing ones,
+        apply the rest in causal order.  Returns (applied changes in causal
+        order, still-pending version range).
+        reference: oplog.rs apply_decoded_changes_to_oplog + pending loop."""
+        queue: List[Change] = list(changes)
+        applied: List[Change] = []
+        progress = True
+        while progress:
+            progress = False
+            next_queue: List[Change] = []
+            # causal linearization attempt: sort by (lamport, peer, ctr)
+            queue.sort(key=lambda c: (c.lamport, c.peer, c.ctr_start))
+            for ch in queue:
+                known_end = self.vv.get(ch.peer)
+                if ch.ctr_end <= known_end:
+                    continue  # fully known — dedup (trim_the_known_part)
+                if ch.ctr_start > known_end:
+                    # a gap within the same peer: park on the previous op
+                    self.pending.park(ID(ch.peer, ch.ctr_start - 1), ch)
+                    continue
+                if ch.ctr_start < known_end:
+                    ch = self._trim_known_prefix(ch, known_end)
+                missing = next((d for d in ch.deps if not self.dag.contains(d)), None)
+                if missing is not None:
+                    self.pending.park(missing, ch)
+                    continue
+                self._insert_change(ch)
+                applied.append(ch)
+                progress = True
+                # unlock parked changes whose trigger is now satisfied
+                next_queue.extend(self.pending.take_unlocked(self.vv))
+            queue = next_queue
+        return applied, self.pending.pending_range()
+
+    def _trim_known_prefix(self, ch: Change, known_end: Counter) -> Change:
+        ops: List[Op] = []
+        for op in ch.ops:
+            if op.ctr_end <= known_end:
+                continue
+            if op.counter < known_end:
+                assert isinstance(op.content, SeqInsert)
+                op = _slice_run(op, known_end)
+            ops.append(op)
+        off = known_end - ch.ctr_start
+        return Change(
+            id=ID(ch.peer, known_end),
+            lamport=ch.lamport + off,
+            deps=Frontiers([ID(ch.peer, known_end - 1)]),
+            ops=ops,
+            timestamp=ch.timestamp,
+            message=ch.message,
+        )
+
+    def _insert_change(self, ch: Change) -> None:
+        lst = self.changes.setdefault(ch.peer, [])
+        starts = self._starts.setdefault(ch.peer, [])
+        lst.append(ch)
+        starts.append(ch.ctr_start)
+        self.dag.add_node(ch.peer, ch.ctr_start, ch.ctr_end, ch.lamport, tuple(ch.deps))
+        if ch.lamport_end > self.next_lamport:
+            self.next_lamport = ch.lamport_end
+
+    # -- export -------------------------------------------------------
+    def changes_since(self, vv: VersionVector) -> List[Change]:
+        """All changes (sliced) not included in `vv`, in causal order.
+        reference: ChangeStore.export_blocks_from."""
+        out: List[Change] = []
+        for peer, lst in self.changes.items():
+            start = vv.get(peer)
+            i = bisect.bisect_right(self._starts[peer], start) - 1
+            i = max(i, 0)
+            for ch in lst[i:]:
+                if ch.ctr_end <= start:
+                    continue
+                out.append(ch if ch.ctr_start >= start else self._trim_known_prefix_view(ch, start))
+        out.sort(key=lambda c: (c.lamport, c.peer, c.ctr_start))
+        return out
+
+    def _trim_known_prefix_view(self, ch: Change, start: Counter) -> Change:
+        return self._trim_known_prefix(ch, start)
+
+    def changes_between(self, from_vv: VersionVector, to_vv: VersionVector) -> List[Change]:
+        """Changes (sliced) with counters in [from_vv, to_vv) per peer, in
+        causal order.  `to_vv` must be causally closed (a valid version)."""
+        out: List[Change] = []
+        for peer, lst in self.changes.items():
+            lo = from_vv.get(peer)
+            hi = to_vv.get(peer)
+            if hi <= lo:
+                continue
+            i = bisect.bisect_right(self._starts[peer], lo) - 1
+            i = max(i, 0)
+            for ch in lst[i:]:
+                if ch.ctr_end <= lo:
+                    continue
+                if ch.ctr_start >= hi:
+                    break
+                if ch.ctr_start < lo:
+                    ch = self._trim_known_prefix(ch, lo)
+                if ch.ctr_end > hi:
+                    ch = _slice_change_end(ch, hi)
+                out.append(ch)
+        out.sort(key=lambda c: (c.lamport, c.peer, c.ctr_start))
+        return out
+
+    def changes_in_causal_order(self) -> List[Change]:
+        out = [ch for lst in self.changes.values() for ch in lst]
+        out.sort(key=lambda c: (c.lamport, c.peer, c.ctr_start))
+        return out
+
+    def iter_ops_causal(self, since: Optional[VersionVector] = None):
+        """Yield (change, op) pairs in a causal linear extension."""
+        chs = self.changes_in_causal_order() if since is None else self.changes_since(since)
+        for ch in chs:
+            for op in ch.ops:
+                yield ch, op
+
+    def diagnose_size(self) -> Dict[str, int]:
+        """reference: oplog.rs:675 diagnose_size."""
+        return {
+            "changes": self.total_changes(),
+            "ops": sum(len(c.ops) for lst in self.changes.values() for c in lst),
+            "atoms": self.total_ops(),
+            "dag_nodes": self.dag.total_changes(),
+            "pending": len(self.pending),
+        }
+
+
+def _slice_change_end(ch: Change, end: Counter) -> Change:
+    """Restrict a change to counters < end (for ranged export/checkout)."""
+    ops: List[Op] = []
+    for op in ch.ops:
+        if op.counter >= end:
+            break
+        if op.ctr_end > end:
+            c = op.content
+            assert isinstance(c, SeqInsert)
+            keep = end - op.counter
+            op = Op(op.counter, op.container, SeqInsert(c.parent, c.side, c.content[:keep]))
+        ops.append(op)
+    return Change(ch.id, ch.lamport, ch.deps, ops, ch.timestamp, ch.message)
+
+
+def _slice_run(op: Op, new_start: Counter) -> Op:
+    """Slice a SeqInsert run so it starts at `new_start`.  The sliced run's
+    first element's parent is the previous element of the original run."""
+    c: SeqInsert = op.content  # type: ignore[assignment]
+    from ..core.change import Side
+
+    off = new_start - op.counter
+    # NOTE: run element ids are (peer, op.counter + j); we don't know peer
+    # here, so the caller-facing invariant is that slicing happens at the
+    # Change level where peer is known.  We re-derive parent at apply time:
+    # element j>0's parent is always (peer, counter-1) implicitly, so the
+    # sliced op keeps parent=None and a flag via counter offset.
+    return Op(new_start, op.container, SeqInsert(_RUN_CONT, Side.Right, c.content[off:]))
+
+
+class _RunCont:
+    """Sentinel parent meaning "previous counter of the same peer"
+    (restores the implicit right-spine parent after run slicing)."""
+
+    def __repr__(self) -> str:
+        return "<run-cont>"
+
+
+_RUN_CONT = _RunCont()

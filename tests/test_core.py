@@ -1,0 +1,192 @@
+"""Core type tests: ids, versions, fractional index, treap, delta."""
+import pytest
+
+from loro_tpu.core.ids import ContainerID, ContainerType, ID, IdSpan, TreeID
+from loro_tpu.core.version import Frontiers, VersionVector
+from loro_tpu.event import Delete, Delta, Insert, Retain
+from loro_tpu.utils.fractional_index import key_between, keys_between
+from loro_tpu.utils.treap import Treap, TreapNode
+
+
+class TestIds:
+    def test_id_roundtrip(self):
+        i = ID(12345678901234567890 % (1 << 63), 42)
+        assert ID.parse(str(i)) == i
+
+    def test_container_id_roundtrip(self):
+        for cid in [
+            ContainerID.root("doc", ContainerType.Text),
+            ContainerID.root("a:b", ContainerType.Map),
+            ContainerID.normal(7, 99, ContainerType.Tree),
+        ]:
+            assert ContainerID.parse(str(cid)) == cid
+
+    def test_container_id_hash_eq(self):
+        a = ContainerID.root("x", ContainerType.List)
+        b = ContainerID.root("x", ContainerType.List)
+        assert a == b and hash(a) == hash(b)
+        assert a != ContainerID.root("x", ContainerType.Map)
+
+    def test_span(self):
+        s = IdSpan(1, 5, 10)
+        assert len(s) == 5
+        assert s.contains(ID(1, 5)) and s.contains(ID(1, 9))
+        assert not s.contains(ID(1, 10)) and not s.contains(ID(2, 5))
+
+
+class TestVersionVector:
+    def test_basic(self):
+        vv = VersionVector()
+        vv.extend_to_include(IdSpan(1, 0, 5))
+        vv.extend_to_include(IdSpan(2, 0, 3))
+        assert vv.includes(ID(1, 4)) and not vv.includes(ID(1, 5))
+        assert vv.total_ops() == 8
+
+    def test_meet_join(self):
+        a = VersionVector({1: 5, 2: 3})
+        b = VersionVector({1: 2, 3: 4})
+        assert a.meet(b) == VersionVector({1: 2})
+        assert a.join(b) == VersionVector({1: 5, 2: 3, 3: 4})
+
+    def test_partial_order(self):
+        a = VersionVector({1: 2})
+        b = VersionVector({1: 5, 2: 1})
+        assert a <= b and not b <= a
+
+    def test_diff_spans(self):
+        a = VersionVector({1: 5, 2: 3})
+        b = VersionVector({1: 2})
+        assert a.diff_spans(b) == [IdSpan(1, 2, 5), IdSpan(2, 0, 3)]
+
+    def test_json_roundtrip(self):
+        a = VersionVector({1: 5, 2: 3})
+        assert VersionVector.from_json(a.to_json()) == a
+
+
+class TestFractionalIndex:
+    def test_between_none(self):
+        k = key_between(None, None)
+        assert isinstance(k, bytes) and len(k) == 1
+
+    def test_ordering(self):
+        a = key_between(None, None)
+        b = key_between(a, None)
+        c = key_between(a, b)
+        assert a < c < b
+
+    def test_many_sequential(self):
+        keys = keys_between(None, None, 200)
+        assert keys == sorted(keys)
+        assert len(set(keys)) == 200
+
+    def test_dense_between(self):
+        a, b = bytes([100]), bytes([101])
+        cur_a = a
+        for _ in range(50):
+            m = key_between(cur_a, b)
+            assert cur_a < m < b
+            cur_a = m
+
+
+class TestTreap:
+    class N(TreapNode):
+        def __init__(self, val, w=1):
+            self.val = val
+            self.init_treap(w)
+
+    def test_insert_and_order(self):
+        t = Treap()
+        nodes = []
+        for i in range(100):
+            n = self.N(i)
+            t.insert_after(nodes[-1] if nodes else None, n)
+            nodes.append(n)
+        assert [n.val for n in t] == list(range(100))
+        assert t.visible_len == 100
+
+    def test_insert_at_beginning_and_middle(self):
+        t = Treap()
+        a, b, c = self.N("a"), self.N("b"), self.N("c")
+        t.insert_after(None, b)
+        t.insert_after(None, a)
+        t.insert_after(b, c)
+        assert [n.val for n in t] == ["a", "b", "c"]
+
+    def test_visibility(self):
+        t = Treap()
+        nodes = []
+        for i in range(10):
+            n = self.N(i)
+            t.insert_after(nodes[-1] if nodes else None, n)
+            nodes.append(n)
+        t.set_visible(nodes[3], 0)
+        t.set_visible(nodes[7], 0)
+        assert t.visible_len == 8
+        assert t.find_visible(3).val == 4
+        assert t.visible_rank(nodes[8]) == 6
+
+    def test_rank_random(self):
+        import random
+
+        rng = random.Random(42)
+        t = Treap()
+        seq = []
+        for i in range(500):
+            pos = rng.randint(0, len(seq))
+            n = self.N(i)
+            t.insert_after(seq[pos - 1] if pos else None, n)
+            seq.insert(pos, n)
+        assert [n.val for n in t] == [n.val for n in seq]
+        for i in [0, 100, 250, 499]:
+            assert t.visible_rank(seq[i]) == i
+            assert t.find_visible(i) is seq[i]
+
+
+class TestDelta:
+    def test_apply_text(self):
+        d = Delta().retain(2).insert("XY").delete(1)
+        assert d.apply_to_text("abcd") == "abXYd"
+
+    def test_compose(self):
+        d1 = Delta().retain(2).insert("XY")
+        d2 = Delta().retain(1).delete(2)
+        composed = d1.compose(d2)
+        assert composed.apply_to_text("abcd") == d2.apply_to_text(d1.apply_to_text("abcd"))
+
+    def test_compose_random(self):
+        import random
+
+        rng = random.Random(7)
+        s = "abcdefghij"
+        for _ in range(100):
+            d1 = _random_delta(rng, len(s))
+            s1 = d1.apply_to_text(s)
+            d2 = _random_delta(rng, len(s1))
+            lhs = d1.compose(d2).apply_to_text(s)
+            rhs = d2.apply_to_text(s1)
+            assert lhs == rhs, f"{d1} . {d2}"
+
+    def test_normalize_merges_runs(self):
+        d = Delta().insert("a").insert("b").retain(1).retain(2).delete(1).delete(2)
+        assert d.items == [Insert("ab"), Retain(3), Delete(3)]
+
+    def test_list_delta(self):
+        d = Delta().retain(1).insert((10, 20)).delete(1)
+        assert d.apply_to_list([1, 2, 3]) == [1, 10, 20, 3]
+
+
+def _random_delta(rng, n):
+    d = Delta()
+    pos = 0
+    while pos < n and rng.random() < 0.7:
+        r = rng.randint(0, n - pos)
+        if r and rng.random() < 0.5:
+            d.retain(r)
+            pos += r
+        dl = rng.randint(0, n - pos)
+        if dl and rng.random() < 0.5:
+            d.delete(dl)
+            pos += dl
+        if rng.random() < 0.5:
+            d.insert("".join(rng.choice("xyz") for _ in range(rng.randint(1, 3))))
+    return d

@@ -1,0 +1,546 @@
+"""End-to-end doc tests: local edits, sync, convergence for every
+container type (mirrors crates/loro/tests integration style)."""
+import pytest
+
+from loro_tpu import ContainerType, ExportMode, Frontiers, LoroDoc, LoroError, VersionVector
+
+
+def sync(a: LoroDoc, b: LoroDoc) -> None:
+    """Two-round sync (reference README's sync example)."""
+    b.import_(a.export_updates(b.oplog_vv()))
+    a.import_(b.export_updates(a.oplog_vv()))
+
+
+class TestText:
+    def test_insert_delete(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.delete(5, 6)
+        t.insert(5, "!")
+        assert t.to_string() == "hello!"
+        doc.commit()
+        assert doc.get_value()["t"] == "hello!"
+
+    def test_middle_insert(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "ac")
+        t.insert(1, "b")
+        assert t.to_string() == "abc"
+
+    def test_sequential_typing(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        for i, ch in enumerate("hello"):
+            t.insert(i, ch)
+        assert t.to_string() == "hello"
+
+    def test_sync_concurrent(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "abc")
+        sync(a, b)
+        assert b.get_text("t").to_string() == "abc"
+        a.get_text("t").insert(3, "A")
+        b.get_text("t").insert(0, "B")
+        sync(a, b)
+        assert a.get_text("t").to_string() == b.get_text("t").to_string()
+        assert a.get_text("t").to_string() == "BabcA"
+
+    def test_concurrent_same_position_no_interleave(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "base")
+        sync(a, b)
+        a.get_text("t").insert(4, "AAA")
+        b.get_text("t").insert(4, "BBB")
+        sync(a, b)
+        s = a.get_text("t").to_string()
+        assert s == b.get_text("t").to_string()
+        # Fugue guarantees no interleaving of the two runs
+        assert "AAA" in s and "BBB" in s
+        assert s in ("baseAAABBB", "baseBBBAAA")
+
+    def test_update(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "the quick brown fox")
+        t.update("the slow brown cat")
+        assert t.to_string() == "the slow brown cat"
+
+    def test_three_way_convergence(self):
+        docs = [LoroDoc(peer=i + 1) for i in range(3)]
+        docs[0].get_text("t").insert(0, "seed")
+        for d in docs[1:]:
+            d.import_(docs[0].export_snapshot())
+        docs[0].get_text("t").insert(0, "X")
+        docs[1].get_text("t").insert(2, "Y")
+        docs[2].get_text("t").insert(4, "Z")
+        blobs = [d.export_updates() for d in docs]
+        for d in docs:
+            for blob in blobs:
+                d.import_(blob)
+        texts = [d.get_text("t").to_string() for d in docs]
+        assert texts[0] == texts[1] == texts[2]
+        assert sorted(c for c in texts[0]) == sorted("seedXYZ")
+
+
+class TestRichText:
+    def test_mark(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(0, 5, "bold", True)
+        segs = t.get_richtext_value()
+        assert segs == [
+            {"insert": "hello", "attributes": {"bold": True}},
+            {"insert": " world"},
+        ]
+
+    def test_unmark(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello")
+        t.mark(0, 5, "bold", True)
+        t.unmark(1, 3, "bold")
+        segs = t.get_richtext_value()
+        assert segs == [
+            {"insert": "h", "attributes": {"bold": True}},
+            {"insert": "el"},
+            {"insert": "lo", "attributes": {"bold": True}},
+        ]
+
+    def test_mark_syncs(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        t = a.get_text("t")
+        t.insert(0, "hello")
+        t.mark(0, 5, "bold", True)
+        sync(a, b)
+        assert b.get_text("t").get_richtext_value() == [
+            {"insert": "hello", "attributes": {"bold": True}}
+        ]
+
+    def test_concurrent_marks_lww(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "hello")
+        sync(a, b)
+        a.get_text("t").mark(0, 5, "color", "red")
+        b.get_text("t").mark(0, 5, "color", "blue")
+        sync(a, b)
+        sa = a.get_text("t").get_richtext_value()
+        sb = b.get_text("t").get_richtext_value()
+        assert sa == sb
+        assert sa[0]["attributes"]["color"] in ("red", "blue")
+
+
+class TestList:
+    def test_basic(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_list("l")
+        l.insert(0, 1, 2, 3)
+        l.insert(1, "x")
+        l.delete(0, 1)
+        assert l.get_value() == ["x", 2, 3]
+
+    def test_sync(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_list("l").push(1, 2)
+        sync(a, b)
+        a.get_list("l").push(3)
+        b.get_list("l").insert(0, 0)
+        sync(a, b)
+        assert a.get_list("l").get_value() == b.get_list("l").get_value()
+        assert a.get_list("l").get_value() == [0, 1, 2, 3]
+
+    def test_nested_containers(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_list("l")
+        child = l.insert_container(0, ContainerType.Text)
+        child.insert(0, "inner")
+        assert doc.get_deep_value()["l"] == ["inner"]
+
+    def test_concurrent_delete_same_elem(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_list("l").push("x", "y", "z")
+        sync(a, b)
+        a.get_list("l").delete(1, 1)
+        b.get_list("l").delete(1, 1)
+        sync(a, b)
+        assert a.get_list("l").get_value() == b.get_list("l").get_value() == ["x", "z"]
+
+
+class TestMap:
+    def test_basic(self):
+        doc = LoroDoc(peer=1)
+        m = doc.get_map("m")
+        m.set("a", 1)
+        m.set("b", "two")
+        m.delete("a")
+        assert m.get_value() == {"b": "two"}
+
+    def test_lww(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_map("m").set("k", "from_a")
+        a.commit()
+        b.get_map("m").set("k", "from_b")
+        b.commit()
+        sync(a, b)
+        assert a.get_map("m").get_value() == b.get_map("m").get_value()
+        # peer 2 has higher peer id; equal lamports -> peer 2 wins
+        assert a.get_map("m").get("k") == "from_b"
+
+    def test_lww_lamport_beats_peer(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        b.get_map("m").set("k", "early")
+        sync(a, b)
+        a.get_map("m").set("k", "later")  # causally after, higher lamport
+        sync(a, b)
+        assert b.get_map("m").get("k") == "later"
+
+    def test_nested(self):
+        doc = LoroDoc(peer=1)
+        m = doc.get_map("m")
+        sub = m.set_container("sub", ContainerType.Map)
+        sub.set("x", 1)
+        lst = m.set_container("lst", ContainerType.List)
+        lst.push("a")
+        assert doc.get_deep_value()["m"] == {"sub": {"x": 1}, "lst": ["a"]}
+
+
+class TestMovableList:
+    def test_move(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_movable_list("l")
+        l.push("a", "b", "c")
+        l.move(0, 2)
+        assert l.get_value() == ["b", "c", "a"]
+        l.move(2, 0)
+        assert l.get_value() == ["a", "b", "c"]
+
+    def test_set(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_movable_list("l")
+        l.push("a", "b")
+        l.set(1, "B")
+        assert l.get_value() == ["a", "B"]
+
+    def test_concurrent_move_same_elem(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_movable_list("l").push("x", "y", "z")
+        sync(a, b)
+        a.get_movable_list("l").move(0, 2)
+        b.get_movable_list("l").move(0, 1)
+        sync(a, b)
+        va = a.get_movable_list("l").get_value()
+        vb = b.get_movable_list("l").get_value()
+        assert va == vb
+        assert sorted(va) == ["x", "y", "z"]  # element not duplicated
+
+    def test_concurrent_set_lww(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_movable_list("l").push("v")
+        sync(a, b)
+        a.get_movable_list("l").set(0, "A")
+        b.get_movable_list("l").set(0, "B")
+        sync(a, b)
+        assert a.get_movable_list("l").get_value() == b.get_movable_list("l").get_value()
+
+    def test_move_vs_delete(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_movable_list("l").push("x", "y")
+        sync(a, b)
+        a.get_movable_list("l").move(0, 1)
+        b.get_movable_list("l").delete(0, 1)
+        sync(a, b)
+        assert a.get_movable_list("l").get_value() == b.get_movable_list("l").get_value()
+
+
+class TestTree:
+    def test_create_move(self):
+        doc = LoroDoc(peer=1)
+        tree = doc.get_tree("t")
+        root = tree.create()
+        child = tree.create(root)
+        grand = tree.create(child)
+        assert tree.parent(grand) == child
+        tree.move(grand, root)
+        assert tree.parent(grand) == root
+        assert set(tree.children(root)) == {child, grand}
+
+    def test_cycle_rejected_locally_ok_remotely(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        ta = a.get_tree("t")
+        r1 = ta.create()
+        r2 = ta.create()
+        sync(a, b)
+        # concurrent: a moves r1 under r2; b moves r2 under r1
+        ta.move(r1, r2)
+        b.get_tree("t").move(r2, r1)
+        sync(a, b)
+        # both converge and no cycle exists
+        pa = {t: a.get_tree("t").parent(t) for t in (r1, r2)}
+        pb = {t: b.get_tree("t").parent(t) for t in (r1, r2)}
+        assert pa == pb
+        assert (pa[r1] == r2) != (pa[r2] == r1)  # exactly one move effected
+
+    def test_delete_subtree(self):
+        doc = LoroDoc(peer=1)
+        tree = doc.get_tree("t")
+        root = tree.create()
+        child = tree.create(root)
+        tree.delete(root)
+        assert not tree.contains(root) and not tree.contains(child)
+
+    def test_sibling_order(self):
+        doc = LoroDoc(peer=1)
+        tree = doc.get_tree("t")
+        root = tree.create()
+        c1 = tree.create(root)
+        c2 = tree.create(root)
+        c0 = tree.create(root, index=0)
+        assert tree.children(root) == [c0, c1, c2]
+
+    def test_meta(self):
+        doc = LoroDoc(peer=1)
+        tree = doc.get_tree("t")
+        n = tree.create()
+        tree.get_meta(n).set("name", "node1")
+        deep = doc.get_deep_value()["t"]
+        assert deep[0]["meta"] == {"name": "node1"}
+
+    def test_tree_sync(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        ta = a.get_tree("t")
+        root = ta.create()
+        sync(a, b)
+        ca = ta.create(root)
+        cb = b.get_tree("t").create(root)
+        sync(a, b)
+        assert a.get_tree("t").children(root) == b.get_tree("t").children(root)
+        assert set(a.get_tree("t").children(root)) == {ca, cb}
+
+
+class TestCounter:
+    def test_basic(self):
+        doc = LoroDoc(peer=1)
+        c = doc.get_counter("c")
+        c.increment(5)
+        c.decrement(2)
+        assert c.value == 3.0
+
+    def test_sync_sums(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_counter("c").increment(10)
+        b.get_counter("c").increment(5)
+        sync(a, b)
+        assert a.get_counter("c").value == b.get_counter("c").value == 15.0
+
+
+class TestImportExport:
+    def test_snapshot_roundtrip(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "hello")
+        a.get_map("m").set("k", [1, 2, {"x": True}])
+        blob = a.export_snapshot()
+        b = LoroDoc(peer=2)
+        b.import_(blob)
+        assert b.get_deep_value() == a.get_deep_value()
+
+    def test_updates_since(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "one")
+        b.import_(a.export_updates())
+        a.get_text("t").insert(3, " two")
+        delta = a.export_updates(b.oplog_vv())
+        b.import_(delta)
+        assert b.get_text("t").to_string() == "one two"
+
+    def test_import_idempotent(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "abc")
+        blob = a.export_updates()
+        b.import_(blob)
+        b.import_(blob)  # duplicate import is a no-op
+        assert b.get_text("t").to_string() == "abc"
+
+    def test_pending_out_of_order(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "first")
+        blob1 = a.export_updates()
+        vv1 = a.oplog_vv()
+        a.get_text("t").insert(5, " second")
+        blob2 = a.export_updates(vv1)
+        b = LoroDoc(peer=2)
+        status = b.import_(blob2)  # deps missing -> parked
+        assert status.pending is not None
+        assert b.get_text("t").to_string() == ""
+        status = b.import_(blob1)  # unlocks the parked changes
+        assert b.get_text("t").to_string() == "first second"
+
+    def test_bad_bytes_rejected(self):
+        import pytest
+        from loro_tpu import DecodeError
+
+        b = LoroDoc()
+        with pytest.raises(DecodeError):
+            b.import_(b"garbage")
+        with pytest.raises(DecodeError):
+            b.import_(b"LTPU\x01\x01\x00\x00\x00\x00{broken")
+
+    def test_json_updates_roundtrip(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "json")
+        a.get_tree("tr").create()
+        j = a.export_json_updates()
+        b = LoroDoc(peer=2)
+        b.import_json_updates(j)
+        assert b.get_deep_value() == a.get_deep_value()
+
+
+class TestVersions:
+    def test_frontiers_advance(self):
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "ab")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        assert len(f1) == 1
+        doc.get_text("t").insert(2, "c")
+        doc.commit()
+        assert doc.oplog_frontiers() != f1
+
+    def test_vv_frontiers_roundtrip(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "x")
+        sync(a, b)
+        b.get_text("t").insert(1, "y")
+        sync(a, b)
+        f = a.oplog_frontiers()
+        vv = a.frontiers_to_vv(f)
+        assert a.vv_to_frontiers(vv) == f
+
+
+class TestCheckout:
+    def test_time_travel(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "v1")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.insert(2, " v2")
+        doc.commit()
+        doc.checkout(f1)
+        assert doc.is_detached()
+        assert doc.get_text("t").to_string() == "v1"
+        doc.checkout_to_latest()
+        assert not doc.is_detached()
+        assert doc.get_text("t").to_string() == "v1 v2"
+
+    def test_edit_while_detached_raises(self):
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "x")
+        doc.commit()
+        f = doc.oplog_frontiers()
+        doc.get_text("t").insert(1, "y")
+        doc.commit()
+        doc.checkout(f)
+        with pytest.raises(LoroError):
+            doc.get_text("t").insert(0, "nope")
+
+    def test_import_while_detached(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "abc")
+        sync(a, b)
+        a.commit()
+        f = a.oplog_frontiers()
+        a.checkout(f)  # stay at current version but detached via flag
+        b.get_text("t").insert(3, "def")
+        a.import_(b.export_updates(a.oplog_vv()))
+        # state frozen while detached
+        assert a.get_text("t").to_string() == "abc"
+        a.checkout_to_latest()
+        assert a.get_text("t").to_string() == "abcdef"
+
+    def test_checkout_empty(self):
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "data")
+        doc.commit()
+        doc.checkout(Frontiers())
+        assert doc.get_text("t").to_string() == ""
+        doc.checkout_to_latest()
+        assert doc.get_text("t").to_string() == "data"
+
+
+class TestFork:
+    def test_fork(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "shared")
+        b = a.fork()
+        b.get_text("t").insert(6, " fork")
+        assert a.get_text("t").to_string() == "shared"
+        assert b.get_text("t").to_string() == "shared fork"
+
+    def test_fork_at(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "v1")
+        a.commit()
+        f1 = a.oplog_frontiers()
+        a.get_text("t").insert(2, "v2")
+        a.commit()
+        b = a.fork_at(f1)
+        assert b.get_text("t").to_string() == "v1"
+
+
+class TestEvents:
+    def test_local_event(self):
+        doc = LoroDoc(peer=1)
+        events = []
+        doc.subscribe_root(events.append)
+        doc.get_text("t").insert(0, "hi")
+        doc.commit()
+        assert len(events) == 1
+        ev = events[0]
+        assert ev.by.value == "local"
+        assert ev.diffs[0].path == ("t",)
+        assert ev.diffs[0].diff.to_json() == [{"insert": "hi"}]
+
+    def test_import_event(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "abc")
+        events = []
+        b.subscribe_root(events.append)
+        b.import_(a.export_updates())
+        assert len(events) == 1
+        assert events[0].by.value == "import"
+        assert events[0].diffs[0].diff.to_json() == [{"insert": "abc"}]
+
+    def test_container_scoped_subscription(self):
+        doc = LoroDoc(peer=1)
+        t_events, m_events = [], []
+        doc.subscribe(doc.get_text("t").id, t_events.append)
+        doc.subscribe(doc.get_map("m").id, m_events.append)
+        doc.get_text("t").insert(0, "x")
+        doc.commit()
+        assert len(t_events) == 1 and len(m_events) == 0
+        doc.get_map("m").set("k", 1)
+        doc.commit()
+        assert len(t_events) == 1 and len(m_events) == 1
+
+    def test_unsubscribe(self):
+        doc = LoroDoc(peer=1)
+        events = []
+        unsub = doc.subscribe_root(events.append)
+        doc.get_text("t").insert(0, "x")
+        doc.commit()
+        unsub()
+        doc.get_text("t").insert(1, "y")
+        doc.commit()
+        assert len(events) == 1
+
+    def test_local_update_subscription(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        blobs = []
+        a.subscribe_local_update(blobs.append)
+        a.get_text("t").insert(0, "realtime")
+        a.commit()
+        assert len(blobs) == 1
+        b.import_(blobs[0])
+        assert b.get_text("t").to_string() == "realtime"

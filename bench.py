@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""North-star benchmark: batched concurrent import of the automerge-perf
+trace across a fleet of documents (BASELINE.md config 3).
+
+Per doc, this performs the work of the reference's
+`OpLog::import -> DiffCalculator -> apply` replay of the full trace
+(reference harness: crates/loro-internal/benches/text_r.rs B4): resolve
+the final Fugue sequence order of every element (insert integration +
+tombstones) and materialize the visible document.  The fleet dimension
+is the TPU win: all documents merge in one XLA launch per chunk.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ops_merged_per_sec, "unit": ..., "vs_baseline": ...}
+
+Baseline denominator: single-threaded reference (Rust) B4 import
+throughput.  The reference repo publishes no numbers (BASELINE.md);
+Rust is not installed in this image, so we use 2.0e6 ops/s — an
+estimate on the generous side for loro's snapshot-import fast path on
+this trace (~130ms for 260k ops).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+RUST_SINGLE_THREAD_OPS_PER_SEC = 2.0e6  # see module docstring
+
+def main() -> None:
+    # bench runs on the real chip (ambient platform) by default; an
+    # explicit JAX_PLATFORMS env must win even though the axon plugin
+    # overrides it at the config level
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from loro_tpu.bench_utils import automerge_final_text, automerge_seq_extract
+    from loro_tpu.ops.columnar import chain_columns
+    from loro_tpu.ops.fugue_batch import (
+        ChainColumns,
+        chain_merge_docs,
+        chain_merge_docs_checksum,
+        pad_bucket,
+    )
+
+    docs_total = int(os.environ.get("BENCH_DOCS", "256"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "32"))
+    limit = os.environ.get("BENCH_TXN_LIMIT")
+    limit = int(limit) if limit else None
+
+    from loro_tpu.ops.columnar import contract_chains
+
+    ex, n_ops = automerge_seq_extract(limit=limit)
+    n_chains = contract_chains(ex).n_chains
+    cols1 = chain_columns(ex, pad_n=pad_bucket(ex.n), pad_c=pad_bucket(n_chains))
+
+    # broadcast one trace across the chunk's doc axis (each doc pays the
+    # full merge; contents identical — the kernel can't exploit that)
+    batched = ChainColumns(*[np.broadcast_to(a, (chunk,) + a.shape).copy() for a in cols1])
+    dev_cols = ChainColumns(*[jax.device_put(a) for a in batched])
+
+    # correctness: one doc's materialized text == ground truth
+    codes, counts = chain_merge_docs(dev_cols)
+    got = "".join(map(chr, np.asarray(codes[0])[: int(counts[0])]))
+    want = automerge_final_text(limit=limit)
+    assert got == want, f"device merge mismatch: {len(got)} vs {len(want)} chars"
+
+    # timed region: merge launches covering docs_total documents; merged
+    # state stays on device, only per-doc checksums return
+    n_chunks = max(1, docs_total // chunk)
+    warm = chain_merge_docs_checksum(dev_cols)
+    jax.block_until_ready(warm)
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n_chunks):
+        out = chain_merge_docs_checksum(dev_cols)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    docs_done = n_chunks * chunk
+    total_ops = docs_done * n_ops
+    ops_per_sec = total_ops / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ops_merged_per_sec_per_chip (automerge-perf trace, "
+                f"{docs_done}-doc concurrent import)",
+                "value": round(ops_per_sec),
+                "unit": "ops/s",
+                "vs_baseline": round(ops_per_sec / RUST_SINGLE_THREAD_OPS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

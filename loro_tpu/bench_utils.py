@@ -1,0 +1,90 @@
+"""Editing-trace loaders for benchmarks.
+
+Analog of the reference's bench-utils crate (crates/bench-utils/src/
+lib.rs:27-56 get_automerge_actions): loads the automerge-perf linear
+editing trace and converts it into the framework's op/element model.
+The extracted columnar element table is cached on disk because the
+conversion (running the host engine once to compute Fugue placements,
+i.e. the "source replica" role) is a one-time cost.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+TRACE_PATH = "/root/reference/crates/loro-internal/benches/automerge-paper.json.gz"
+CACHE_PATH = os.path.join(os.path.dirname(__file__), "..", ".bench_cache_automerge.npz")
+
+
+def load_automerge_patches(path: str = TRACE_PATH, limit: Optional[int] = None):
+    """[(pos, del_len, insert_str)] single-char patches + final content."""
+    with gzip.open(path) as f:
+        data = json.load(f)
+    patches: List[Tuple[int, int, str]] = []
+    for txn in data["txns"][:limit] if limit else data["txns"]:
+        for p in txn["patches"]:
+            patches.append((p[0], p[1], p[2]))
+    return patches, data.get("endContent", "")
+
+
+def automerge_seq_extract(limit: Optional[int] = None, use_cache: bool = True):
+    """SeqExtract of the full automerge trace (peer 1, linear history).
+    Applies the trace through the host engine once to derive each op's
+    Fugue (parent, side) placement, then explodes to columns."""
+    from .doc import LoroDoc
+    from .ops.columnar import SeqExtract, extract_seq_container
+
+    cache = CACHE_PATH if limit is None else None
+    if use_cache and cache and os.path.exists(cache):
+        z = np.load(cache)
+        return SeqExtract(
+            parent=z["parent"],
+            side=z["side"],
+            peer=z["peer"],
+            counter=z["counter"],
+            deleted=z["deleted"],
+            content=z["content"],
+            valid=z["valid"],
+            peers=[int(p) for p in z["peers"]],
+        ), int(z["n_ops"])
+
+    patches, _ = load_automerge_patches(limit=limit)
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("text")
+    for pos, dels, ins in patches:
+        if dels:
+            t.delete(pos, dels)
+        if ins:
+            t.insert(pos, ins)
+    doc.commit()
+    changes = doc.oplog.changes_in_causal_order()
+    ex = extract_seq_container(changes, t.id)
+    n_ops = len(patches)
+    if use_cache and cache:
+        np.savez_compressed(
+            cache,
+            parent=ex.parent,
+            side=ex.side,
+            peer=ex.peer,
+            counter=ex.counter,
+            deleted=ex.deleted,
+            content=ex.content,
+            valid=ex.valid,
+            peers=np.asarray(ex.peers, np.uint64),
+            n_ops=n_ops,
+        )
+    return ex, n_ops
+
+
+def automerge_final_text(limit: Optional[int] = None) -> str:
+    """Ground-truth final text by direct patch application."""
+    patches, end = load_automerge_patches(limit=limit)
+    buf: List[str] = []
+    s = ""
+    for pos, dels, ins in patches:
+        s = s[:pos] + ins + s[pos + dels :]
+    return s

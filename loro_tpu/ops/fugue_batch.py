@@ -1,0 +1,319 @@
+"""Batched Fugue sequence-order kernel.
+
+The device-side merge engine for Text/List/MovableList — the TPU
+reformulation of the reference's tracker replay
+(crates/loro-internal/src/container/richtext/tracker/crdt_rope.rs
+Fugue integration + tracker.rs diff extraction).
+
+Because our wire format ships each insert's Fugue tree placement
+`(parent, side)` (see core/change.py), integrating a batch of inserts
+needs no sequential origin-scan.  The final sequence order is the
+in-order traversal of the Fugue tree with siblings sorted by
+(peer, counter).  We compute it fully in parallel:
+
+1. lexsort elements by (parent, side, peer, counter) -> sibling groups
+2. build the Euler-tour successor ring over 3 tokens per node
+   (ENTER / VISIT / EXIT; VISIT sits between the L- and R-children
+   blocks, giving in-order positions)
+3. Wyllie pointer-doubling list ranking (ceil(log2(3N)) gather rounds)
+4. element order = rank of its VISIT token
+
+Work O(N log N), depth O(log N), all gathers/sorts — ideal XLA/TPU
+shapes.  `vmap` batches the whole thing across documents; the fleet
+layer (parallel/fleet.py) shards the doc axis over the device mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SeqColumns(NamedTuple):
+    """Columnar element table for one document (padded to fixed N).
+
+    parent: i32[N]  index of fugue parent element; -1 = virtual root
+    side:   i32[N]  0 = Left child, 1 = Right child
+    peer:   i32[N]  peer *rank* in the batch peer dictionary (order-
+                    preserving w.r.t. u64 peer ids -> sibling order
+                    matches the host engine)
+    counter:i32[N]
+    deleted:bool[N] tombstone flag
+    content:i32[N]  codepoint / value-dictionary index
+    valid:  bool[N] False for padding rows
+    """
+
+    parent: jax.Array
+    side: jax.Array
+    peer: jax.Array
+    counter: jax.Array
+    deleted: jax.Array
+    content: jax.Array
+    valid: jax.Array
+
+
+def _token_ids(n: int) -> Tuple[int, int, int, int]:
+    """Token index layout: ENTER(e)=e, VISIT(e)=N1+e, EXIT(e)=2*N1+e,
+    where N1=n+1 (element n is the virtual root)."""
+    n1 = n + 1
+    return n1, 0, n1, 2 * n1
+
+
+def fugue_order(cols: SeqColumns) -> jax.Array:
+    """Return rank i32[N]: a key whose ascending order is the in-order
+    position of each element in the Fugue traversal (keys may have gaps;
+    pads get large keys).
+
+    CONTRACT: rows must be pre-sorted by (peer, counter) — which the
+    host extraction produces for free as per-peer concatenation, no
+    comparison sort (SeqExtract.sort_by_peer_counter).  Sibling order is
+    then one *stable* single-key sort by packed (parent, side), the only
+    sort in the whole kernel."""
+    return _order_core(cols.parent, cols.side, cols.valid)
+
+
+def _order_core(parent_in: jax.Array, side_in: jax.Array, valid_in: jax.Array) -> jax.Array:
+    """Euler-tour in-order ranking over generic node arrays (element- or
+    chain-level).  Input contract as in fugue_order."""
+    n = parent_in.shape[0]
+    n1 = n + 1
+    root = n  # virtual root element index
+    big = jnp.int32(2**30)
+
+    # -- extended element arrays incl. virtual root -------------------
+    parent = jnp.concatenate([jnp.where(valid_in, parent_in, big), jnp.array([big], jnp.int32)])
+    parent = parent.at[:n].set(jnp.where(valid_in & (parent_in < 0), root, parent[:n]))
+    side = jnp.concatenate([side_in.astype(jnp.int32), jnp.array([1], jnp.int32)])
+    valid = jnp.concatenate([valid_in, jnp.array([False])])  # root not a child
+
+    # -- sibling groups: ONE stable sort by (parent, side); (peer,
+    # counter) order within groups comes from the input contract -------
+    key = jnp.where(parent < big, parent * 2 + side, big)
+    order = jnp.argsort(key, stable=True).astype(jnp.int32)
+    p_s = parent[order]
+    s_s = side[order]
+    prev_same = (p_s == jnp.roll(p_s, 1)) & (s_s == jnp.roll(s_s, 1))
+    prev_same = prev_same.at[0].set(False)
+    is_first = ~prev_same
+    nxt_same = (p_s == jnp.roll(p_s, -1)) & (s_s == jnp.roll(s_s, -1))
+    nxt_same = nxt_same.at[-1].set(False)
+    elem_s = order  # element index at each sorted slot
+    next_sib_s = jnp.where(nxt_same, jnp.roll(elem_s, -1), -1)
+
+    # scatter: per element, its next sibling; per (parent, side): first child
+    next_sib = jnp.zeros(n1, jnp.int32).at[elem_s].set(next_sib_s.astype(jnp.int32))
+    is_child = p_s < big  # this sorted slot is a real child row
+    tgt_l = jnp.where(is_first & is_child & (s_s == 0), p_s, n1)  # n1 = dump slot
+    tgt_r = jnp.where(is_first & is_child & (s_s == 1), p_s, n1)
+    first_l = jnp.full(n1 + 1, -1, jnp.int32).at[tgt_l].set(elem_s.astype(jnp.int32))[:n1]
+    first_r = jnp.full(n1 + 1, -1, jnp.int32).at[tgt_r].set(elem_s.astype(jnp.int32))[:n1]
+
+    has_next_sib = next_sib >= 0
+    has_l = first_l >= 0
+    has_r = first_r >= 0
+
+    # -- Euler-tour successor ring over tokens ------------------------
+    # ENTER(e) -> ENTER(first_l[e])         if has_l else VISIT(e)
+    # VISIT(e) -> ENTER(first_r[e])         if has_r else EXIT(e)
+    # EXIT(e)  -> ENTER(next_sib[e])        if has_next_sib
+    #          -> VISIT(parent[e])          if last sibling and side==L
+    #          -> EXIT(parent[e])           if last sibling and side==R
+    # EXIT(root) -> itself (ring terminal)
+    _, ENTER0, VISIT0, EXIT0 = 0, 0, n1, 2 * n1
+    m = 3 * n1
+    e_ids = jnp.arange(n1, dtype=jnp.int32)
+    succ_enter = jnp.where(has_l, ENTER0 + first_l, VISIT0 + e_ids)
+    succ_visit = jnp.where(has_r, ENTER0 + first_r, EXIT0 + e_ids)
+    par = jnp.where(parent < big, parent, root).astype(jnp.int32)
+    succ_exit = jnp.where(
+        has_next_sib,
+        ENTER0 + next_sib,
+        jnp.where(side == 0, VISIT0 + par, EXIT0 + par),
+    )
+    succ_exit = succ_exit.at[root].set(EXIT0 + root)  # terminal self-loop
+    succ = jnp.concatenate([succ_enter, succ_visit, succ_exit]).astype(jnp.int32)
+
+    # invalid elements: make their tokens tight self-loops so they don't
+    # perturb the ring (they are unreachable from the root anyway)
+    tok_valid = jnp.concatenate([valid, valid, valid])
+    tok_ids = jnp.arange(m, dtype=jnp.int32)
+    succ = jnp.where(tok_valid | (tok_ids == EXIT0 + root), succ, tok_ids)
+    # root ENTER/VISIT are valid ring members:
+    succ = succ.at[ENTER0 + root].set(jnp.where(has_l[root], ENTER0 + first_l[root], VISIT0 + root))
+    succ = succ.at[VISIT0 + root].set(jnp.where(has_r[root], ENTER0 + first_r[root], EXIT0 + root))
+
+    # -- Wyllie list ranking: distance to terminal --------------------
+    dist = jnp.where(succ == tok_ids, 0, 1).astype(jnp.int32)
+    n_steps = max(1, int(np.ceil(np.log2(max(m, 2)))))
+
+    def body(_, carry):
+        d, s = carry
+        return d + d[s], s[s]
+
+    dist, _ = jax.lax.fori_loop(0, n_steps, body, (dist, succ))
+    # in-order position: larger distance-to-end = earlier
+    visit_dist = dist[VISIT0 : VISIT0 + n1]
+    rank = visit_dist[root] - visit_dist[:n]  # monotone along the traversal
+    # pads / unreachable: push to the end
+    rank = jnp.where(valid_in, rank, big)
+    return rank.astype(jnp.int32)
+
+
+def _visit_dist(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """(dist i32[N], m): distance of each element's VISIT token to the
+    ring terminal (strictly decreasing along the traversal) and the ring
+    size m = 3*(N+1).  Shared plumbing for rank/compaction."""
+    rank = fugue_order(cols)
+    return rank, jnp.int32(3 * (cols.parent.shape[0] + 1))
+
+
+def visible_order(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """(perm, visible_count): perm[i] = element index of the i-th element
+    in final order, with visible elements first in document order; count
+    of visible elements."""
+    rank = fugue_order(cols)
+    visible = cols.valid & ~cols.deleted
+    big = jnp.int32(2**30)
+    key = jnp.where(visible, rank, big)  # visible first (stable argsort)
+    perm = jnp.argsort(key, stable=True)
+    return perm.astype(jnp.int32), visible.sum().astype(jnp.int32)
+
+
+def materialize_content(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """Gather content codes of visible elements in document order.
+    Returns (codes i32[N] with tail padding = -1, count).
+
+    Sort-free compaction: ranks are unique values < m = 3*(N+1), so a
+    scatter into an m-bucket histogram + exclusive cumsum yields each
+    visible element's final position directly."""
+    n = cols.parent.shape[0]
+    rank, _ = _visit_dist(cols)
+    m = 3 * (n + 1)
+    visible = cols.valid & ~cols.deleted
+    rk = jnp.clip(rank, 0, m - 1)
+    hist = jnp.zeros(m, jnp.int32).at[jnp.where(visible, rk, m - 1)].add(
+        visible.astype(jnp.int32)
+    )
+    pos_of_rank = jnp.cumsum(hist) - hist  # exclusive prefix sum
+    pos = pos_of_rank[rk]
+    count = visible.sum().astype(jnp.int32)
+    # invisible rows target index n -> dropped (no collisions)
+    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
+        cols.content, mode="drop"
+    )
+    return codes, count
+
+
+class ChainColumns(NamedTuple):
+    """Chain-contracted document batch (see columnar.contract_chains):
+    chain-level tree arrays [C] + element-level arrays [N]."""
+
+    c_parent: jax.Array  # i32[C]
+    c_side: jax.Array  # i32[C]
+    c_valid: jax.Array  # bool[C]
+    head_row: jax.Array  # i32[C]
+    chain_id: jax.Array  # i32[N] element -> chain
+    deleted: jax.Array  # bool[N]
+    content: jax.Array  # i32[N]
+    valid: jax.Array  # bool[N]
+
+
+def chain_materialize(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
+    """Merge via chain contraction: rank C chains (C << N), then place
+    all N elements with pure vector ops (segment sums / cumsum / one
+    gather) — the gather-heavy ranking runs on the contracted tree only.
+    Returns (codes i32[N] padded with -1, visible count)."""
+    c = cols.c_parent.shape[0]
+    n = cols.chain_id.shape[0]
+    crank = _order_core(cols.c_parent, cols.c_side, cols.c_valid)  # i32[C]
+    m = 3 * (c + 1)
+    visible = cols.valid & ~cols.deleted
+    vis_i = visible.astype(jnp.int32)
+
+    # visible width per chain (chains are contiguous row ranges)
+    cid = jnp.where(cols.valid, cols.chain_id, c)  # pads -> dump chain
+    w = jnp.zeros(c + 1, jnp.int32).at[cid].add(vis_i)[:c]
+
+    # base position of each chain = total visible width of chains with
+    # smaller rank: histogram of widths by rank + exclusive cumsum
+    rk = jnp.clip(crank, 0, m - 1)
+    hist = jnp.zeros(m, jnp.int32).at[jnp.where(cols.c_valid, rk, m - 1)].add(
+        jnp.where(cols.c_valid, w, 0)
+    )
+    base_of_rank = jnp.cumsum(hist) - hist
+    base = base_of_rank[rk]  # i32[C]
+
+    # within-chain visible prefix: global exclusive cumsum minus the
+    # chain head's value (rows of a chain are contiguous)
+    row_excl = jnp.cumsum(vis_i) - vis_i
+    head_excl = row_excl[jnp.clip(cols.head_row, 0, n - 1)]  # i32[C]
+    within = row_excl - head_excl[jnp.clip(cols.chain_id, 0, c - 1)]
+
+    pos = base[jnp.clip(cols.chain_id, 0, c - 1)] + within
+    count = vis_i.sum().astype(jnp.int32)
+    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
+        cols.content, mode="drop"
+    )
+    return codes, count
+
+
+chain_materialize_batch = jax.vmap(chain_materialize)
+
+
+@jax.jit
+def chain_merge_docs(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
+    """One launch: chain-contracted merge for a doc batch ([D,C]/[D,N])."""
+    return chain_materialize_batch(cols)
+
+
+@jax.jit
+def chain_merge_docs_checksum(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
+    codes, counts = chain_materialize_batch(cols)
+    n = codes.shape[1]
+    wgt = (jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)) % jnp.uint32(1 << 30)
+    cs = ((jnp.where(codes >= 0, codes, 0).astype(jnp.uint32) * wgt[None, :]) % (1 << 30)).sum(
+        axis=1, dtype=jnp.uint32
+    )
+    return cs, counts
+
+
+# batched-over-documents variants --------------------------------------
+fugue_order_batch = jax.vmap(fugue_order)
+visible_order_batch = jax.vmap(visible_order)
+materialize_content_batch = jax.vmap(materialize_content)
+
+# jitted single-doc entry (one compilation per padded size — callers
+# should bucket-pad N, e.g. to powers of two)
+materialize_content_jit = jax.jit(materialize_content)
+
+
+def pad_bucket(n: int, floor: int = 64) -> int:
+    """Next power-of-two bucket ≥ n (bounds XLA recompilations)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def merge_docs(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """One XLA launch: resolve order + materialize visible content for a
+    whole batch of documents.  cols arrays are [D, N]."""
+    return materialize_content_batch(cols)
+
+
+@jax.jit
+def merge_docs_checksum(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """Merge but return only a per-doc order-sensitive checksum [D] +
+    counts [D].  Used by benchmarks: the merged state stays device-
+    resident (the fleet model); only O(D) scalars cross the host link."""
+    codes, counts = materialize_content_batch(cols)
+    n = codes.shape[1]
+    w = (jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)) % jnp.uint32(1 << 30)
+    cs = ((jnp.where(codes >= 0, codes, 0).astype(jnp.uint32) * w[None, :]) % (1 << 30)).sum(
+        axis=1, dtype=jnp.uint32
+    )
+    return cs, counts

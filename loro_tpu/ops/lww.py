@@ -1,0 +1,67 @@
+"""Batched LWW-map merge + counter-sum kernels.
+
+The device equivalents of MapDiffCalculator (reference diff_calc.rs:
+515-538: keep max (lamport, peer) per key) and CounterState.  A whole
+batch of documents' map ops merges in one launch: three scatter-max
+passes over (doc, slot) cells — no sorting, no host loop.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG = jnp.int32(-(2**31) + 1)
+
+
+class MapOpCols(NamedTuple):
+    """[D, M] per-doc padded op columns (see columnar.MapExtract)."""
+
+    slot: jax.Array  # i32 (doc-local slot id in [0, S))
+    lamport: jax.Array
+    peer: jax.Array
+    value_idx: jax.Array
+    valid: jax.Array  # bool
+
+
+def lww_merge_doc(cols: MapOpCols, n_slots: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-doc LWW: winner per slot.
+
+    Returns (value_idx i32[S] (-2 = slot untouched, -1 = deleted),
+    win_lamport i32[S], win_peer i32[S])."""
+    slot = jnp.where(cols.valid, cols.slot, n_slots)  # pads -> dump slot
+    # pass 1: max lamport per slot
+    lam = jnp.where(cols.valid, cols.lamport, NEG)
+    win_lam = jnp.full(n_slots + 1, NEG, jnp.int32).at[slot].max(lam)
+    # pass 2: among max-lamport ops, max peer
+    at_max = cols.valid & (cols.lamport == win_lam[slot])
+    peer = jnp.where(at_max, cols.peer, NEG)
+    win_peer = jnp.full(n_slots + 1, NEG, jnp.int32).at[slot].max(peer)
+    # pass 3: the unique winner's value (op ids are unique per
+    # (slot, lamport, peer), so exactly one op matches)
+    is_win = at_max & (cols.peer == win_peer[slot])
+    val = jnp.where(is_win, cols.value_idx, NEG)
+    win_val = jnp.full(n_slots + 1, NEG, jnp.int32).at[slot].max(val)
+    untouched = win_lam[:n_slots] == NEG
+    value_idx = jnp.where(untouched, -2, win_val[:n_slots])
+    return value_idx, win_lam[:n_slots], win_peer[:n_slots]
+
+
+def counter_merge_doc(slot: jax.Array, delta: jax.Array, valid: jax.Array, n_slots: int) -> jax.Array:
+    """Sum deltas per (doc-local) counter slot: f32[S]."""
+    s = jnp.where(valid, slot, n_slots)
+    d = jnp.where(valid, delta, 0.0)
+    return jnp.zeros(n_slots + 1, jnp.float32).at[s].add(d)[:n_slots]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def lww_merge_batch(cols: MapOpCols, n_slots: int):
+    """[D, M] op columns -> per-doc winners [D, S] in one launch."""
+    return jax.vmap(lambda c: lww_merge_doc(c, n_slots))(cols)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def counter_merge_batch(slot, delta, valid, n_slots: int):
+    return jax.vmap(lambda s, d, v: counter_merge_doc(s, d, v, n_slots))(slot, delta, valid)

@@ -1,0 +1,47 @@
+"""Device mesh + sharding layout for fleet merges.
+
+The distributed axis of a CRDT fleet is document-batch parallelism
+(SURVEY.md §2.4): docs are independent, so the mesh shards the doc axis
+("docs") across chips over ICI and across hosts over DCN.  A second
+axis ("ops") is available for intra-doc parallelism of very large
+imports (sharded sorts/scans); by default it is size 1 — XLA's sorts
+already saturate a chip for the op counts a single doc produces.
+
+No NCCL/MPI analog is needed: merges are embarrassingly parallel per
+doc; the only collectives are the result gathers XLA inserts when the
+caller asks for replicated output.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DOC_AXIS = "docs"
+OP_AXIS = "ops"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None, op_parallel: int = 1) -> Mesh:
+    """1D (docs) or 2D (docs, ops) mesh over the given devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    assert n % op_parallel == 0, f"{n} devices not divisible by op_parallel={op_parallel}"
+    arr = np.array(devices).reshape(n // op_parallel, op_parallel)
+    return Mesh(arr, (DOC_AXIS, OP_AXIS))
+
+
+def doc_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard leading (doc) axis; replicate the rest."""
+    return NamedSharding(mesh, P(DOC_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_doc_batch(mesh: Mesh, tree):
+    """Place a pytree of [D, ...] arrays with the doc axis sharded."""
+    sh = doc_sharding(mesh)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)

@@ -1,9 +1,16 @@
 """Test configuration: force an 8-device virtual CPU mesh so sharding
 tests run without TPU hardware (the driver separately dry-runs the
-multi-chip path)."""
+multi-chip path).
+
+Note: the ambient axon TPU plugin overrides JAX_PLATFORMS by writing
+the jax_platforms *config* ("axon,cpu"), so env vars alone don't stick
+— we must update the config before the backend initializes."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

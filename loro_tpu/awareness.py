@@ -1,0 +1,185 @@
+"""Presence: Awareness + EphemeralStore.
+
+reference: crates/loro-internal/src/awareness.rs — non-persistent
+peer-presence state outside the CRDT history: `Awareness` maps peer ->
+(state value, counter, timestamp); `EphemeralStore` is a key->value LWW
+store by wall-clock timestamp with inactivity expiry and its own little
+wire format + local/remote subscriptions.
+"""
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .core.ids import PeerID
+
+
+@dataclass
+class PeerInfo:
+    state: Any
+    counter: int
+    timestamp: float
+
+
+class Awareness:
+    def __init__(self, peer: PeerID, timeout_s: float = 30.0):
+        self.peer = peer
+        self.timeout_s = timeout_s
+        self.peers: Dict[PeerID, PeerInfo] = {}
+
+    def set_local_state(self, state: Any) -> None:
+        cur = self.peers.get(self.peer)
+        counter = (cur.counter + 1) if cur else 1
+        self.peers[self.peer] = PeerInfo(state, counter, time.time())
+
+    def get_local_state(self) -> Any:
+        info = self.peers.get(self.peer)
+        return info.state if info else None
+
+    def encode(self, peers: Optional[List[PeerID]] = None) -> bytes:
+        now = time.time()
+        out = []
+        for p, info in self.peers.items():
+            if peers is not None and p not in peers:
+                continue
+            out.append({"peer": str(p), "state": info.state, "counter": info.counter})
+        return json.dumps(out).encode()
+
+    def encode_all(self) -> bytes:
+        return self.encode()
+
+    def apply(self, data: bytes) -> Tuple[List[PeerID], List[PeerID]]:
+        """Returns (updated peers, added peers)."""
+        updated, added = [], []
+        now = time.time()
+        for entry in json.loads(data.decode()):
+            p = int(entry["peer"])
+            counter = entry["counter"]
+            cur = self.peers.get(p)
+            if cur is None:
+                self.peers[p] = PeerInfo(entry["state"], counter, now)
+                added.append(p)
+            elif counter > cur.counter:
+                self.peers[p] = PeerInfo(entry["state"], counter, now)
+                updated.append(p)
+        return updated, added
+
+    def remove_outdated(self) -> List[PeerID]:
+        now = time.time()
+        dead = [p for p, i in self.peers.items() if now - i.timestamp > self.timeout_s]
+        for p in dead:
+            del self.peers[p]
+        return dead
+
+    def get_all_states(self) -> Dict[PeerID, Any]:
+        return {p: i.state for p, i in self.peers.items()}
+
+
+@dataclass
+class _Entry:
+    value: Any
+    timestamp: float
+    deleted: bool = False
+
+
+class EphemeralStore:
+    """key -> LWW-by-timestamp value with inactivity expiry.
+    reference: awareness.rs:250+ EphemeralStore."""
+
+    def __init__(self, timeout_ms: int = 30_000):
+        self.timeout_ms = timeout_ms
+        self._data: Dict[str, _Entry] = {}
+        self._local_subs: List[Callable[[bytes], None]] = []
+        self._subs: List[Callable[[dict], None]] = []
+
+    # -- local mutation -----------------------------------------------
+    def set(self, key: str, value: Any) -> None:
+        self._data[key] = _Entry(value, time.time() * 1000)
+        self._emit_local([key])
+        self._emit({"by": "local", "added": [], "updated": [key], "removed": []})
+
+    def delete(self, key: str) -> None:
+        if key in self._data:
+            self._data[key] = _Entry(None, time.time() * 1000, deleted=True)
+            self._emit_local([key])
+            self._emit({"by": "local", "added": [], "updated": [], "removed": [key]})
+
+    def get(self, key: str) -> Any:
+        e = self._data.get(key)
+        return None if e is None or e.deleted else e.value
+
+    def keys(self) -> List[str]:
+        self.remove_outdated()
+        return sorted(k for k, e in self._data.items() if not e.deleted)
+
+    def get_all_states(self) -> Dict[str, Any]:
+        self.remove_outdated()
+        return {k: e.value for k, e in self._data.items() if not e.deleted}
+
+    # -- wire ---------------------------------------------------------
+    def encode(self, key: Optional[str] = None) -> bytes:
+        items = []
+        for k, e in self._data.items():
+            if key is not None and k != key:
+                continue
+            items.append({"k": k, "v": e.value, "t": e.timestamp, "d": e.deleted})
+        return json.dumps(items).encode()
+
+    def encode_all(self) -> bytes:
+        return self.encode()
+
+    def apply(self, data: bytes) -> None:
+        added, updated, removed = [], [], []
+        for it in json.loads(data.decode()):
+            k = it["k"]
+            cur = self._data.get(k)
+            if cur is None or it["t"] > cur.timestamp:
+                existed = cur is not None and not cur.deleted
+                self._data[k] = _Entry(it["v"], it["t"], it.get("d", False))
+                if it.get("d", False):
+                    if existed:
+                        removed.append(k)
+                elif existed:
+                    updated.append(k)
+                else:
+                    added.append(k)
+        if added or updated or removed:
+            self._emit({"by": "import", "added": added, "updated": updated, "removed": removed})
+
+    def remove_outdated(self) -> List[str]:
+        now = time.time() * 1000
+        dead = [k for k, e in self._data.items() if now - e.timestamp > self.timeout_ms]
+        removed = []
+        for k in dead:
+            if not self._data[k].deleted:
+                removed.append(k)
+            del self._data[k]
+        if removed:
+            self._emit({"by": "timeout", "added": [], "updated": [], "removed": removed})
+        return removed
+
+    # -- subscriptions ------------------------------------------------
+    def subscribe_local_update(self, cb: Callable[[bytes], None]) -> Callable[[], None]:
+        self._local_subs.append(cb)
+        return lambda: self._local_subs.remove(cb)
+
+    def subscribe(self, cb: Callable[[dict], None]) -> Callable[[], None]:
+        self._subs.append(cb)
+        return lambda: self._subs.remove(cb)
+
+    def _emit_local(self, keys: List[str]) -> None:
+        if self._local_subs:
+            payload = json.dumps(
+                [
+                    {"k": k, "v": self._data[k].value, "t": self._data[k].timestamp, "d": self._data[k].deleted}
+                    for k in keys
+                ]
+            ).encode()
+            for cb in self._local_subs:
+                cb(payload)
+
+    def _emit(self, ev: dict) -> None:
+        for cb in list(self._subs):
+            cb(ev)

@@ -249,18 +249,20 @@ class LoroDoc:
         self.commit()
         if mode is None or isinstance(mode, ExportMode.Snapshot) or mode is ExportMode.Snapshot:
             return self._encode_changes(
-                self.oplog.changes_in_causal_order(), EncodeMode.JsonSnapshot
+                self.oplog.changes_in_causal_order(), EncodeMode.ColumnarSnapshot
             )
         if isinstance(mode, ExportMode.Updates):
             vv = mode.from_vv or VersionVector()
-            return self._encode_changes(self.oplog.changes_since(vv), EncodeMode.JsonUpdates, vv)
+            return self._encode_changes(
+                self.oplog.changes_since(vv), EncodeMode.ColumnarUpdates, vv
+            )
         if isinstance(mode, ExportMode.UpdatesInRange):
             chs = self.oplog.changes_between(mode.from_vv, mode.to_vv)
-            return self._encode_changes(chs, EncodeMode.JsonUpdates, mode.from_vv)
+            return self._encode_changes(chs, EncodeMode.ColumnarUpdates, mode.from_vv)
         if isinstance(mode, ExportMode.SnapshotAt):
             to_vv = self.oplog.dag.frontiers_to_vv(mode.frontiers)
             chs = self.oplog.changes_between(VersionVector(), to_vv)
-            return self._encode_changes(chs, EncodeMode.JsonSnapshot)
+            return self._encode_changes(chs, EncodeMode.ColumnarSnapshot)
         raise LoroError(f"unsupported export mode {mode}")
 
     def export_snapshot(self) -> bytes:
@@ -272,9 +274,16 @@ class LoroDoc:
     def _encode_changes(
         self, changes: List[Change], mode: EncodeMode, start_vv: Optional[VersionVector] = None
     ) -> bytes:
-        payload = jcodec.dumps(
-            jcodec.export_json_updates(changes, start_vv or VersionVector(), self.oplog.vv.copy())
-        )
+        if mode in (EncodeMode.ColumnarUpdates, EncodeMode.ColumnarSnapshot):
+            from .codec import binary as bcodec
+
+            payload = bcodec.encode_changes(changes)
+        else:
+            payload = jcodec.dumps(
+                jcodec.export_json_updates(
+                    changes, start_vv or VersionVector(), self.oplog.vv.copy()
+                )
+            )
         crc = zlib.crc32(payload)
         header = MAGIC + bytes([FORMAT_VERSION, mode.value]) + crc.to_bytes(4, "little")
         return header + payload
@@ -408,58 +417,94 @@ class LoroDoc:
         return {cid: st.get_value() for cid, st in self.state.states.items()}
 
     def _value_level_diffs(self, old_values: Dict[ContainerID, Any]) -> Dict[ContainerID, List]:
-        """Value-level diffs for checkout events (exact for map/counter,
-        positional for sequences via difflib).  TODO(round2): replay-based
-        exact deltas like the reference's persistent DiffCalculator."""
-        import difflib
+        """Value-level diffs for checkout events (exact for map/counter/
+        tree, positional for sequences via difflib).  TODO(round2):
+        replay-based exact deltas like the reference's persistent
+        DiffCalculator."""
+        new_values = self._container_values()
+        batch = _diff_values(old_values, new_values, self.state)
+        return {cid: [d] for cid, d in batch.items()}
 
-        out: Dict[ContainerID, List] = {}
-        all_cids = set(old_values) | set(self.state.states)
-        for cid in all_cids:
-            old_v = old_values.get(cid)
-            st = self.state.states.get(cid)
-            new_v = st.get_value() if st else None
-            if old_v == new_v:
-                continue
-            if cid.ctype == ContainerType.Map:
-                d = MapDiff()
-                old_m = old_v or {}
-                new_m = new_v or {}
-                for k in new_m:
-                    if old_m.get(k) != new_m[k] or k not in old_m:
-                        d.updated[k] = new_m[k]
-                for k in old_m:
-                    if k not in new_m:
-                        d.deleted.add(k)
-                out[cid] = [d]
-            elif cid.ctype == ContainerType.Counter:
-                from .event import CounterDiff
+    # ------------------------------------------------------------------
+    # version diff / apply (reference: loro.rs:1244 diff, loro.rs:1302
+    # apply_diff, loro.rs:1232 revert_to)
+    # ------------------------------------------------------------------
+    def _state_at(self, frontiers: Frontiers) -> DocState:
+        """Materialize a throwaway DocState at an arbitrary version by
+        causal replay (the reference reaches the same states via its
+        persistent Checkout DiffCalculator)."""
+        vv = self.oplog.dag.frontiers_to_vv(frontiers)
+        st = DocState()
+        st.apply_changes(self.oplog.changes_between(VersionVector(), vv), record=False)
+        st.vv = vv
+        st.frontiers = frontiers
+        return st
 
-                out[cid] = [CounterDiff((new_v or 0.0) - (old_v or 0.0))]
-            elif cid.ctype == ContainerType.Text:
-                old_s, new_s = old_v or "", new_v or ""
-                delta = Delta()
-                sm = difflib.SequenceMatcher(a=old_s, b=new_s, autojunk=False)
-                for tag, i1, i2, j1, j2 in sm.get_opcodes():
-                    if tag == "equal":
-                        delta.retain(i2 - i1)
+    def diff(self, a: Frontiers, b: Frontiers) -> Dict[ContainerID, Any]:
+        """DiffBatch turning state(a) into state(b) (value-level).
+        Endpoints equal to the live state reuse it instead of replaying
+        the full history."""
+        sa = self.state if a == self.state.frontiers else self._state_at(a)
+        sb = self.state if b == self.state.frontiers else self._state_at(b)
+        return _state_diff(sa, sb)
+
+    def apply_diff(self, batch: Dict[ContainerID, Any], origin: str = "apply_diff") -> None:
+        """Apply a DiffBatch as new local ops."""
+        from .core.change import TreeMove
+        from .event import CounterDiff as _CD
+        from .event import Delta as _Delta
+        from .event import MapDiff as _MD
+        from .event import TreeDiff as _TD
+        from .event import TreeDiffAction as _TDA
+        from .event import Insert as _Ins
+        from .event import Retain as _Ret
+
+        for cid, d in batch.items():
+            h = self.get_container(cid)
+            if isinstance(d, _MD):
+                for k, v in d.updated.items():
+                    h.set(k, v)  # type: ignore[attr-defined]
+                for k in d.deleted:
+                    h.delete(k)  # type: ignore[attr-defined]
+            elif isinstance(d, _CD):
+                if d.delta:
+                    h.increment(d.delta)  # type: ignore[attr-defined]
+            elif isinstance(d, _Delta):
+                pos = 0
+                for it in d.items:
+                    if isinstance(it, _Ret):
+                        pos += it.n
+                    elif isinstance(it, _Ins):
+                        if isinstance(it.value, str):
+                            h.insert(pos, it.value)  # type: ignore[call-arg]
+                        else:
+                            h.insert(pos, *it.value)  # type: ignore[call-arg]
+                        pos += len(it.value)
                     else:
-                        if tag in ("replace", "delete"):
-                            delta.delete(i2 - i1)
-                        if tag in ("replace", "insert"):
-                            delta.insert(new_s[j1:j2])
-                out[cid] = [delta.chop()]
-            elif cid.ctype in (ContainerType.List, ContainerType.MovableList):
-                delta = Delta()
-                old_l, new_l = old_v or [], new_v or []
-                delta.delete(len(old_l))
-                delta.insert(tuple(new_l))
-                out[cid] = [delta.chop()]
-            elif cid.ctype == ContainerType.Tree:
-                if st is not None:
-                    td = st.to_diff()
-                    out[cid] = [td]
-        return out
+                        h.delete(pos, it.n)  # type: ignore[attr-defined]
+            elif isinstance(d, _TD):
+                for item in d.items:
+                    try:
+                        if item.action == _TDA.Delete:
+                            h.delete(item.target)  # type: ignore[attr-defined]
+                        elif item.action == _TDA.Create:
+                            if not h.contains(item.target):  # type: ignore[attr-defined]
+                                # re-creating a node keeps its identity: a
+                                # move op revives it under the same TreeID
+                                self._txn_apply(
+                                    cid, TreeMove(item.target, item.parent, item.position)
+                                )
+                        else:
+                            h.move(item.target, item.parent, item.index)  # type: ignore[attr-defined]
+                    except (ValueError, LoroError):
+                        continue  # target vanished concurrently
+        self.commit(origin=origin)
+
+    def revert_to(self, frontiers: Frontiers) -> None:
+        """Generate new ops returning the doc to `frontiers`' state."""
+        self.commit()
+        batch = self.diff(self.oplog.frontiers, frontiers)
+        self.apply_diff(batch, origin="revert")
 
     # ------------------------------------------------------------------
     # fork
@@ -489,3 +534,103 @@ class LoroDoc:
 
     def __len__(self) -> int:
         return len(self.state.states)
+
+
+def _state_diff(sa: DocState, sb: DocState) -> Dict[ContainerID, Any]:
+    """Value-level DiffBatch turning sa's values into sb's."""
+    va = {cid: st.get_value() for cid, st in sa.states.items()}
+    vb = {cid: st.get_value() for cid, st in sb.states.items()}
+    return _diff_values(va, vb, sb)
+
+
+def _list_delta(old_l: List[Any], new_l: List[Any]) -> Delta:
+    import difflib
+
+    ka = [repr(x) for x in old_l]
+    kb = [repr(x) for x in new_l]
+    delta = Delta()
+    sm = difflib.SequenceMatcher(a=ka, b=kb, autojunk=False)
+    for tag, i1, i2, j1, j2 in sm.get_opcodes():
+        if tag == "equal":
+            delta.retain(i2 - i1)
+        else:
+            if tag in ("replace", "delete"):
+                delta.delete(i2 - i1)
+            if tag in ("replace", "insert"):
+                delta.insert(tuple(new_l[j1:j2]))
+    return delta.chop()
+
+
+def _diff_values(
+    va: Dict[ContainerID, Any], vb: Dict[ContainerID, Any], target_state: DocState
+) -> Dict[ContainerID, Any]:
+    import difflib
+
+    from .event import CounterDiff
+
+    out: Dict[ContainerID, Any] = {}
+    for cid in set(va) | set(vb):
+        old_v = va.get(cid)
+        new_v = vb.get(cid)
+        if old_v == new_v:
+            continue
+        if cid.ctype == ContainerType.Map:
+            d = MapDiff()
+            old_m = old_v or {}
+            new_m = new_v or {}
+            for k in new_m:
+                if k not in old_m or old_m[k] != new_m[k]:
+                    d.updated[k] = new_m[k]
+            for k in old_m:
+                if k not in new_m:
+                    d.deleted.add(k)
+            if not d.is_empty():
+                out[cid] = d
+        elif cid.ctype == ContainerType.Counter:
+            out[cid] = CounterDiff((new_v or 0.0) - (old_v or 0.0))
+        elif cid.ctype == ContainerType.Text:
+            old_s, new_s = old_v or "", new_v or ""
+            delta = Delta()
+            sm = difflib.SequenceMatcher(a=old_s, b=new_s, autojunk=False)
+            for tag, i1, i2, j1, j2 in sm.get_opcodes():
+                if tag == "equal":
+                    delta.retain(i2 - i1)
+                else:
+                    if tag in ("replace", "delete"):
+                        delta.delete(i2 - i1)
+                    if tag in ("replace", "insert"):
+                        delta.insert(new_s[j1:j2])
+            if not delta.chop().is_empty():
+                out[cid] = delta
+        elif cid.ctype in (ContainerType.List, ContainerType.MovableList):
+            delta = _list_delta(old_v or [], new_v or [])
+            if not delta.is_empty():
+                out[cid] = delta
+        elif cid.ctype == ContainerType.Tree:
+            out[cid] = _tree_value_diff(old_v or [], new_v or [])
+    return out
+
+
+def _tree_value_diff(old_nodes: List[dict], new_nodes: List[dict]) -> TreeDiff:
+    """Diff two tree value snapshots (flat node lists) into TreeDiff items
+    ordered parents-first."""
+    from .core.ids import TreeID
+    from .event import TreeDiffAction, TreeDiffItem
+
+    old_by = {n["id"]: n for n in old_nodes}
+    new_by = {n["id"]: n for n in new_nodes}
+    d = TreeDiff()
+    for nid, n in new_by.items():
+        t = TreeID.parse(nid)
+        parent = TreeID.parse(n["parent"]) if n["parent"] else None
+        pos = bytes.fromhex(n["fractional_index"]) if n.get("fractional_index") else None
+        if nid not in old_by:
+            d.items.append(TreeDiffItem(t, TreeDiffAction.Create, parent, n["index"], pos))
+        else:
+            o = old_by[nid]
+            if (o["parent"], o["fractional_index"]) != (n["parent"], n["fractional_index"]):
+                d.items.append(TreeDiffItem(t, TreeDiffAction.Move, parent, n["index"], pos))
+    for nid in old_by:
+        if nid not in new_by:
+            d.items.append(TreeDiffItem(TreeID.parse(nid), TreeDiffAction.Delete))
+    return d

@@ -1,0 +1,250 @@
+"""JSONPath queries over live documents + subscriptions.
+
+reference: crates/loro-internal/src/jsonpath/ (pest grammar + evaluator
++ subscribe_jsonpath re-evaluating on events).  Supported syntax:
+  $                     root
+  .key  ['key']         member access
+  [0]  [-1]             index access (negative from end)
+  [s:e]  [s:e:st]       slices
+  .*  [*]               wildcard
+  ..key  ..*            recursive descent
+  [?(@.k op lit)]       filters (==, !=, <, <=, >, >=)
+Results are deep values; handler-level results available via
+query_handlers.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+from .doc import LoroDoc, LoroError
+
+
+class JsonPathError(LoroError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<root>\$)
+  | (?P<recursive>\.\.(?:(?P<rkey>[A-Za-z_][\w]*)|(?P<rstar>\*)|(?P<rbracket>(?=\[)))?)
+  | (?P<member>\.(?P<key>[A-Za-z_][\w]*))
+  | (?P<wildcard>\.\*)
+  | (?P<bracket>\[(?P<body>[^\]]*)\])
+    """,
+    re.VERBOSE,
+)
+
+
+def parse(path: str) -> List[Tuple]:
+    """Parse into a list of step tuples."""
+    steps: List[Tuple] = []
+    i = 0
+    if not path:
+        raise JsonPathError("empty path")
+    while i < len(path):
+        m = _TOKEN_RE.match(path, i)
+        if m is None:
+            raise JsonPathError(f"bad jsonpath at {i}: {path[i:]!r}")
+        if m.group("root"):
+            steps.append(("root",))
+        elif m.group("recursive") is not None:
+            if m.group("rkey"):
+                steps.append(("recursive", m.group("rkey")))
+            elif m.group("rstar"):
+                steps.append(("recursive", None))
+            else:
+                steps.append(("recursive_pending",))  # ..[...] handled next
+        elif m.group("member"):
+            steps.append(("key", m.group("key")))
+        elif m.group("wildcard"):
+            steps.append(("wild",))
+        elif m.group("bracket") is not None:
+            steps.append(_parse_bracket(m.group("body")))
+        i = m.end()
+    # fold recursive_pending + following step
+    out: List[Tuple] = []
+    i = 0
+    while i < len(steps):
+        if steps[i][0] == "recursive_pending":
+            if i + 1 >= len(steps):
+                raise JsonPathError("dangling '..'")
+            out.append(("recursive_step", steps[i + 1]))
+            i += 2
+        else:
+            out.append(steps[i])
+            i += 1
+    return out
+
+
+_FILTER_RE = re.compile(
+    r"^\?\(\s*@\.(?P<key>[\w]+)\s*(?P<op>==|!=|<=|>=|<|>)\s*(?P<lit>.+?)\s*\)$"
+)
+
+
+def _parse_bracket(body: str) -> Tuple:
+    body = body.strip()
+    if body == "*":
+        return ("wild",)
+    quoted = (body.startswith("'") and body.endswith("'")) or (
+        body.startswith('"') and body.endswith('"')
+    )
+    if quoted and "," not in body:
+        return ("key", body[1:-1])
+    fm = _FILTER_RE.match(body)
+    if fm:
+        lit = fm.group("lit")
+        if lit.startswith(("'", '"')):
+            val: Any = lit[1:-1]
+        elif lit in ("true", "false"):
+            val = lit == "true"
+        elif lit == "null":
+            val = None
+        else:
+            try:
+                val = int(lit)
+            except ValueError:
+                try:
+                    val = float(lit)
+                except ValueError:
+                    raise JsonPathError(f"bad filter literal {lit!r}")
+        return ("filter", fm.group("key"), fm.group("op"), val)
+    if ":" in body:
+        parts = body.split(":")
+        if len(parts) not in (2, 3):
+            raise JsonPathError(f"bad slice {body!r}")
+        nums = [int(p) if p.strip() else None for p in parts]
+        while len(nums) < 3:
+            nums.append(None)
+        return ("slice", nums[0], nums[1], nums[2])
+    if "," in body:
+        keys = []
+        for part in body.split(","):
+            part = part.strip()
+            if part.startswith(("'", '"')):
+                keys.append(part[1:-1])
+            else:
+                keys.append(int(part))
+        return ("union", tuple(keys))
+    try:
+        return ("index", int(body))
+    except ValueError:
+        raise JsonPathError(f"bad bracket body {body!r}")
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+
+def _children(v: Any) -> List[Any]:
+    if isinstance(v, dict):
+        return list(v.values())
+    if isinstance(v, list):
+        return list(v)
+    return []
+
+
+def _descendants(v: Any) -> List[Any]:
+    out = [v]
+    stack = [v]
+    while stack:
+        cur = stack.pop()
+        for c in _children(cur):
+            out.append(c)
+            stack.append(c)
+    return out
+
+
+_OPS = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: _cmp_ok(a, b) and a < b,
+    "<=": lambda a, b: _cmp_ok(a, b) and a <= b,
+    ">": lambda a, b: _cmp_ok(a, b) and a > b,
+    ">=": lambda a, b: _cmp_ok(a, b) and a >= b,
+}
+
+
+def _cmp_ok(a: Any, b: Any) -> bool:
+    return isinstance(a, (int, float)) and isinstance(b, (int, float)) or (
+        isinstance(a, str) and isinstance(b, str)
+    )
+
+
+def _apply_step(nodes: List[Any], step: Tuple) -> List[Any]:
+    kind = step[0]
+    out: List[Any] = []
+    if kind == "root":
+        return nodes
+    for v in nodes:
+        if kind == "key":
+            if isinstance(v, dict) and step[1] in v:
+                out.append(v[step[1]])
+        elif kind == "index":
+            if isinstance(v, list):
+                i = step[1]
+                if -len(v) <= i < len(v):
+                    out.append(v[i])
+        elif kind == "slice":
+            if isinstance(v, list):
+                out.extend(v[step[1] : step[2] : step[3]])
+        elif kind == "wild":
+            out.extend(_children(v))
+        elif kind == "union":
+            for k in step[1]:
+                if isinstance(k, str) and isinstance(v, dict) and k in v:
+                    out.append(v[k])
+                elif isinstance(k, int) and isinstance(v, list) and -len(v) <= k < len(v):
+                    out.append(v[k])
+        elif kind == "recursive":
+            key = step[1]
+            for d in _descendants(v):
+                if key is None:
+                    out.extend(_children(d))
+                elif isinstance(d, dict) and key in d:
+                    out.append(d[key])
+        elif kind == "recursive_step":
+            inner = step[1]
+            for d in _descendants(v):
+                out.extend(_apply_step([d], inner))
+        elif kind == "filter":
+            _, key, op, lit = step
+            for c in _children(v):
+                if isinstance(c, dict) and key in c and _OPS[op](c[key], lit):
+                    out.append(c)
+        else:  # pragma: no cover
+            raise JsonPathError(f"unknown step {step}")
+    return out
+
+
+def query(doc: LoroDoc, path: str) -> List[Any]:
+    """Evaluate a JSONPath against the doc's deep value.
+    reference API: loro.rs jsonpath / loro/src/lib.rs:1358."""
+    steps = parse(path)
+    nodes: List[Any] = [doc.get_deep_value()]
+    for step in steps:
+        nodes = _apply_step(nodes, step)
+    return nodes
+
+
+def subscribe_jsonpath(
+    doc: LoroDoc, path: str, cb: Callable[[List[Any]], None]
+) -> Callable[[], None]:
+    """Re-evaluate on every doc event; callback fires when the result
+    set changes (reference: jsonpath/subscription.rs)."""
+    steps = parse(path)  # validate early
+    last: List[Any] = query(doc, path)
+
+    def on_event(_ev) -> None:
+        nonlocal last
+        cur = query(doc, path)
+        if cur != last:
+            last = cur
+            cb(cur)
+
+    return doc.subscribe_root(on_event)

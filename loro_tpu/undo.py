@@ -1,0 +1,133 @@
+"""UndoManager: undo/redo of local edits with OT against concurrent
+remote edits.
+
+reference: crates/loro-internal/src/undo.rs — local commit spans are
+recorded on a stack; undo computes the inverse DiffBatch between the
+span's end and start versions (history replay) and transforms it
+through everything that has been applied since (remote imports and
+later local edits), then applies it as *new* ops; redo mirrors.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .core.ids import ContainerID
+from .core.version import Frontiers
+from .doc import LoroDoc
+from .event import CounterDiff, Delta, DocDiff, EventTriggerKind, MapDiff, TreeDiff
+
+UNDO_ORIGIN = "undo"
+REDO_ORIGIN = "redo"
+
+
+@dataclass
+class UndoItem:
+    from_f: Frontiers
+    to_f: Frontiers
+    # diffs applied after this item, per container (for transform)
+    post: Dict[ContainerID, Any] = field(default_factory=dict)
+
+
+def _transform_batch(
+    batch: Dict[ContainerID, Any], post: Dict[ContainerID, Any]
+) -> Dict[ContainerID, Any]:
+    """Transform an inverse DiffBatch through later diffs: sequence
+    deltas are OT-transformed; map keys touched later are dropped (the
+    later write wins, an undo must not clobber it); tree items whose
+    target was touched later are dropped (reference DiffBatch::transform
+    semantics, undo.rs:63-70)."""
+    out: Dict[ContainerID, Any] = {}
+    for cid, d in batch.items():
+        p = post.get(cid)
+        if p is None:
+            out[cid] = d
+            continue
+        if isinstance(d, Delta) and isinstance(p, Delta):
+            t = p.transform(d, priority_left=True)
+            if not t.is_empty():
+                out[cid] = t
+        elif isinstance(d, MapDiff) and isinstance(p, MapDiff):
+            touched = set(p.updated) | set(p.deleted)
+            t = MapDiff(
+                {k: v for k, v in d.updated.items() if k not in touched},
+                {k for k in d.deleted if k not in touched},
+            )
+            if not t.is_empty():
+                out[cid] = t
+        elif isinstance(d, TreeDiff) and isinstance(p, TreeDiff):
+            touched = {it.target for it in p.items}
+            t = TreeDiff([it for it in d.items if it.target not in touched])
+            if not t.is_empty():
+                out[cid] = t
+        elif isinstance(d, CounterDiff):
+            out[cid] = d  # sums commute
+    return out
+
+
+class UndoManager:
+    def __init__(self, doc: LoroDoc, max_stack: int = 100):
+        self.doc = doc
+        self.max_stack = max_stack
+        self.undo_stack: List[UndoItem] = []
+        self.redo_stack: List[UndoItem] = []
+        self._unsub = doc.subscribe_root(self._on_event)
+        self._exclude_origins = {UNDO_ORIGIN, REDO_ORIGIN}
+
+    def close(self) -> None:
+        self._unsub()
+
+    # ------------------------------------------------------------------
+    def _on_event(self, ev: DocDiff) -> None:
+        if ev.by == EventTriggerKind.Checkout:
+            return
+        if ev.by == EventTriggerKind.Local:
+            # local history is linear: stack discipline alone keeps
+            # inverse diffs applicable (later items are undone first),
+            # so local diffs never fold into `post` — only remote
+            # concurrency transforms the stacks (reference undo.rs).
+            if ev.origin == UNDO_ORIGIN:
+                self.redo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
+            elif ev.origin == REDO_ORIGIN:
+                self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
+            else:
+                self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
+                if len(self.undo_stack) > self.max_stack:
+                    self.undo_stack.pop(0)
+                self.redo_stack.clear()
+            return
+        # remote import: transform both stacks
+        self._fold_post({cd.id: cd.diff for cd in ev.diffs})
+
+    def _fold_post(self, ev_batch: Dict[ContainerID, Any]) -> None:
+        from .event import compose_diff
+
+        for stack in (self.undo_stack, self.redo_stack):
+            for it in stack:
+                for cid, d in ev_batch.items():
+                    it.post[cid] = compose_diff(it.post.get(cid), d)
+
+    # ------------------------------------------------------------------
+    def can_undo(self) -> bool:
+        return bool(self.undo_stack)
+
+    def can_redo(self) -> bool:
+        return bool(self.redo_stack)
+
+    def undo(self) -> bool:
+        return self._pop_apply(self.undo_stack, UNDO_ORIGIN)
+
+    def redo(self) -> bool:
+        return self._pop_apply(self.redo_stack, REDO_ORIGIN)
+
+    def _pop_apply(self, stack: List[UndoItem], origin: str) -> bool:
+        self.doc.commit()
+        if not stack:
+            return False
+        item = stack.pop()
+        inv = self.doc.diff(item.to_f, item.from_f)  # inverse of the span
+        inv = _transform_batch(inv, item.post)
+        if not inv:
+            return True  # fully cancelled by later edits; still consumed
+        self.doc.apply_diff(inv, origin=origin)
+        return True

@@ -1,0 +1,263 @@
+"""L7 feature tests: undo/redo, cursors, awareness/ephemeral, diff/revert
+(mirrors crates/loro/tests undo.rs + cursor + awareness coverage)."""
+import pytest
+
+from loro_tpu import ContainerType, Frontiers, LoroDoc
+from loro_tpu.awareness import Awareness, EphemeralStore
+from loro_tpu.cursor import Cursor, CursorSide, get_cursor, get_cursor_pos
+from loro_tpu.undo import UndoManager
+
+
+def sync(a, b):
+    b.import_(a.export_updates(b.oplog_vv()))
+    a.import_(b.export_updates(a.oplog_vv()))
+
+
+class TestUndo:
+    def test_basic_text_undo_redo(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc)
+        t = doc.get_text("t")
+        t.insert(0, "hello")
+        doc.commit()
+        t.insert(5, " world")
+        doc.commit()
+        assert um.undo()
+        assert t.to_string() == "hello"
+        assert um.undo()
+        assert t.to_string() == ""
+        assert not um.can_undo()
+        assert um.redo()
+        assert t.to_string() == "hello"
+        assert um.redo()
+        assert t.to_string() == "hello world"
+
+    def test_new_edit_clears_redo(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc)
+        t = doc.get_text("t")
+        t.insert(0, "a")
+        doc.commit()
+        um.undo()
+        t.insert(0, "b")
+        doc.commit()
+        assert not um.can_redo()
+
+    def test_map_undo(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc)
+        m = doc.get_map("m")
+        m.set("k", 1)
+        doc.commit()
+        m.set("k", 2)
+        doc.commit()
+        um.undo()
+        assert m.get("k") == 1
+        um.undo()
+        assert m.get("k") is None
+
+    def test_undo_only_own_ops(self):
+        """Remote edits are not undone (reference undo semantics)."""
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        um = UndoManager(a)
+        a.get_text("t").insert(0, "aaa")
+        a.commit()
+        b.get_text("t").insert(0, "bbb")
+        b.commit()
+        sync(a, b)
+        # concurrent root runs order by (peer, counter): peer 1 first
+        assert a.get_text("t").to_string() == "aaabbb"
+        um.undo()
+        assert a.get_text("t").to_string() == "bbb"
+
+    def test_undo_transformed_through_remote(self):
+        """Concurrent remote insert shifts the undone region."""
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "base")
+        sync(a, b)
+        um = UndoManager(a)
+        a.get_text("t").insert(4, "XYZ")
+        a.commit()
+        b.get_text("t").insert(0, "pre-")
+        sync(a, b)
+        assert a.get_text("t").to_string() == "pre-baseXYZ"
+        um.undo()
+        assert a.get_text("t").to_string() == "pre-base"
+        sync(a, b)
+        assert b.get_text("t").to_string() == "pre-base"
+
+    def test_counter_undo(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc)
+        c = doc.get_counter("c")
+        c.increment(5)
+        doc.commit()
+        um.undo()
+        assert c.value == 0.0
+        um.redo()
+        assert c.value == 5.0
+
+    def test_tree_undo(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc)
+        tree = doc.get_tree("tr")
+        r = tree.create()
+        doc.commit()
+        c = tree.create(r)
+        doc.commit()
+        um.undo()
+        assert tree.contains(r) and not tree.contains(c)
+        um.undo()
+        assert not tree.contains(r)
+        um.redo()
+        assert tree.contains(r)
+
+
+class TestDiffRevert:
+    def test_diff_and_apply(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "v1")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.insert(2, " v2")
+        doc.get_map("m").set("k", 9)
+        doc.commit()
+        f2 = doc.oplog_frontiers()
+        batch = doc.diff(f2, f1)
+        doc.apply_diff(batch)
+        assert doc.get_text("t").to_string() == "v1"
+        assert doc.get_map("m").get("k") is None
+        # history is preserved (revert generated new ops)
+        assert doc.oplog.total_ops() > 5
+
+    def test_revert_to(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_list("l")
+        l.push(1, 2, 3)
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        l.delete(0, 1)
+        l.push(4)
+        doc.commit()
+        doc.revert_to(f1)
+        assert l.get_value() == [1, 2, 3]
+        assert not doc.is_detached()
+
+
+class TestCursor:
+    def test_cursor_survives_remote_insert(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "hello world")
+        sync(a, b)
+        cur = get_cursor(a, a.get_text("t"), 5)  # before " world"
+        b.get_text("t").insert(0, ">>> ")
+        sync(a, b)
+        pos = get_cursor_pos(a, cur)
+        assert pos.pos == 9  # shifted by the 4-char remote prefix
+        assert not pos.update_needed
+
+    def test_cursor_on_deleted_elem(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "abcdef")
+        cur = get_cursor(doc, t, 2)  # at 'c'
+        t.delete(1, 3)  # deletes bcd
+        pos = get_cursor_pos(doc, cur)
+        assert pos.update_needed
+        assert pos.pos == 1  # nearest survivor: 'e' at index 1
+
+    def test_end_cursor(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "ab")
+        cur = get_cursor(doc, t, 2)
+        t.insert(0, "xy")
+        assert get_cursor_pos(doc, cur).pos == 4
+
+    def test_list_cursor(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_list("l")
+        l.push("a", "b", "c")
+        cur = get_cursor(doc, l, 1)
+        l.insert(0, "z")
+        assert get_cursor_pos(doc, cur).pos == 2
+
+    def test_movable_list_cursor_follows_move(self):
+        """Cursor anchors to the element, not its position slot."""
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("ml")
+        ml.push("a", "b", "c")
+        cur = get_cursor(doc, ml, 0)  # on "a"
+        ml.move(0, 2)  # a -> end
+        pos = get_cursor_pos(doc, cur)
+        assert pos.pos == 2 and not pos.update_needed
+        ml.delete(2, 1)  # delete "a"
+        assert get_cursor_pos(doc, cur).update_needed
+
+
+class TestAwareness:
+    def test_roundtrip(self):
+        a = Awareness(peer=1)
+        b = Awareness(peer=2)
+        a.set_local_state({"cursor": 5, "name": "alice"})
+        updated, added = b.apply(a.encode_all())
+        assert added == [1]
+        assert b.get_all_states()[1]["name"] == "alice"
+
+    def test_counter_lww(self):
+        a = Awareness(peer=1)
+        b = Awareness(peer=2)
+        a.set_local_state("v1")
+        blob1 = a.encode_all()
+        a.set_local_state("v2")
+        b.apply(a.encode_all())
+        b.apply(blob1)  # stale: ignored
+        assert b.get_all_states()[1] == "v2"
+
+
+class TestEphemeralStore:
+    def test_set_get_delete(self):
+        s = EphemeralStore()
+        s.set("cursor", {"x": 1})
+        assert s.get("cursor") == {"x": 1}
+        s.delete("cursor")
+        assert s.get("cursor") is None
+
+    def test_sync_lww(self):
+        a, b = EphemeralStore(), EphemeralStore()
+        a.set("k", "from_a")
+        b.apply(a.encode_all())
+        assert b.get("k") == "from_a"
+        b.set("k", "from_b")  # later timestamp
+        a.apply(b.encode_all())
+        assert a.get("k") == "from_b"
+
+    def test_local_update_subscription(self):
+        a, b = EphemeralStore(), EphemeralStore()
+        blobs = []
+        a.subscribe_local_update(blobs.append)
+        a.set("presence", "here")
+        assert blobs
+        b.apply(blobs[0])
+        assert b.get("presence") == "here"
+
+    def test_events(self):
+        a = EphemeralStore()
+        events = []
+        a.subscribe(events.append)
+        a.set("k", 1)
+        b = EphemeralStore()
+        b.subscribe(events.append)
+        b.apply(a.encode_all())
+        kinds = [(e["by"], tuple(e["added"]) or tuple(e["updated"]) or tuple(e["removed"])) for e in events]
+        assert ("local", ("k",)) in kinds
+        assert ("import", ("k",)) in kinds
+
+    def test_timeout_expiry(self):
+        s = EphemeralStore(timeout_ms=0)
+        s.set("k", 1)
+        import time
+
+        time.sleep(0.01)
+        assert s.get_all_states() == {}

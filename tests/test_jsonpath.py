@@ -1,0 +1,83 @@
+"""JSONPath tests (reference: loro-internal jsonpath tests)."""
+import pytest
+
+from loro_tpu import ContainerType, LoroDoc
+from loro_tpu.jsonpath import JsonPathError, query, subscribe_jsonpath
+
+
+def store_doc() -> LoroDoc:
+    doc = LoroDoc(peer=1)
+    m = doc.get_map("store")
+    books = m.set_container("book", ContainerType.List)
+    for title, price, cat in [
+        ("Sayings", 8.95, "reference"),
+        ("Sword", 12.99, "fiction"),
+        ("Moby Dick", 8.99, "fiction"),
+    ]:
+        b = books.push_container(ContainerType.Map)
+        b.set("title", title)
+        b.set("price", price)
+        b.set("category", cat)
+    m.set("bicycle", {"color": "red", "price": 19.95})
+    doc.commit()
+    return doc
+
+
+class TestQuery:
+    def test_member(self):
+        doc = store_doc()
+        assert query(doc, "$.store.bicycle.color") == ["red"]
+
+    def test_index(self):
+        doc = store_doc()
+        assert query(doc, "$.store.book[0].title") == ["Sayings"]
+        assert query(doc, "$.store.book[-1].title") == ["Moby Dick"]
+
+    def test_slice(self):
+        doc = store_doc()
+        assert query(doc, "$.store.book[0:2].price") == [8.95, 12.99]
+
+    def test_wildcard(self):
+        doc = store_doc()
+        assert sorted(query(doc, "$.store.book[*].title")) == ["Moby Dick", "Sayings", "Sword"]
+
+    def test_recursive(self):
+        doc = store_doc()
+        prices = query(doc, "$..price")
+        assert sorted(prices) == [8.95, 8.99, 12.99, 19.95]
+
+    def test_filter(self):
+        doc = store_doc()
+        cheap = query(doc, "$.store.book[?(@.price < 9)].title")
+        # filter returns the matching dicts; project titles
+        titles = query(doc, "$.store.book[?(@.price < 9)]")
+        assert sorted(b["title"] for b in titles) == ["Moby Dick", "Sayings"]
+
+    def test_filter_eq_str(self):
+        doc = store_doc()
+        fic = query(doc, "$.store.book[?(@.category == 'fiction')]")
+        assert len(fic) == 2
+
+    def test_union(self):
+        doc = store_doc()
+        assert query(doc, "$.store.book[0]['title','price']") == ["Sayings", 8.95]
+
+    def test_bad_path(self):
+        doc = store_doc()
+        with pytest.raises(JsonPathError):
+            query(doc, "$.store[")
+        with pytest.raises(JsonPathError):
+            query(doc, "")
+
+    def test_subscription(self):
+        doc = store_doc()
+        seen = []
+        unsub = subscribe_jsonpath(doc, "$.store.bicycle.color", seen.append)
+        doc.get_map("store").set("bicycle", {"color": "blue", "price": 19.95})
+        doc.commit()
+        assert seen == [["blue"]]
+        # unrelated change: no callback
+        doc.get_map("other").set("x", 1)
+        doc.commit()
+        assert len(seen) == 1
+        unsub()

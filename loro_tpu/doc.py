@@ -51,6 +51,8 @@ class EncodeMode(Enum):
     ColumnarUpdates = 3
     ColumnarSnapshot = 4
     ShallowSnapshot = 5
+    FastSnapshot = 6
+    StateOnly = 7
 
 
 class ExportMode:
@@ -105,6 +107,10 @@ class LoroDoc:
         self.config = Configure()
         self._txn: Optional[Transaction] = None
         self._detached = False
+        # (state bytes, vv, frontiers) of the frozen shallow-history root
+        # (reference: GcStore, container_store.rs:58) — replay floor for
+        # checkout/diff on shallow docs
+        self._shallow_base: Optional[Tuple[bytes, VersionVector, Frontiers]] = None
         self._local_update_subs: List[Callable[[bytes], None]] = []
         self._peer_id_change_subs: List[Callable[[PeerID], None]] = []
         self._pre_commit_subs: List[Callable[["Transaction"], None]] = []
@@ -248,9 +254,7 @@ class LoroDoc:
         """Export per ExportMode (reference: loro.rs:2096 dispatch)."""
         self.commit()
         if mode is None or isinstance(mode, ExportMode.Snapshot) or mode is ExportMode.Snapshot:
-            return self._encode_changes(
-                self.oplog.changes_in_causal_order(), EncodeMode.ColumnarSnapshot
-            )
+            return self._export_fast_snapshot()
         if isinstance(mode, ExportMode.Updates):
             vv = mode.from_vv or VersionVector()
             return self._encode_changes(
@@ -260,10 +264,88 @@ class LoroDoc:
             chs = self.oplog.changes_between(mode.from_vv, mode.to_vv)
             return self._encode_changes(chs, EncodeMode.ColumnarUpdates, mode.from_vv)
         if isinstance(mode, ExportMode.SnapshotAt):
+            if self._shallow_base is not None:
+                # history below the root is gone: ship base + ops <= f
+                return self._export_shallow(
+                    self.oplog.dag.shallow_since_frontiers, with_updates=True, to_f=mode.frontiers
+                )
             to_vv = self.oplog.dag.frontiers_to_vv(mode.frontiers)
-            chs = self.oplog.changes_between(VersionVector(), to_vv)
+            chs = self.oplog.changes_between(self.oplog.dag.shallow_since_vv, to_vv)
             return self._encode_changes(chs, EncodeMode.ColumnarSnapshot)
+        if isinstance(mode, ExportMode.ShallowSnapshot):
+            return self._export_shallow(mode.frontiers, with_updates=True)
+        if mode is ExportMode.StateOnly or isinstance(mode, ExportMode.StateOnly):
+            return self._export_shallow(self.oplog.frontiers, with_updates=False)
         raise LoroError(f"unsupported export mode {mode}")
+
+    def _export_fast_snapshot(self) -> bytes:
+        """[varint oplog_len][oplog changes][varint state_len][doc state]
+        (reference layout: fast_snapshot.rs:1-15).  The encoded state is
+        always the state at the oplog head — detached docs materialize
+        it by replay from the floor (so shallow bases are never lost)."""
+        import json as _json
+
+        from .codec import binary as bcodec
+        from .codec import snapshot as scodec
+        from .codec.binary import Writer
+
+        if self.state.frontiers == self.oplog.frontiers:
+            head_state = self.state
+        else:
+            head_state = self._state_at(self.oplog.frontiers)
+        w = Writer()
+        oplog_bytes = bcodec.encode_changes(self.oplog.changes_in_causal_order())
+        state_bytes = scodec.encode_doc_state(head_state, head_state.parents)
+        w.bytes_(oplog_bytes)
+        w.bytes_(state_bytes)
+        # shallow-root carry-over so a fast snapshot of a shallow doc
+        # keeps its replay floor
+        if self._shallow_base is not None:
+            base_bytes, base_vv, base_f = self._shallow_base
+            w.u8(1)
+            w.bytes_(base_bytes)
+            w.str_(_json.dumps(base_vv.to_json()))
+            w.str_(_json.dumps(base_f.to_json()))
+        else:
+            w.u8(0)
+        payload = bytes(w.buf)
+        crc = zlib.crc32(payload)
+        return MAGIC + bytes([FORMAT_VERSION, EncodeMode.FastSnapshot.value]) + crc.to_bytes(4, "little") + payload
+
+    def _export_shallow(
+        self, frontiers: Frontiers, with_updates: bool, to_f: Optional[Frontiers] = None
+    ) -> bytes:
+        """Frozen state at `frontiers` + (optionally) the ops after it,
+        up to `to_f` (default: everything).
+        reference: shallow_snapshot.rs:22."""
+        import json as _json
+
+        from .codec import binary as bcodec
+        from .codec import snapshot as scodec
+        from .codec.binary import Writer
+
+        base_vv = self.oplog.dag.frontiers_to_vv(frontiers)
+        if not (self.oplog.dag.shallow_since_vv <= base_vv):
+            raise LoroError("shallow snapshot frontiers below this doc's shallow root")
+        if frontiers == self.state.frontiers:
+            base_state = self.state  # export() committed; live state reusable
+        else:
+            base_state = self._state_at(frontiers)
+        state_bytes = scodec.encode_doc_state(base_state, base_state.parents)
+        w = Writer()
+        w.bytes_(state_bytes)
+        w.str_(_json.dumps(base_vv.to_json()))
+        w.str_(_json.dumps(frontiers.to_json()))
+        if with_updates:
+            to_vv = self.oplog.vv if to_f is None else self.oplog.dag.frontiers_to_vv(to_f)
+            chs = self.oplog.changes_between(base_vv, to_vv)
+            w.bytes_(bcodec.encode_changes(chs))
+        else:
+            w.bytes_(b"")
+        payload = bytes(w.buf)
+        crc = zlib.crc32(payload)
+        mode = EncodeMode.ShallowSnapshot if with_updates else EncodeMode.StateOnly
+        return MAGIC + bytes([FORMAT_VERSION, mode.value]) + crc.to_bytes(4, "little") + payload
 
     def export_snapshot(self) -> bytes:
         return self.export(ExportMode.Snapshot)
@@ -289,14 +371,20 @@ class LoroDoc:
         return header + payload
 
     def import_(self, data: bytes, origin: str = "import") -> ImportStatus:
-        """reference: loro.rs:568 LoroDoc::import."""
+        """reference: loro.rs:568 LoroDoc::import (header parse + mode
+        dispatch, loro.rs:584-649)."""
         self.commit()
-        changes = self._decode(data)
+        mode, payload = self._parse_envelope(data)
+        if mode == EncodeMode.FastSnapshot:
+            return self._import_fast_snapshot(payload, origin)
+        if mode in (EncodeMode.ShallowSnapshot, EncodeMode.StateOnly):
+            return self._import_shallow(payload, origin)
+        changes = self._decode_changes(mode, payload)
         return self._import_changes(changes, origin)
 
     import_bytes = import_
 
-    def _decode(self, data: bytes) -> List[Change]:
+    def _parse_envelope(self, data: bytes) -> Tuple[EncodeMode, bytes]:
         if len(data) < 10 or data[:4] != MAGIC:
             raise DecodeError("bad magic")
         version, mode_b = data[4], data[5]
@@ -307,9 +395,11 @@ class LoroDoc:
         if zlib.crc32(payload) != crc:
             raise DecodeError("checksum mismatch")
         try:
-            mode = EncodeMode(mode_b)
+            return EncodeMode(mode_b), payload
         except ValueError as e:
             raise DecodeError(f"unknown encode mode {mode_b}") from e
+
+    def _decode_changes(self, mode: EncodeMode, payload: bytes) -> List[Change]:
         if mode in (EncodeMode.JsonUpdates, EncodeMode.JsonSnapshot):
             try:
                 return jcodec.import_json_updates(jcodec.loads(payload))
@@ -323,6 +413,111 @@ class LoroDoc:
             except Exception as e:
                 raise DecodeError(f"malformed columnar payload: {e}") from e
         raise DecodeError(f"unsupported mode {mode}")
+
+    def _import_fast_snapshot(self, payload: bytes, origin: str) -> ImportStatus:
+        """Empty doc: install oplog + state bytes directly (no replay —
+        the point of the fast format, fast_snapshot.rs:27).  Non-empty
+        doc: fall back to importing the embedded changes."""
+        from .codec import binary as bcodec
+        from .codec import snapshot as scodec
+        from .codec.binary import Reader
+
+        import json as _json
+
+        try:
+            r = Reader(payload)
+            oplog_bytes = r.bytes_()
+            state_bytes = r.bytes_()
+            has_base = bool(r.u8())
+            base = None
+            if has_base:
+                bb = r.bytes_()
+                bvv = VersionVector.from_json(_json.loads(r.str_()))
+                bf = Frontiers.from_json(_json.loads(r.str_()))
+                base = (bb, bvv, bf)
+            changes = bcodec.decode_changes(oplog_bytes)
+        except DecodeError:
+            raise
+        except Exception as e:
+            raise DecodeError(f"malformed fast snapshot: {e}") from e
+        if not self.oplog.is_empty() or self.state.states:
+            if base is not None:
+                # retained changes alone are useless without the base
+                raise LoroError(
+                    "snapshot carries a shallow base; import it into an empty doc"
+                )
+            return self._import_changes(changes, origin)
+        if base is not None:
+            self._install_shallow_base(*base)
+        applied, pending = self.oplog.import_changes(changes)
+        try:
+            states, parents = scodec.decode_doc_state(state_bytes)
+        except Exception as e:
+            raise DecodeError(f"malformed snapshot state: {e}") from e
+        self.state.states = states
+        self.state.parents.update(parents)
+        self.state.vv = self.oplog.vv.copy()
+        self.state.frontiers = self.oplog.frontiers
+        self._emit_state_install_event(origin)
+        status = VersionRange()
+        for ch in applied:
+            status.extend_to_include(ch.id_span())
+        return ImportStatus(status, pending if not pending.is_empty() else None)
+
+    def _emit_state_install_event(self, origin: str) -> None:
+        """Subscribers registered before a snapshot import still need to
+        see the content: emit empty->state diffs for every container."""
+        if not self.observer.has_subscribers():
+            return
+        diffs = {}
+        for cid, st in self.state.states.items():
+            d = st.to_diff()
+            if not (hasattr(d, "is_empty") and d.is_empty()):
+                diffs[cid] = [d]
+        if diffs:
+            self._emit(diffs, origin, EventTriggerKind.Import, Frontiers())
+
+    def _import_shallow(self, payload: bytes, origin: str) -> ImportStatus:
+        """Install a frozen base state + retained ops into an empty doc.
+        reference: shallow snapshot import semantics."""
+        import json as _json
+
+        from .codec import binary as bcodec
+        from .codec import snapshot as scodec
+        from .codec.binary import Reader
+
+        if not self.oplog.is_empty() or self.state.states:
+            raise LoroError("shallow snapshots can only be imported into an empty doc")
+        try:
+            r = Reader(payload)
+            state_bytes = r.bytes_()
+            base_vv = VersionVector.from_json(_json.loads(r.str_()))
+            base_f = Frontiers.from_json(_json.loads(r.str_()))
+            updates = r.bytes_()
+            changes = bcodec.decode_changes(updates) if updates else []
+        except Exception as e:
+            raise DecodeError(f"malformed shallow snapshot: {e}") from e
+        self._install_shallow_base(state_bytes, base_vv, base_f)
+        try:
+            states, parents = scodec.decode_doc_state(state_bytes)
+        except Exception as e:
+            raise DecodeError(f"malformed snapshot state: {e}") from e
+        self.state.states = states
+        self.state.parents.update(parents)
+        self.state.vv = base_vv.copy()
+        self.state.frontiers = base_f
+        self._emit_state_install_event(origin)
+        if changes:
+            return self._import_changes(changes, origin)
+        return ImportStatus(VersionRange(), None)
+
+    def _install_shallow_base(self, state_bytes: bytes, vv: VersionVector, f: Frontiers) -> None:
+        self._shallow_base = (state_bytes, vv.copy(), f)
+        dag = self.oplog.dag
+        dag.shallow_since_vv = vv.copy()
+        dag.shallow_since_frontiers = f
+        dag.vv = vv.copy()
+        dag.frontiers = f
 
     def _import_changes(self, changes: List[Change], origin: str) -> ImportStatus:
         applied, pending = self.oplog.import_changes(changes)
@@ -389,7 +584,12 @@ class LoroDoc:
         """reference: loro.rs:1625.  Sets detached mode unless the target
         is the latest version."""
         self.commit()
-        target_vv = self.oplog.dag.frontiers_to_vv(frontiers)
+        try:
+            target_vv = self.oplog.dag.frontiers_to_vv(frontiers)
+        except KeyError as e:
+            raise LoroError(f"checkout target not in history (shallow/trimmed?): {e}") from e
+        if self._shallow_base is not None and not (self.oplog.dag.shallow_since_vv <= target_vv):
+            raise LoroError("cannot checkout below the shallow root")
         cur_vv = self.state.vv
         record = self.observer.has_subscribers()
         old_values = self._container_values() if record else None
@@ -398,11 +598,9 @@ class LoroDoc:
             chs = self.oplog.changes_between(cur_vv, target_vv)
             self.state.apply_changes(chs, record=False)
         else:
-            # retreat: rebuild state from scratch up to target_vv
-            new_state = DocState()
-            chs = self.oplog.changes_between(VersionVector(), target_vv)
-            new_state.apply_changes(chs, record=False)
-            self.state = new_state
+            # retreat: rebuild from the replay floor (empty or the
+            # frozen shallow base) up to target_vv
+            self.state = self._state_at(frontiers)
         self.state.vv = target_vv.copy()
         self.state.frontiers = frontiers
         # checkout always detaches (reference loro.rs:1625); only
@@ -432,10 +630,22 @@ class LoroDoc:
     def _state_at(self, frontiers: Frontiers) -> DocState:
         """Materialize a throwaway DocState at an arbitrary version by
         causal replay (the reference reaches the same states via its
-        persistent Checkout DiffCalculator)."""
+        persistent Checkout DiffCalculator).  Shallow docs replay from
+        the frozen base state, never below it."""
         vv = self.oplog.dag.frontiers_to_vv(frontiers)
         st = DocState()
-        st.apply_changes(self.oplog.changes_between(VersionVector(), vv), record=False)
+        from_vv = VersionVector()
+        if self._shallow_base is not None:
+            from .codec import snapshot as scodec
+
+            base_bytes, base_vv, _ = self._shallow_base
+            if not (base_vv <= vv):
+                raise LoroError("cannot materialize a version below the shallow root")
+            states, parents = scodec.decode_doc_state(base_bytes)
+            st.states = states
+            st.parents.update(parents)
+            from_vv = base_vv
+        st.apply_changes(self.oplog.changes_between(from_vv, vv), record=False)
         st.vv = vv
         st.frontiers = frontiers
         return st
@@ -444,6 +654,7 @@ class LoroDoc:
         """DiffBatch turning state(a) into state(b) (value-level).
         Endpoints equal to the live state reuse it instead of replaying
         the full history."""
+        self.commit()  # uncommitted ops would desync state vs frontiers
         sa = self.state if a == self.state.frontiers else self._state_at(a)
         sb = self.state if b == self.state.frontiers else self._state_at(b)
         return _state_diff(sa, sb)
@@ -543,13 +754,17 @@ def _state_diff(sa: DocState, sb: DocState) -> Dict[ContainerID, Any]:
     return _diff_values(va, vb, sb)
 
 
-def _list_delta(old_l: List[Any], new_l: List[Any]) -> Delta:
+def _seq_delta(old, new, keys_a=None, keys_b=None, as_tuple=False) -> Delta:
+    """Minimal retain/insert/delete delta via difflib (shared by the
+    text and list branches of _diff_values)."""
     import difflib
 
-    ka = [repr(x) for x in old_l]
-    kb = [repr(x) for x in new_l]
     delta = Delta()
-    sm = difflib.SequenceMatcher(a=ka, b=kb, autojunk=False)
+    sm = difflib.SequenceMatcher(
+        a=keys_a if keys_a is not None else old,
+        b=keys_b if keys_b is not None else new,
+        autojunk=False,
+    )
     for tag, i1, i2, j1, j2 in sm.get_opcodes():
         if tag == "equal":
             delta.retain(i2 - i1)
@@ -557,15 +772,19 @@ def _list_delta(old_l: List[Any], new_l: List[Any]) -> Delta:
             if tag in ("replace", "delete"):
                 delta.delete(i2 - i1)
             if tag in ("replace", "insert"):
-                delta.insert(tuple(new_l[j1:j2]))
+                delta.insert(tuple(new[j1:j2]) if as_tuple else new[j1:j2])
     return delta.chop()
+
+
+def _list_delta(old_l: List[Any], new_l: List[Any]) -> Delta:
+    return _seq_delta(
+        old_l, new_l, keys_a=[repr(x) for x in old_l], keys_b=[repr(x) for x in new_l], as_tuple=True
+    )
 
 
 def _diff_values(
     va: Dict[ContainerID, Any], vb: Dict[ContainerID, Any], target_state: DocState
 ) -> Dict[ContainerID, Any]:
-    import difflib
-
     from .event import CounterDiff
 
     out: Dict[ContainerID, Any] = {}
@@ -589,18 +808,8 @@ def _diff_values(
         elif cid.ctype == ContainerType.Counter:
             out[cid] = CounterDiff((new_v or 0.0) - (old_v or 0.0))
         elif cid.ctype == ContainerType.Text:
-            old_s, new_s = old_v or "", new_v or ""
-            delta = Delta()
-            sm = difflib.SequenceMatcher(a=old_s, b=new_s, autojunk=False)
-            for tag, i1, i2, j1, j2 in sm.get_opcodes():
-                if tag == "equal":
-                    delta.retain(i2 - i1)
-                else:
-                    if tag in ("replace", "delete"):
-                        delta.delete(i2 - i1)
-                    if tag in ("replace", "insert"):
-                        delta.insert(new_s[j1:j2])
-            if not delta.chop().is_empty():
+            delta = _seq_delta(old_v or "", new_v or "")
+            if not delta.is_empty():
                 out[cid] = delta
         elif cid.ctype in (ContainerType.List, ContainerType.MovableList):
             delta = _list_delta(old_v or [], new_v or [])

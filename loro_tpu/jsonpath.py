@@ -117,9 +117,14 @@ def _parse_bracket(body: str) -> Tuple:
         parts = body.split(":")
         if len(parts) not in (2, 3):
             raise JsonPathError(f"bad slice {body!r}")
-        nums = [int(p) if p.strip() else None for p in parts]
+        try:
+            nums = [int(p) if p.strip() else None for p in parts]
+        except ValueError:
+            raise JsonPathError(f"bad slice {body!r}")
         while len(nums) < 3:
             nums.append(None)
+        if nums[2] == 0:
+            raise JsonPathError("slice step cannot be 0")
         return ("slice", nums[0], nums[1], nums[2])
     if "," in body:
         keys = []

@@ -181,6 +181,8 @@ class AppDag:
 
     def frontiers_to_vv(self, f: Frontiers) -> VersionVector:
         """reference: loro_dag.rs:1192."""
+        if f == self.shallow_since_frontiers and not f.is_empty():
+            return self.shallow_since_vv.copy()
         vv = VersionVector()
         vv.merge(self.shallow_since_vv)
         for id in f:

@@ -1,0 +1,250 @@
+"""Snapshot tests: fast snapshot (no-replay install), shallow snapshot
+(trimmed history), state-only (reference: fast_snapshot.rs +
+shallow_snapshot.rs behaviors)."""
+import pytest
+
+from loro_tpu import ContainerType, ExportMode, Frontiers, LoroDoc, LoroError
+
+
+def rich_doc(peer=1) -> LoroDoc:
+    doc = LoroDoc(peer=peer)
+    t = doc.get_text("text")
+    t.insert(0, "snapshot me")
+    t.mark(0, 8, "bold", True)
+    t.delete(2, 2)
+    m = doc.get_map("map")
+    m.set("k", [1, {"x": 2}])
+    sub = m.set_container("sub", ContainerType.Text)
+    sub.insert(0, "nested")
+    ml = doc.get_movable_list("ml")
+    ml.push("a", "b", "c")
+    ml.move(0, 2)
+    ml.set(1, "B")
+    tree = doc.get_tree("tree")
+    r = tree.create()
+    c = tree.create(r)
+    tree.get_meta(c).set("n", 1)
+    doc.get_counter("cnt").increment(7)
+    doc.commit()
+    return doc
+
+
+class TestFastSnapshot:
+    def test_roundtrip_equivalence(self):
+        a = rich_doc()
+        blob = a.export(ExportMode.Snapshot)
+        b = LoroDoc(peer=2)
+        b.import_(blob)
+        assert b.get_deep_value() == a.get_deep_value()
+        # history fully available: updates export still works
+        c = LoroDoc(peer=3)
+        c.import_(b.export_updates())
+        assert c.get_deep_value() == a.get_deep_value()
+
+    def test_continue_editing_after_fast_import(self):
+        a = rich_doc()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.Snapshot))
+        b.get_text("text").insert(0, "more ")
+        b.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        assert a.get_text("text").to_string() == b.get_text("text").to_string()
+
+    def test_richtext_marks_survive(self):
+        a = rich_doc()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.Snapshot))
+        assert b.get_text("text").get_richtext_value() == a.get_text("text").get_richtext_value()
+
+    def test_import_into_nonempty_falls_back(self):
+        a = rich_doc()
+        b = LoroDoc(peer=2)
+        b.get_text("other").insert(0, "mine")
+        b.import_(a.export(ExportMode.Snapshot))
+        assert b.get_text("text").to_string() == a.get_text("text").to_string()
+        assert b.get_text("other").to_string() == "mine"
+
+    def test_movable_list_state_installed(self):
+        a = rich_doc()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.Snapshot))
+        assert b.get_movable_list("ml").get_value() == a.get_movable_list("ml").get_value()
+        # and continues to accept moves
+        b.get_movable_list("ml").move(0, 1)
+        b.commit()
+
+
+class TestShallowSnapshot:
+    def test_shallow_trims_history(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        for i in range(20):
+            t.insert(len(t), f"{i},")
+            a.commit()
+        f_mid = a.oplog_frontiers()
+        t.insert(0, "HEAD:")
+        a.commit()
+        blob = a.export(ExportMode.ShallowSnapshot(f_mid))
+        full = a.export(ExportMode.Snapshot)
+        b = LoroDoc(peer=2)
+        b.import_(blob)
+        assert b.get_text("t").to_string() == a.get_text("t").to_string()
+        # trimmed history: far fewer retained atoms than the full doc
+        assert b.oplog.total_ops() - b.oplog.dag.shallow_since_vv.total_ops() < 10
+        assert not b.oplog.dag.shallow_since_vv.is_empty() if hasattr(b.oplog.dag.shallow_since_vv, "is_empty") else True
+
+    def test_shallow_doc_keeps_editing_and_syncing(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "base content")
+        a.commit()
+        f = a.oplog_frontiers()
+        a.get_text("t").insert(4, " more")
+        a.commit()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+        b.get_text("t").insert(0, "B:")
+        b.commit()
+        # sync b's new ops back to the full doc
+        a.import_(b.export_updates(a.oplog_vv()))
+        assert a.get_text("t").to_string() == b.get_text("t").to_string()
+
+    def test_shallow_checkout_below_root_fails(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "one")
+        a.commit()
+        f1 = a.oplog_frontiers()
+        a.get_text("t").insert(3, " two")
+        a.commit()
+        f2 = a.oplog_frontiers()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.ShallowSnapshot(f2)))
+        with pytest.raises(LoroError):
+            b.checkout(f1)
+
+    def test_shallow_checkout_at_or_above_root_ok(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "one")
+        a.commit()
+        f1 = a.oplog_frontiers()
+        a.get_text("t").insert(3, " two")
+        a.commit()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.ShallowSnapshot(f1)))
+        assert b.get_text("t").to_string() == "one two"
+        b.get_text("t").insert(0, "x")
+        b.commit()
+        b.checkout(f1)  # exactly the shallow root: allowed
+        assert b.get_text("t").to_string() == "one"
+        b.checkout_to_latest()
+        assert b.get_text("t").to_string() == "xone two"
+
+    def test_shallow_into_nonempty_rejected(self):
+        a = rich_doc()
+        a.commit()
+        blob = a.export(ExportMode.ShallowSnapshot(a.oplog_frontiers()))
+        b = LoroDoc(peer=2)
+        b.get_map("m").set("x", 1)
+        b.commit()
+        with pytest.raises(LoroError):
+            b.import_(blob)
+
+
+class TestReviewRegressions:
+    def _shallow_doc(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        t.insert(0, "base")
+        a.commit()
+        f = a.oplog_frontiers()
+        t.insert(4, " tail")
+        a.commit()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+        return b, f
+
+    def test_snapshot_of_shallow_doc_keeps_base(self):
+        b, f = self._shallow_doc()
+        blob = b.export(ExportMode.Snapshot)
+        c = LoroDoc(peer=3)
+        c.import_(blob)
+        assert c.get_text("t").to_string() == "base tail"
+
+    def test_snapshot_of_detached_shallow_doc(self):
+        b, f = self._shallow_doc()
+        b.checkout(f)  # detached at the shallow root
+        blob = b.export(ExportMode.Snapshot)
+        c = LoroDoc(peer=3)
+        c.import_(blob)
+        assert c.get_text("t").to_string() == "base tail"
+
+    def test_snapshot_at_on_shallow_doc(self):
+        b, f = self._shallow_doc()
+        blob = b.export(ExportMode.SnapshotAt(b.oplog_frontiers()))
+        c = LoroDoc(peer=3)
+        c.import_(blob)
+        assert c.get_text("t").to_string() == "base tail"
+
+    def test_fork_at_on_shallow_doc(self):
+        b, f = self._shallow_doc()
+        c = b.fork_at(b.oplog_frontiers())
+        assert c.get_text("t").to_string() == "base tail"
+
+    def test_fast_snapshot_with_base_into_nonempty_rejected(self):
+        from loro_tpu import LoroError
+
+        b, f = self._shallow_doc()
+        blob = b.export(ExportMode.Snapshot)
+        c = LoroDoc(peer=3)
+        c.get_map("m").set("x", 1)
+        c.commit()
+        with pytest.raises(LoroError):
+            c.import_(blob)
+
+    def test_snapshot_import_emits_events(self):
+        a = rich_doc()
+        blob = a.export(ExportMode.Snapshot)
+        b = LoroDoc(peer=2)
+        events = []
+        b.subscribe_root(events.append)
+        b.import_(blob)
+        assert events, "subscribers must see snapshot content"
+        paths = {cd.path[0] for ev in events for cd in ev.diffs}
+        assert "text" in paths and "map" in paths
+
+    def test_diff_with_uncommitted_txn(self):
+        from loro_tpu import Frontiers
+
+        d = LoroDoc(peer=1)
+        d.get_text("t").insert(0, "ab")
+        d.commit()
+        f1 = d.oplog_frontiers()
+        d.get_text("t").insert(2, "cd")  # NOT committed
+        batch = d.diff(f1, Frontiers())
+        delta = next(iter(batch.values()))
+        assert delta.delete_len() == 2  # not 4
+
+
+class TestStateOnly:
+    def test_state_only(self):
+        a = rich_doc()
+        blob = a.export(ExportMode.StateOnly)
+        b = LoroDoc(peer=2)
+        b.import_(blob)
+        assert b.get_deep_value() == a.get_deep_value()
+        # minimal history: nothing retained beyond the root
+        assert b.oplog.vv == b.oplog.dag.shallow_since_vv
+
+    def test_state_only_smaller_than_snapshot(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        for i in range(300):
+            t.insert(0 if i % 3 else len(t), "word ")
+            a.commit()
+        t.update("final tiny text")
+        a.commit()
+        so = a.export(ExportMode.StateOnly)
+        full = a.export(ExportMode.Snapshot)
+        # tombstoned elements stay in the frozen state (they remain
+        # legal Fugue parents for ops causally after the root), so the
+        # win is history-meta removal, not tombstone pruning
+        assert len(so) < len(full)

@@ -1,0 +1,116 @@
+"""ctypes binding + on-demand build of the native wire->SoA decoder.
+
+Builds codec.cpp with g++ on first use (cached as codec.so next to the
+source; rebuilt when the source is newer).  Falls back gracefully: all
+callers must handle `available() == False` (pure-Python paths exist for
+everything — the native decoder is the throughput path for fleet
+decode, reference-parity with loro's Rust block decode).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "codec.cpp")
+_SO = os.path.join(_DIR, "codec.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process: concurrent builds don't race
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        need_build = not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        if need_build and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.loro_count_seq_elements.restype = ctypes.c_longlong
+        lib.loro_count_seq_elements.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ]
+        lib.loro_explode_seq.restype = ctypes.c_longlong
+        lib.loro_explode_seq.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ] + [ctypes.c_void_p] * 6 + [ctypes.c_longlong]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def explode_seq_payload(payload: bytes, target_cid_index: int):
+    """Parse a binary updates payload and return the element table of
+    the target sequence container as numpy columns
+    (parent, side, peer_idx, counter, deleted, content) or None if the
+    native decoder is unavailable.  Raises ValueError on malformed
+    payloads or unresolvable references (caller falls back to Python).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.loro_count_seq_elements(payload, len(payload), target_cid_index)
+    if n < 0:
+        raise ValueError("native decode failed (malformed payload?)")
+    parent = np.empty(n, np.int32)
+    side = np.empty(n, np.int32)
+    peer = np.empty(n, np.int32)
+    counter = np.empty(n, np.int32)
+    deleted = np.zeros(n, np.uint8)
+    content = np.empty(n, np.int32)
+    wrote = lib.loro_explode_seq(
+        payload,
+        len(payload),
+        target_cid_index,
+        parent.ctypes.data_as(ctypes.c_void_p),
+        side.ctypes.data_as(ctypes.c_void_p),
+        peer.ctypes.data_as(ctypes.c_void_p),
+        counter.ctypes.data_as(ctypes.c_void_p),
+        deleted.ctypes.data_as(ctypes.c_void_p),
+        content.ctypes.data_as(ctypes.c_void_p),
+        n,
+    )
+    if wrote != n:
+        raise ValueError("native decode failed (unresolvable refs or count mismatch)")
+    return parent, side, peer, counter, deleted.astype(bool), content

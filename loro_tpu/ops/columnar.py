@@ -207,6 +207,56 @@ def extract_map_ops(changes: Sequence[Change]) -> MapExtract:
     )
 
 
+def extract_seq_from_payload(payload: bytes, cid: ContainerID) -> Optional[SeqExtract]:
+    """Native-decoder fast path: binary updates payload -> SeqExtract
+    without materializing Python Change objects (the fleet ingest path;
+    ~1000x the Python explode loop).  Returns None when the native
+    library is unavailable; raises ValueError on malformed payloads."""
+    from ..codec.binary import Reader, _read_cid
+    from ..native import available, explode_seq_payload
+
+    if not available():
+        return None
+    r = Reader(payload)
+    peers = [r.u64le() for _ in range(r.varint())]
+    for _ in range(r.varint()):
+        r.bytes_()  # keys
+    cids = [_read_cid(r, peers) for _ in range(r.varint())]
+    try:
+        target = cids.index(cid)
+    except ValueError:
+        return SeqExtract(
+            parent=np.zeros(0, np.int32),
+            side=np.zeros(0, np.int32),
+            peer=np.zeros(0, np.int32),
+            counter=np.zeros(0, np.int32),
+            deleted=np.zeros(0, bool),
+            content=np.zeros(0, np.int32),
+            valid=np.zeros(0, bool),
+            peers=[],
+        )
+    out = explode_seq_payload(payload, target)
+    if out is None:
+        return None
+    parent, side, peer_idx, counter, deleted, content = out
+    # wire peer table is registration-ordered; the kernel contract needs
+    # order-preserving ranks of the sorted u64 peer ids
+    order = np.argsort(np.asarray(peers, np.uint64), kind="stable")
+    rank_of = np.empty(len(peers), np.int32)
+    rank_of[order] = np.arange(len(peers), dtype=np.int32)
+    peer_rank = rank_of[peer_idx] if len(peers) else peer_idx
+    return SeqExtract(
+        parent=parent,
+        side=side,
+        peer=peer_rank.astype(np.int32),
+        counter=counter,
+        deleted=deleted,
+        content=content,
+        valid=np.ones(parent.shape[0], bool),
+        peers=sorted(peers),
+    ).sort_by_peer_counter()
+
+
 def pad_rows(a: np.ndarray, n: int, fill) -> np.ndarray:
     if a.shape[0] == n:
         return a

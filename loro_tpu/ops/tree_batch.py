@@ -1,0 +1,170 @@
+"""Batched movable-tree merge kernel.
+
+reference semantics: crates/loro-internal/src/diff_calc/tree.rs —
+moves apply in global (lamport, peer, counter) order; a move whose new
+parent lies in the target's subtree at that moment is skipped
+(`effected = false`, tree.rs:499-508).  Deletion = move under TRASH.
+
+Device formulation: the move log (host-sorted by key — cheap numpy
+radix) replays as a `lax.scan`; the per-move cycle check is a bounded
+parent-pointer walk (`d_max` gathers), all vmapped across documents so
+one scan step advances every doc in the batch.  Sibling order
+(fractional index) is resolved host-side at materialization — the
+device's job is the structural fixpoint, the part that is sequential
+per doc but embarrassingly parallel across docs.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROOT = -1
+TRASH = -2
+ABSENT = -3
+
+
+class TreeOpCols(NamedTuple):
+    """[M] per-doc move log, sorted by (lamport, peer, counter).
+
+    target: i32[M] node index (per-doc node dictionary)
+    parent: i32[M] node index, ROOT, or TRASH
+    valid:  bool[M] padding mask
+    """
+
+    target: jax.Array
+    parent: jax.Array
+    valid: jax.Array
+
+
+def tree_merge_doc(
+    cols: TreeOpCols, n_nodes: int, d_max: Optional[int] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Replay one doc's sorted move log.  Returns (parent i32[n_nodes]
+    with ABSENT for never-created nodes, effected bool[M] per move).
+
+    `d_max` bounds the cycle-check walk.  Soundness requires
+    d_max >= max tree depth; the default (n_nodes) is always sound —
+    pass a smaller bound only when the workload guarantees a depth cap.
+    """
+    if d_max is None:
+        d_max = n_nodes
+    init = jnp.full(n_nodes, ABSENT, jnp.int32)
+
+    def step(state, mv):
+        t, p, v = mv
+
+        # cycle check: does walking up from p reach t?
+        def walk(_, carry):
+            cur, hit = carry
+            hit = hit | (cur == t)
+            nxt = jnp.where(cur >= 0, state[jnp.clip(cur, 0, n_nodes - 1)], jnp.int32(ROOT - 10))
+            return nxt, hit
+
+        _, cycle = jax.lax.fori_loop(0, d_max, walk, (p, jnp.bool_(False)))
+        ok = v & ~(cycle & (p >= 0))
+        new_state = jnp.where(
+            ok, state.at[jnp.clip(t, 0, n_nodes - 1)].set(p), state
+        )
+        return new_state, ok
+
+    final, effected = jax.lax.scan(step, init, (cols.target, cols.parent, cols.valid))
+    return final, effected
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def tree_merge_batch(
+    cols: TreeOpCols, n_nodes: int, d_max: Optional[int] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """[D, M] move logs -> ([D, n_nodes] parents, [D, M] effected)."""
+    return jax.vmap(lambda c: tree_merge_doc(c, n_nodes, d_max))(cols)
+
+
+def is_deleted_batch(parents: jax.Array) -> jax.Array:
+    """bool[D, N]: node is trash-reachable (pointer-doubling ancestor
+    resolution — log-depth, fully parallel)."""
+
+    def per_doc(par):
+        n = par.shape[0]
+
+        def body(_, p):
+            # jump: p[i] <- p[p[i]] when parent is a real node
+            nxt = jnp.where(p >= 0, p[jnp.clip(p, 0, n - 1)], p)
+            return nxt
+
+        # log2(n) doublings cover any depth <= n
+        p = jax.lax.fori_loop(0, int(np.ceil(np.log2(max(n, 2)))) + 1, body, par)
+        return p == TRASH
+
+    return jax.vmap(per_doc)(parents)
+
+
+def extract_tree_ops(changes, cid):
+    """Host: explode TreeMove ops for `cid` into sorted columns + node
+    dictionary.  Returns (TreeOpCols numpy, nodes list, row_positions
+    list aligned with rows — resolve winners with positions_of after the
+    kernel reports which moves were effected)."""
+    from ..core.change import TreeMove
+
+    rows = []  # (lamport, peer, counter, target, parent, position)
+    node_ids = {}
+    nodes = []
+
+    def node_idx(tid):
+        if tid not in node_ids:
+            node_ids[tid] = len(nodes)
+            nodes.append(tid)
+        return node_ids[tid]
+
+    for ch in changes:
+        for op in ch.ops:
+            if op.container != cid or not isinstance(op.content, TreeMove):
+                continue
+            c = op.content
+            lam = ch.lamport + (op.counter - ch.ctr_start)
+            t = node_idx(c.target)
+            if c.is_delete:
+                p = TRASH
+            elif c.parent is None:
+                p = ROOT
+            else:
+                p = node_idx(c.parent)
+            rows.append((lam, ch.peer, op.counter, t, p, c.position))
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    m = len(rows)
+    target = np.asarray([r[3] for r in rows], np.int32)
+    parent = np.asarray([r[4] for r in rows], np.int32)
+    row_positions = [r[5] for r in rows]
+    cols = TreeOpCols(target=target, parent=parent, valid=np.ones(m, bool))
+    return cols, nodes, row_positions
+
+
+def positions_of(cols: TreeOpCols, row_positions, effected) -> dict:
+    """Winning fractional index per node: the last *effected*, non-delete
+    move in key order (deletes ship position=None and cycle-losing moves
+    must not clobber the position the effective tree actually has)."""
+    out: dict = {}
+    effected = np.asarray(effected)
+    for i in range(len(row_positions)):
+        if not effected[i]:
+            continue
+        if int(cols.parent[i]) == TRASH:
+            continue
+        out[int(cols.target[i])] = row_positions[i]
+    return out
+
+
+def pad_tree_cols(cols: TreeOpCols, m: int) -> TreeOpCols:
+    def pad(a, fill, dtype):
+        out = np.full(m, fill, dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    return TreeOpCols(
+        target=pad(cols.target, 0, np.int32),
+        parent=pad(cols.parent, ROOT, np.int32),
+        valid=pad(cols.valid, False, bool),
+    )

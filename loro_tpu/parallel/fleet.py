@@ -103,6 +103,34 @@ class Fleet:
         extracts = [extract_seq_container(chs, cid) for chs in docs_changes]
         return self.merge_text_docs(extracts)
 
+    def merge_text_payloads(
+        self, payloads: Sequence[bytes], cid: ContainerID
+    ) -> TextMergeResult:
+        """Full ingest pipeline: binary update payloads -> native C++
+        wire->SoA decode -> one sharded device launch.  This is the
+        server-side bulk-sync path the north star describes: the decode
+        stage never materializes Python op objects.
+
+        Payloads are envelope-stripped bytes; integrity (CRC) is the
+        envelope layer's job (LoroDoc._parse_envelope) — a corrupted
+        payload here decodes to garbage-but-safe output, never a crash.
+        """
+        from ..codec.binary import decode_changes
+        from ..ops.columnar import extract_seq_from_payload
+
+        extracts = []
+        for p in payloads:
+            try:
+                ex = extract_seq_from_payload(p, cid)
+            except ValueError:
+                # native path can't resolve (e.g. incremental payload
+                # referencing elements outside it): python fallback
+                ex = None
+            if ex is None:
+                ex = extract_seq_container(decode_changes(p), cid)
+            extracts.append(ex)
+        return self.merge_text_docs(extracts)
+
     # ------------------------------------------------------------------
     # LWW map merge
     # ------------------------------------------------------------------

@@ -1,0 +1,131 @@
+"""Native (C++) wire->SoA decoder vs pure-Python extraction."""
+import random
+
+import numpy as np
+import pytest
+
+from loro_tpu import EncodeMode, LoroDoc
+from loro_tpu.native import available
+from loro_tpu.ops.columnar import extract_seq_container, extract_seq_from_payload
+
+pytestmark = pytest.mark.skipif(not available(), reason="native codec unavailable")
+
+
+def _payload(doc) -> bytes:
+    doc.commit()
+    blob = doc.export_updates()
+    assert blob[5] == EncodeMode.ColumnarUpdates.value
+    return blob[10:]  # strip envelope
+
+
+def _assert_same(ex_py, ex_nat):
+    assert ex_nat.n == ex_py.n
+    np.testing.assert_array_equal(ex_nat.parent, ex_py.parent)
+    np.testing.assert_array_equal(ex_nat.side, ex_py.side)
+    np.testing.assert_array_equal(ex_nat.peer, ex_py.peer)
+    np.testing.assert_array_equal(ex_nat.counter, ex_py.counter)
+    np.testing.assert_array_equal(ex_nat.deleted, ex_py.deleted)
+
+
+class TestNativeDecoder:
+    def test_simple_text(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.delete(2, 3)
+        t.insert(4, "résumé ☃")  # multibyte utf8
+        cid = t.id
+        ex_nat = extract_seq_from_payload(_payload(doc), cid)
+        ex_py = extract_seq_container(doc.oplog.changes_in_causal_order(), cid)
+        _assert_same(ex_py, ex_nat)
+        np.testing.assert_array_equal(ex_nat.content, ex_py.content)
+
+    def test_multi_container_interleaved(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        l = doc.get_list("l")
+        m = doc.get_map("m")
+        tr = doc.get_tree("tree")
+        ml = doc.get_movable_list("ml")
+        t.insert(0, "abc")
+        l.push(1, 2)
+        m.set("k", {"nested": [1, 2]})
+        r = tr.create()
+        ml.push("x", "y")
+        ml.move(0, 1)
+        t.insert(1, "XY")
+        t.mark(0, 3, "bold", True)
+        doc.get_counter("c").increment(3)
+        t.delete(0, 2)
+        cid = t.id
+        ex_nat = extract_seq_from_payload(_payload(doc), cid)
+        ex_py = extract_seq_container(doc.oplog.changes_in_causal_order(), cid)
+        _assert_same(ex_py, ex_nat)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_multi_peer(self, seed):
+        rng = random.Random(seed)
+        docs = [LoroDoc(peer=rng.getrandbits(50) + 1) for _ in range(3)]
+        for _ in range(70):
+            d = rng.choice(docs)
+            t = d.get_text("t")
+            if len(t) and rng.random() < 0.35:
+                pos = rng.randint(0, len(t) - 1)
+                t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+            else:
+                t.insert(rng.randint(0, len(t)), rng.choice(["ab", "ç", "1234", "☃"]))
+            if rng.random() < 0.3:
+                src, dst = rng.sample(docs, 2)
+                dst.import_(src.export_updates(dst.oplog_vv()))
+        for _ in range(2):
+            for s in docs:
+                for t2 in docs:
+                    if s is not t2:
+                        t2.import_(s.export_updates(t2.oplog_vv()))
+        doc = docs[0]
+        cid = doc.get_text("t").id
+        ex_nat = extract_seq_from_payload(_payload(doc), cid)
+        ex_py = extract_seq_container(doc.oplog.changes_in_causal_order(), cid)
+        _assert_same(ex_py, ex_nat)
+        np.testing.assert_array_equal(ex_nat.content, ex_py.content)
+
+    def test_absent_container(self):
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "x")
+        from loro_tpu import ContainerID, ContainerType
+
+        other = ContainerID.root("nope", ContainerType.Text)
+        ex = extract_seq_from_payload(_payload(doc), other)
+        assert ex.n == 0
+
+    def test_malformed_payload_raises(self):
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "abcdef")
+        payload = bytearray(_payload(doc))
+        cid = doc.get_text("t").id
+        for cut in (len(payload) // 2, len(payload) - 2):
+            with pytest.raises(ValueError):
+                extract_seq_from_payload(bytes(payload[:cut]), cid)
+
+    def test_speed_vs_python(self):
+        import time
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        rng = random.Random(0)
+        for _ in range(3000):
+            if len(t) and rng.random() < 0.3:
+                pos = rng.randint(0, len(t) - 1)
+                t.delete(pos, min(2, len(t) - pos))
+            else:
+                t.insert(rng.randint(0, len(t)), "word")
+        payload = _payload(doc)
+        cid = t.id
+        t0 = time.perf_counter()
+        ex_nat = extract_seq_from_payload(payload, cid)
+        t_nat = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ex_py = extract_seq_container(doc.oplog.changes_in_causal_order(), cid)
+        t_py = time.perf_counter() - t0
+        _assert_same(ex_py, ex_nat)
+        assert t_nat < t_py, f"native {t_nat*1e3:.1f}ms not faster than python {t_py*1e3:.1f}ms"

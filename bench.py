@@ -27,6 +27,79 @@ import numpy as np
 
 RUST_SINGLE_THREAD_OPS_PER_SEC = 2.0e6  # see module docstring
 
+
+def _emit(metric: str, ops_per_sec: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(ops_per_sec),
+                "unit": "ops/s",
+                "vs_baseline": round(ops_per_sec / RUST_SINGLE_THREAD_OPS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+def bench_map() -> None:
+    """BASELINE config 1: batched LWW-map concurrent import."""
+    import jax
+    import numpy as np
+
+    from loro_tpu.ops.lww import MapOpCols, lww_merge_batch
+
+    docs = int(os.environ.get("BENCH_DOCS", "1024"))
+    m = int(os.environ.get("BENCH_MAP_OPS", "65536"))
+    s = int(os.environ.get("BENCH_MAP_SLOTS", "4096"))
+    rng = np.random.default_rng(0)
+    cols = MapOpCols(
+        slot=rng.integers(0, s, (docs, m)).astype(np.int32),
+        lamport=rng.integers(0, 1 << 20, (docs, m)).astype(np.int32),
+        peer=rng.integers(0, 64, (docs, m)).astype(np.int32),
+        value_idx=np.arange(docs * m, dtype=np.int32).reshape(docs, m) % (1 << 20),
+        valid=np.ones((docs, m), bool),
+    )
+    dev = MapOpCols(*[jax.device_put(a) for a in cols])
+    out = lww_merge_batch(dev, s)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        out = lww_merge_batch(dev, s)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    _emit(f"lww_map ops merged/sec ({docs}-doc batch, {m} ops/doc)", docs * m / dt)
+
+
+def bench_tree() -> None:
+    """BASELINE config 5: deep hierarchy, concurrent move/reparent."""
+    import jax
+    import numpy as np
+
+    from loro_tpu.ops.tree_batch import TreeOpCols, tree_merge_batch
+
+    docs = int(os.environ.get("BENCH_DOCS", "1024"))
+    n_nodes = int(os.environ.get("BENCH_TREE_NODES", "512"))
+    m = int(os.environ.get("BENCH_TREE_MOVES", "2048"))
+    rng = np.random.default_rng(0)
+    target = rng.integers(0, n_nodes, (docs, m)).astype(np.int32)
+    parent = rng.integers(-2, n_nodes, (docs, m)).astype(np.int32)
+    cols = TreeOpCols(
+        target=target, parent=parent, valid=np.ones((docs, m), bool)
+    )
+    dev = TreeOpCols(*[jax.device_put(a) for a in cols])
+    d_max = int(os.environ.get("BENCH_TREE_DEPTH", "64"))
+    out = tree_merge_batch(dev, n_nodes, d_max)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = tree_merge_batch(dev, n_nodes, d_max)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    _emit(f"tree moves merged/sec ({docs}-doc batch, {m} moves/doc)", docs * m / dt)
+
+
 def main() -> None:
     # bench runs on the real chip (ambient platform) by default; an
     # explicit JAX_PLATFORMS env must win even though the axon plugin
@@ -35,6 +108,12 @@ def main() -> None:
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    config = os.environ.get("BENCH_CONFIG", "text")
+    if config == "map":
+        return bench_map()
+    if config == "tree":
+        return bench_tree()
 
     from loro_tpu.bench_utils import automerge_final_text, automerge_seq_extract
     from loro_tpu.ops.columnar import chain_columns
@@ -80,18 +159,10 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     docs_done = n_chunks * chunk
-    total_ops = docs_done * n_ops
-    ops_per_sec = total_ops / dt
-    print(
-        json.dumps(
-            {
-                "metric": "ops_merged_per_sec_per_chip (automerge-perf trace, "
-                f"{docs_done}-doc concurrent import)",
-                "value": round(ops_per_sec),
-                "unit": "ops/s",
-                "vs_baseline": round(ops_per_sec / RUST_SINGLE_THREAD_OPS_PER_SEC, 2),
-            }
-        )
+    _emit(
+        "ops_merged_per_sec_per_chip (automerge-perf trace, "
+        f"{docs_done}-doc concurrent import)",
+        docs_done * n_ops / dt,
     )
 
 

@@ -40,6 +40,7 @@ from .models.handlers import (
 from .oplog.oplog import OpLog
 from .state import DocState, compose_many
 from .txn import Transaction
+from .utils import tracing
 
 MAGIC = b"LTPU"
 FORMAT_VERSION = 1
@@ -252,6 +253,7 @@ class LoroDoc:
     # ------------------------------------------------------------------
     def export(self, mode=None) -> bytes:
         """Export per ExportMode (reference: loro.rs:2096 dispatch)."""
+        tracing.instant("doc.export", mode=type(mode).__name__ if mode is not None else "Snapshot")
         self.commit()
         if mode is None or isinstance(mode, ExportMode.Snapshot) or mode is ExportMode.Snapshot:
             return self._export_fast_snapshot()
@@ -373,14 +375,16 @@ class LoroDoc:
     def import_(self, data: bytes, origin: str = "import") -> ImportStatus:
         """reference: loro.rs:568 LoroDoc::import (header parse + mode
         dispatch, loro.rs:584-649)."""
-        self.commit()
-        mode, payload = self._parse_envelope(data)
-        if mode == EncodeMode.FastSnapshot:
-            return self._import_fast_snapshot(payload, origin)
-        if mode in (EncodeMode.ShallowSnapshot, EncodeMode.StateOnly):
-            return self._import_shallow(payload, origin)
-        changes = self._decode_changes(mode, payload)
-        return self._import_changes(changes, origin)
+        with tracing.span("doc.import", bytes=len(data)):
+            self.commit()
+            mode, payload = self._parse_envelope(data)
+            if mode == EncodeMode.FastSnapshot:
+                return self._import_fast_snapshot(payload, origin)
+            if mode in (EncodeMode.ShallowSnapshot, EncodeMode.StateOnly):
+                return self._import_shallow(payload, origin)
+            with tracing.span("decode", mode=mode.name):
+                changes = self._decode_changes(mode, payload)
+            return self._import_changes(changes, origin)
 
     import_bytes = import_
 
@@ -520,14 +524,16 @@ class LoroDoc:
         dag.frontiers = f
 
     def _import_changes(self, changes: List[Change], origin: str) -> ImportStatus:
-        applied, pending = self.oplog.import_changes(changes)
+        with tracing.span("oplog.import", n_changes=len(changes)):
+            applied, pending = self.oplog.import_changes(changes)
         success = VersionRange()
         for ch in applied:
             success.extend_to_include(ch.id_span())
         if applied and not self._detached:
             record = self.observer.has_subscribers()
             from_f = self.state.frontiers
-            diffs = self.state.apply_changes(applied, record=record)
+            with tracing.span("state.apply", n_changes=len(applied)):
+                diffs = self.state.apply_changes(applied, record=record)
             self.state.frontiers = self.oplog.frontiers
             if record and diffs:
                 self._emit(diffs, origin, EventTriggerKind.Import, from_f)

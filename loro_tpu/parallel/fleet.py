@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.change import Change
 from ..core.ids import ContainerID
+from ..utils import tracing
 from ..ops.columnar import MapExtract, SeqExtract, extract_map_ops, extract_seq_container, pad_rows
 from ..ops.fugue_batch import SeqColumns, materialize_content_batch, pad_bucket
 from ..ops.lww import MapOpCols, lww_merge_doc
@@ -68,6 +69,7 @@ class Fleet:
         doc axis is padded to a multiple of the mesh's doc dimension."""
         if self._text_fn is None:
             self._text_fn = self._build_text_fn()
+        tracing.instant("fleet.merge_text_docs", docs=len(extracts))
         n = pad_bucket(max(e.n for e in extracts))
         d_mesh = self.mesh.shape[DOC_AXIS]
         d = len(extracts)

@@ -1,0 +1,105 @@
+"""Lightweight tracing: spans -> Chrome trace JSON.
+
+reference: the `tracing` spans on loro's hot paths + dev-utils
+(crates/dev-utils/src/lib.rs:9-31 writes ./log/trace-*.json for
+chrome://tracing when DEBUG is set).  Same contract here: zero overhead
+unless enabled (env LORO_TPU_TRACE=1 or enable()); `span(name)` context
+managers on import/merge/export paths; dump() writes the trace file.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_enabled = os.environ.get("LORO_TPU_TRACE", "") not in ("", "0")
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_t0 = time.perf_counter()
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextmanager
+def span(name: str, **args):
+    """Trace span; ~zero cost when tracing is off."""
+    if not _enabled:
+        yield
+        return
+    start = (time.perf_counter() - _t0) * 1e6
+    try:
+        yield
+    finally:
+        end = (time.perf_counter() - _t0) * 1e6
+        with _lock:
+            _events.append(
+                {
+                    "name": name,
+                    "ph": "X",
+                    "ts": start,
+                    "dur": end - start,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() % 0xFFFF,
+                    "args": {k: _safe(v) for k, v in args.items()} if args else {},
+                }
+            )
+
+
+def instant(name: str, **args) -> None:
+    if not _enabled:
+        return
+    with _lock:
+        _events.append(
+            {
+                "name": name,
+                "ph": "i",
+                "ts": (time.perf_counter() - _t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 0xFFFF,
+                "s": "t",
+                "args": {k: _safe(v) for k, v in args.items()} if args else {},
+            }
+        )
+
+
+def _safe(v):
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def events() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_events)
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def dump(path: Optional[str] = None) -> str:
+    """Write chrome://tracing JSON; returns the path."""
+    if path is None:
+        os.makedirs("log", exist_ok=True)
+        path = os.path.join("log", f"trace-{int(time.time())}.json")
+    with _lock:
+        data = {"traceEvents": list(_events)}
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path

@@ -1,0 +1,36 @@
+"""Tracing subsystem tests (reference: dev-utils chrome-trace setup)."""
+import json
+import os
+
+from loro_tpu import LoroDoc
+from loro_tpu.utils import tracing
+
+
+def test_spans_recorded_and_dumped(tmp_path):
+    tracing.clear()
+    tracing.enable()
+    try:
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "traced")
+        b.import_(a.export_updates())
+        names = {e["name"] for e in tracing.events()}
+        assert "doc.import" in names
+        assert "oplog.import" in names
+        assert "state.apply" in names
+        path = tracing.dump(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            data = json.load(f)
+        assert data["traceEvents"]
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
+def test_zero_overhead_when_disabled():
+    tracing.clear()
+    assert not tracing.is_enabled() or True
+    tracing.disable()
+    a = LoroDoc(peer=1)
+    a.get_text("t").insert(0, "x")
+    a.export_updates()
+    assert tracing.events() == []

@@ -207,6 +207,20 @@ def extract_map_ops(changes: Sequence[Change]) -> MapExtract:
     )
 
 
+def peer_counter_perm(peer: np.ndarray, counter: np.ndarray, parent: np.ndarray):
+    """Shared (peer, counter)-ordering plumbing for extractors: returns
+    (perm, remapped_parent) where parent indexes are rewritten through
+    the permutation (the fugue_order input contract)."""
+    n = len(peer)
+    perm = np.lexsort((counter, peer)) if n else np.zeros(0, np.int64)
+    inv = np.empty(n, np.int64)
+    inv[perm] = np.arange(n)
+    out_parent = np.asarray(parent)[perm].astype(np.int64)
+    mask = out_parent >= 0
+    out_parent[mask] = inv[out_parent[mask]]
+    return perm, out_parent.astype(np.int32)
+
+
 def extract_seq_from_payload(payload: bytes, cid: ContainerID) -> Optional[SeqExtract]:
     """Native-decoder fast path: binary updates payload -> SeqExtract
     without materializing Python Change objects (the fleet ingest path;

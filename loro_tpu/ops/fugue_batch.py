@@ -291,11 +291,32 @@ materialize_content_jit = jax.jit(materialize_content)
 
 
 def pad_bucket(n: int, floor: int = 64) -> int:
-    """Next power-of-two bucket ≥ n (bounds XLA recompilations)."""
+    """Next power-of-two bucket >= n (bounds XLA recompilations)."""
     b = floor
     while b < n:
         b *= 2
     return b
+
+
+def pad_seq_columns(cols: SeqColumns, n: int) -> SeqColumns:
+    """Pad numpy SeqColumns to n rows (invalid tail)."""
+
+    def pad(a, fill):
+        if a.shape[0] == n:
+            return a
+        out = np.full(n, fill, a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    return SeqColumns(
+        parent=pad(cols.parent, -1),
+        side=pad(cols.side, 0),
+        peer=pad(cols.peer, 0),
+        counter=pad(cols.counter, 0),
+        deleted=pad(cols.deleted, True),
+        content=pad(cols.content, -1),
+        valid=pad(cols.valid, False),
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=())

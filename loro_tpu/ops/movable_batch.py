@@ -1,0 +1,200 @@
+"""Batched MovableList merge kernel.
+
+reference semantics: MovableListDiffCalculator (diff_calc.rs:1669-2020)
+— position slots live in the shared Fugue sequence; per element the
+winning slot (last move, max (lamport, peer)) and winning value (last
+set) are LWW selections.  Device formulation: the shared Fugue order
+kernel ranks *slots*; two scatter-max passes pick winners; an element is
+visible iff its winning slot is not tombstoned (a newer concurrent move
+revives it — matching models/movable_list_state.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fugue_batch import SeqColumns, fugue_order
+
+NEG = jnp.int32(-(2**31) + 1)
+
+
+class MovableCols(NamedTuple):
+    """[S] slot rows + [K] set rows for one doc (padded).
+
+    Slots (sequence elements): seq (SeqColumns over slots; `content` is
+    the slot's element index), lamport i32[S].
+    Sets: set_elem i32[K] element index, set_lamport, set_peer,
+    set_value i32[K] value-dictionary index, set_valid bool[K].
+    n_elems is carried statically by the caller.
+    """
+
+    seq: SeqColumns
+    lamport: jax.Array
+    set_elem: jax.Array
+    set_lamport: jax.Array
+    set_peer: jax.Array
+    set_value: jax.Array
+    set_valid: jax.Array
+
+
+def movable_merge_doc(cols: MovableCols, n_elems: int) -> Tuple[jax.Array, jax.Array]:
+    """Returns (ordered value indexes i32[S] padded with -1, count)."""
+    seq = cols.seq
+    s = seq.parent.shape[0]
+    elem = jnp.where(seq.valid, seq.content, n_elems)  # pads -> dump elem
+
+    # winning slot per element: max (lamport, peer); tie-break by peer is
+    # safe because slot ids are unique per (lamport, peer)
+    lam = jnp.where(seq.valid, cols.lamport, NEG)
+    win_lam = jnp.full(n_elems + 1, NEG, jnp.int32).at[elem].max(lam)
+    at_lam = seq.valid & (cols.lamport == win_lam[elem])
+    peer = jnp.where(at_lam, seq.peer, NEG)
+    win_peer = jnp.full(n_elems + 1, NEG, jnp.int32).at[elem].max(peer)
+    is_win_slot = at_lam & (seq.peer == win_peer[elem])
+    # among winner candidates with equal (lamport, peer) (same-run slots
+    # impossible: one move per counter) — unique winner
+    win_deleted = jnp.full(n_elems + 1, 0, jnp.int32).at[
+        jnp.where(is_win_slot, elem, n_elems)
+    ].max(jnp.where(seq.deleted, 1, 0))
+
+    # winning value per element (creation values ship as set rows too)
+    sv_lam = jnp.where(cols.set_valid, cols.set_lamport, NEG)
+    se = jnp.where(cols.set_valid, cols.set_elem, n_elems)
+    v_lam = jnp.full(n_elems + 1, NEG, jnp.int32).at[se].max(sv_lam)
+    at_v = cols.set_valid & (cols.set_lamport == v_lam[se])
+    v_peer = jnp.full(n_elems + 1, NEG, jnp.int32).at[
+        jnp.where(at_v, se, n_elems)
+    ].max(jnp.where(at_v, cols.set_peer, NEG))
+    is_win_set = at_v & (cols.set_peer == v_peer[se])
+    win_value = jnp.full(n_elems + 1, -1, jnp.int32).at[
+        jnp.where(is_win_set, se, n_elems)
+    ].max(jnp.where(is_win_set, cols.set_value, -1))
+
+    # visible slots: the element's winning slot, not tombstoned
+    visible = is_win_slot & ~seq.deleted & (win_deleted[elem] == 0)
+    rank = fugue_order(seq)
+    m = 3 * (s + 1)
+    rk = jnp.clip(rank, 0, m - 1)
+    hist = jnp.zeros(m, jnp.int32).at[jnp.where(visible, rk, m - 1)].add(
+        visible.astype(jnp.int32)
+    )
+    pos_of_rank = jnp.cumsum(hist) - hist
+    pos = pos_of_rank[rk]
+    count = visible.sum().astype(jnp.int32)
+    out = jnp.full(s, -1, jnp.int32).at[jnp.where(visible, pos, s)].set(
+        win_value[jnp.clip(elem, 0, n_elems)], mode="drop"
+    )
+    return out, count
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def movable_merge_batch(cols: MovableCols, n_elems: int):
+    return jax.vmap(lambda c: movable_merge_doc(c, n_elems))(cols)
+
+
+def extract_movable(changes, cid):
+    """Host: explode a MovableList container's ops into MovableCols
+    (numpy) + (elems list, values list).  Rows follow the
+    (peer, counter) ordering contract of fugue_order."""
+    from ..core.change import MovableMove, MovableSet, SeqDelete, SeqInsert
+    from ..core.ids import ID
+    from ..oplog.oplog import _RunCont
+
+    peers_seen = sorted({ch.peer for ch in changes})
+    peer_rank = {p: i for i, p in enumerate(peers_seen)}
+    slots = []  # (parent_idx, side, peer_rank, counter, lamport, elem_idx)
+    id2slot = {}
+    elems = []  # elem ids
+    elem_idx = {}
+    values = []
+    sets = []  # (elem_idx, lamport, peer_rank, value_idx)
+    deletes = []
+
+    def eidx(eid):
+        if eid not in elem_idx:
+            elem_idx[eid] = len(elems)
+            elems.append(eid)
+        return elem_idx[eid]
+
+    for ch in changes:
+        for op in ch.ops:
+            if op.container != cid:
+                continue
+            c = op.content
+            lam = ch.lamport + (op.counter - ch.ctr_start)
+            if isinstance(c, SeqInsert):
+                body = c.content
+                for j in range(len(body)):
+                    if j == 0:
+                        if isinstance(c.parent, _RunCont):
+                            pidx = id2slot[(ch.peer, op.counter - 1)]
+                        elif c.parent is None:
+                            pidx = -1
+                        else:
+                            pidx = id2slot[(c.parent.peer, c.parent.counter)]
+                        side = int(c.side)
+                    else:
+                        pidx = len(slots) - 1
+                        side = 1
+                    eid = (ch.peer, op.counter + j)
+                    ei = eidx(eid)
+                    id2slot[eid] = len(slots)
+                    slots.append((pidx, side, peer_rank[ch.peer], op.counter + j, lam + j, ei))
+                    vi = len(values)
+                    values.append(body[j])
+                    sets.append((ei, lam + j, peer_rank[ch.peer], vi))
+            elif isinstance(c, MovableMove):
+                if isinstance(c.parent, _RunCont):
+                    pidx = id2slot[(ch.peer, op.counter - 1)]
+                elif c.parent is None:
+                    pidx = -1
+                else:
+                    pidx = id2slot[(c.parent.peer, c.parent.counter)]
+                ei = eidx((c.elem.peer, c.elem.counter))
+                id2slot[(ch.peer, op.counter)] = len(slots)
+                slots.append((pidx, int(c.side), peer_rank[ch.peer], op.counter, lam, ei))
+            elif isinstance(c, MovableSet):
+                ei = eidx((c.elem.peer, c.elem.counter))
+                vi = len(values)
+                values.append(c.value)
+                sets.append((ei, lam, peer_rank[ch.peer], vi))
+            elif isinstance(c, SeqDelete):
+                for sp in c.spans:
+                    deletes.append((sp.peer, sp.start, sp.end))
+
+    n = len(slots)
+    arr = np.asarray(slots, np.int64).reshape(n, 6) if n else np.zeros((0, 6), np.int64)
+    deleted = np.zeros(n, bool)
+    for peer, start, end in deletes:
+        for ctr in range(start, end):
+            i = id2slot.get((peer, ctr))
+            if i is not None:
+                deleted[i] = True
+    from .columnar import peer_counter_perm
+
+    perm, parent = peer_counter_perm(arr[:, 2], arr[:, 3], arr[:, 0])
+    k = len(sets)
+    sarr = np.asarray(sets, np.int64).reshape(k, 4) if k else np.zeros((0, 4), np.int64)
+    seq = SeqColumns(
+        parent=parent.astype(np.int32),
+        side=arr[perm, 1].astype(np.int32),
+        peer=arr[perm, 2].astype(np.int32),
+        counter=arr[perm, 3].astype(np.int32),
+        deleted=deleted[perm],
+        content=arr[perm, 5].astype(np.int32),  # element index
+        valid=np.ones(n, bool),
+    )
+    cols = MovableCols(
+        seq=seq,
+        lamport=arr[perm, 4].astype(np.int32),
+        set_elem=sarr[:, 0].astype(np.int32),
+        set_lamport=sarr[:, 1].astype(np.int32),
+        set_peer=sarr[:, 2].astype(np.int32),
+        set_value=sarr[:, 3].astype(np.int32),
+        set_valid=np.ones(k, bool),
+    )
+    return cols, elems, values

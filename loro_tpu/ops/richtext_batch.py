@@ -1,0 +1,237 @@
+"""Batched rich-text merge kernel: text order + style resolution.
+
+reference semantics: the Peritext-style style anchors of
+crates/loro-internal/src/container/richtext (StyleAnchor rope elements,
+style_range_map.rs): a (start, end) anchor pair styles the characters
+between them; per key the winning pair covering a char is the one with
+max (lamport, peer); value None = unstyled.
+
+Device formulation: anchors ride the same Fugue order kernel as chars
+(zero-width).  With P pairs per doc, anchor positions induce <= 2P+1
+constant-style regions; winners resolve as masked maxima over the
+[P, R, K] cover tensor — tiny dense work after the big order solve.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fugue_batch import SeqColumns, fugue_order
+
+NEG = jnp.int32(-(2**31) + 1)
+
+
+class RichtextCols(NamedTuple):
+    """[N] element rows (chars: content = codepoint; anchors: content=-1)
+    + [P] anchor-pair rows."""
+
+    seq: SeqColumns
+    pair_start: jax.Array  # i32[P] element row of the start anchor
+    pair_end: jax.Array  # i32[P] element row of the end anchor
+    pair_key: jax.Array  # i32[P] style-key index
+    pair_value: jax.Array  # i32[P] value index; -1 = null (unmark)
+    pair_lamport: jax.Array
+    pair_peer: jax.Array
+    pair_valid: jax.Array  # bool[P] (False for pads / deleted anchors)
+
+
+def richtext_merge_doc(
+    cols: RichtextCols, n_keys: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (codes i32[N] in order (-1 pad tail), char count,
+    region boundaries i32[2P+2] (ascending char positions, padded with
+    count), winner value idx i32[2P+1, n_keys] (-1 = unstyled))."""
+    seq = cols.seq
+    n = seq.parent.shape[0]
+    p = cols.pair_start.shape[0]
+    rank = fugue_order(seq)
+    m = 3 * (n + 1)
+    rk = jnp.clip(rank, 0, m - 1)
+    is_char = seq.content >= 0
+    visible = seq.valid & ~seq.deleted & is_char
+    hist = jnp.zeros(m, jnp.int32).at[jnp.where(visible, rk, m - 1)].add(
+        visible.astype(jnp.int32)
+    )
+    pos_of_rank = jnp.cumsum(hist) - hist
+    pos = pos_of_rank[rk]
+    count = visible.sum().astype(jnp.int32)
+    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
+        seq.content, mode="drop"
+    )
+
+    # anchor char-positions (chars before the anchor in final order)
+    ps = jnp.clip(cols.pair_start, 0, n - 1)
+    pe = jnp.clip(cols.pair_end, 0, n - 1)
+    a_start = jnp.where(cols.pair_valid, pos[ps], count)
+    a_end = jnp.where(cols.pair_valid, pos[pe], count)
+
+    # region boundaries: sorted anchor positions, 0 and count implicit
+    bounds = jnp.sort(jnp.concatenate([a_start, a_end]))  # [2P]
+    lo = jnp.concatenate([jnp.zeros(1, jnp.int32), bounds])  # [2P+1]
+    hi = jnp.concatenate([bounds, count[None].astype(jnp.int32)])
+
+    # cover[i, r]: pair i styles region r (non-empty regions only matter)
+    cover = (
+        cols.pair_valid[:, None]
+        & (a_start[:, None] <= lo[None, :])
+        & (a_end[:, None] >= hi[None, :])
+        & (lo[None, :] < hi[None, :])
+    )  # [P, R]
+    key_onehot = (
+        cols.pair_key[:, None] == jnp.arange(n_keys, dtype=jnp.int32)[None, :]
+    )  # [P, K]
+    mask = cover[:, :, None] & key_onehot[:, None, :]  # [P, R, K]
+    # winner = max (lamport, peer) — two overflow-free passes, matching
+    # the host's tuple comparison (text_state._resolve_attrs) for any
+    # lamport / peer-rank magnitudes
+    win_lam = jnp.max(jnp.where(mask, cols.pair_lamport[:, None, None], NEG), axis=0)
+    at_lam = mask & (cols.pair_lamport[:, None, None] == win_lam[None, :, :])
+    win_peer = jnp.max(jnp.where(at_lam, cols.pair_peer[:, None, None], NEG), axis=0)
+    is_winner = at_lam & (cols.pair_peer[:, None, None] == win_peer[None, :, :])
+    win_value = jnp.max(
+        jnp.where(is_winner, cols.pair_value[:, None, None], -1), axis=0
+    )  # [R, K]; stays -1 when no cover or null value
+    styled = win_lam > NEG
+    win_value = jnp.where(styled, win_value, -1)
+    return codes, count, jnp.concatenate([lo, hi[-1:]]), win_value
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def richtext_merge_batch(cols: RichtextCols, n_keys: int):
+    return jax.vmap(lambda c: richtext_merge_doc(c, n_keys))(cols)
+
+
+def extract_richtext(changes, cid):
+    """Host: explode a Text container (chars + anchors) into
+    RichtextCols (numpy) + (keys list, values list).  Pairing invariant:
+    a start anchor at id (p, c) pairs with the end anchor (p, c+1)
+    (TextHandler.mark emits exactly that)."""
+    from ..core.change import SeqDelete, SeqInsert, StyleAnchor
+    from ..oplog.oplog import _RunCont
+
+    peers_seen = sorted({ch.peer for ch in changes})
+    peer_rank = {pr: i for i, pr in enumerate(peers_seen)}
+    rows = []  # (parent, side, peer_rank, counter, content)
+    id2row = {}
+    keys, key_idx = [], {}
+    values = []
+    anchors = {}  # (peer, counter) -> dict
+    deletes = []
+
+    def kidx(k):
+        if k not in key_idx:
+            key_idx[k] = len(keys)
+            keys.append(k)
+        return key_idx[k]
+
+    for ch in changes:
+        for op in ch.ops:
+            if op.container != cid:
+                continue
+            c = op.content
+            lam = ch.lamport + (op.counter - ch.ctr_start)
+            if isinstance(c, SeqInsert):
+                if isinstance(c.parent, _RunCont):
+                    pidx = id2row[(ch.peer, op.counter - 1)]
+                elif c.parent is None:
+                    pidx = -1
+                else:
+                    pidx = id2row[(c.parent.peer, c.parent.counter)]
+                if isinstance(c.content, StyleAnchor):
+                    a = c.content
+                    row = len(rows)
+                    id2row[(ch.peer, op.counter)] = row
+                    rows.append((pidx, int(c.side), peer_rank[ch.peer], op.counter, -1))
+                    if a.value is None:
+                        vi = -1
+                    else:
+                        vi = len(values)
+                        values.append(a.value)
+                    anchors[(ch.peer, op.counter)] = {
+                        "row": row,
+                        "key": kidx(a.key),
+                        "value": vi,
+                        "lamport": lam,
+                        "peer": peer_rank[ch.peer],
+                        "start": a.is_start,
+                        "deleted": False,
+                    }
+                else:
+                    for j, chr_ in enumerate(c.content):
+                        row = len(rows)
+                        id2row[(ch.peer, op.counter + j)] = row
+                        rows.append(
+                            (
+                                pidx if j == 0 else row - 1,
+                                int(c.side) if j == 0 else 1,
+                                peer_rank[ch.peer],
+                                op.counter + j,
+                                ord(chr_),
+                            )
+                        )
+            elif isinstance(c, SeqDelete):
+                for sp in c.spans:
+                    deletes.append((sp.peer, sp.start, sp.end))
+
+    n = len(rows)
+    arr = np.asarray(rows, np.int64).reshape(n, 5) if n else np.zeros((0, 5), np.int64)
+    deleted = np.zeros(n, bool)
+    for peer, start, end in deletes:
+        for ctr in range(start, end):
+            i = id2row.get((peer, ctr))
+            if i is not None:
+                deleted[i] = True
+                a = anchors.get((peer, ctr))
+                if a is not None:
+                    a["deleted"] = True
+    from .columnar import peer_counter_perm
+
+    perm, parent = peer_counter_perm(arr[:, 2], arr[:, 3], arr[:, 0])
+    inv = np.empty(n, np.int64)
+    inv[perm] = np.arange(n)
+    seq = SeqColumns(
+        parent=parent.astype(np.int32),
+        side=arr[perm, 1].astype(np.int32),
+        peer=arr[perm, 2].astype(np.int32),
+        counter=arr[perm, 3].astype(np.int32),
+        deleted=deleted[perm],
+        content=arr[perm, 4].astype(np.int32),
+        valid=np.ones(n, bool),
+    )
+    # pairs: start anchor (p,c) + end anchor (p,c+1)
+    pairs = []
+    for (peer, ctr), a in anchors.items():
+        if not a["start"]:
+            continue
+        end = anchors.get((peer, ctr + 1))
+        if end is None or end["start"]:
+            continue  # unpaired (mid-transfer); inactive
+        active = not a["deleted"] and not end["deleted"]
+        pairs.append(
+            (
+                inv[a["row"]],
+                inv[end["row"]],
+                a["key"],
+                a["value"],
+                a["lamport"],
+                a["peer"],
+                active,
+            )
+        )
+    pp = len(pairs)
+    parr = np.asarray(pairs, np.int64).reshape(pp, 7) if pp else np.zeros((0, 7), np.int64)
+    cols = RichtextCols(
+        seq=seq,
+        pair_start=parr[:, 0].astype(np.int32),
+        pair_end=parr[:, 1].astype(np.int32),
+        pair_key=parr[:, 2].astype(np.int32),
+        pair_value=parr[:, 3].astype(np.int32),
+        pair_lamport=parr[:, 4].astype(np.int32),
+        pair_peer=parr[:, 5].astype(np.int32),
+        pair_valid=parr[:, 6].astype(bool),
+    )
+    return cols, keys, values

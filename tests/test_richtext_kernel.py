@@ -1,0 +1,183 @@
+"""Differential tests: device richtext merge vs host TextState."""
+import random
+
+import numpy as np
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.ops.richtext_batch import RichtextCols, extract_richtext, richtext_merge_doc
+
+
+def _device_richtext(doc):
+    import jax.numpy as jnp
+
+    from loro_tpu.ops.fugue_batch import SeqColumns
+
+    from loro_tpu.ops.fugue_batch import pad_bucket, pad_seq_columns
+
+    doc.commit()
+    cid = doc.get_text("t").id
+    cols, keys, values = extract_richtext(doc.oplog.changes_in_causal_order(), cid)
+    if cols.seq.parent.shape[0] == 0:
+        return []
+    n_keys = 4  # fixed for jit-cache sharing across seeds
+    assert len(keys) <= n_keys
+    n = pad_bucket(cols.seq.parent.shape[0])
+    p = pad_bucket(max(1, cols.pair_start.shape[0]), floor=16)
+
+    def padp(a, fill):
+        out = np.full(p, fill, a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    seq = pad_seq_columns(cols.seq, n)
+    dc = RichtextCols(
+        seq=SeqColumns(*[jnp.asarray(a) for a in seq]),
+        pair_start=jnp.asarray(padp(cols.pair_start, 0)),
+        pair_end=jnp.asarray(padp(cols.pair_end, 0)),
+        pair_key=jnp.asarray(padp(cols.pair_key, 0)),
+        pair_value=jnp.asarray(padp(cols.pair_value, -1)),
+        pair_lamport=jnp.asarray(padp(cols.pair_lamport, 0)),
+        pair_peer=jnp.asarray(padp(cols.pair_peer, 0)),
+        pair_valid=jnp.asarray(padp(cols.pair_valid, False)),
+    )
+    codes, count, bounds, win = richtext_merge_doc(dc, n_keys)
+    count = int(count)
+    text = "".join(chr(c) for c in np.asarray(codes)[:count])
+    bounds = np.asarray(bounds)
+    win = np.asarray(win)
+    segs = []
+    for r in range(len(bounds) - 1):
+        lo, hi = int(bounds[r]), int(bounds[r + 1])
+        if lo >= hi:
+            continue
+        attrs = {}
+        for k in range(len(keys)):
+            vi = int(win[r, k])
+            if vi >= 0:
+                attrs[keys[k]] = values[vi]
+        seg = {"insert": text[lo:hi]}
+        if attrs:
+            seg["attributes"] = attrs
+        if segs and segs[-1].get("attributes") == seg.get("attributes"):
+            segs[-1]["insert"] += seg["insert"]
+        else:
+            segs.append(seg)
+    return segs
+
+
+class TestRichtextKernel:
+    def test_basic_mark(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(0, 5, "bold", True)
+        assert _device_richtext(doc) == t.get_richtext_value()
+
+    def test_unmark_and_overlap(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "abcdefgh")
+        t.mark(0, 6, "bold", True)
+        t.unmark(2, 4, "bold")
+        t.mark(3, 8, "color", "red")
+        assert _device_richtext(doc) == t.get_richtext_value()
+
+    def test_concurrent_marks(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "shared text here")
+        b.import_(a.export_snapshot())
+        a.get_text("t").mark(0, 10, "color", "red")
+        b.get_text("t").mark(5, 16, "color", "blue")
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        assert a.get_text("t").get_richtext_value() == b.get_text("t").get_richtext_value()
+        assert _device_richtext(a) == a.get_text("t").get_richtext_value()
+
+    def test_edits_inside_marks(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(0, 5, "bold", True)
+        t.insert(3, "XX")  # inside the bold range
+        t.delete(8, 2)
+        assert _device_richtext(doc) == t.get_richtext_value()
+
+    def test_winner_selection_large_lamport_and_peer(self):
+        """Regression: (lamport, peer) winner must not be packed into one
+        int32 (review finding) — large magnitudes must still order like
+        the host's tuple comparison."""
+        import jax.numpy as jnp
+
+        from loro_tpu.ops.fugue_batch import SeqColumns
+
+        n = 8  # 4 chars + 2 anchor pairs
+        # elements: chars c0..c3 then two start/end pairs around all chars
+        parent = np.array([-1, 0, 1, 2, -1, 3, -1, 3], np.int32)
+        side = np.array([1, 1, 1, 1, 0, 1, 0, 1], np.int32)
+        peer = np.array([0, 0, 0, 0, 1, 1, 2, 2], np.int32)
+        counter = np.array([0, 1, 2, 3, 0, 1, 0, 1], np.int32)
+        content = np.array([97, 98, 99, 100, -1, -1, -1, -1], np.int32)
+        seq = SeqColumns(
+            parent=parent,
+            side=side,
+            peer=peer,
+            counter=counter,
+            deleted=np.zeros(n, bool),
+            content=content,
+            valid=np.ones(n, bool),
+        )
+        # pair A: lamport 5, peer_rank 300 (value 0); pair B: lamport 6,
+        # peer_rank 0 (value 1).  Host tuple order: B wins (6 > 5).
+        cols = RichtextCols(
+            seq=SeqColumns(*[jnp.asarray(a) for a in seq]),
+            pair_start=jnp.asarray(np.array([4, 6], np.int32)),
+            pair_end=jnp.asarray(np.array([5, 7], np.int32)),
+            pair_key=jnp.asarray(np.array([0, 0], np.int32)),
+            pair_value=jnp.asarray(np.array([0, 1], np.int32)),
+            pair_lamport=jnp.asarray(np.array([5, 6], np.int32)),
+            pair_peer=jnp.asarray(np.array([300, 0], np.int32)),
+            pair_valid=jnp.asarray(np.ones(2, bool)),
+        )
+        _, _, _, win = richtext_merge_doc(cols, 1)
+        winners = {int(v) for v in np.asarray(win)[:, 0] if int(v) >= 0}
+        assert winners == {1}, "higher lamport must beat higher peer"
+        # huge lamport must not overflow
+        cols2 = cols._replace(pair_lamport=jnp.asarray(np.array([1 << 24, 5], np.int32)))
+        _, _, _, win2 = richtext_merge_doc(cols2, 1)
+        winners2 = {int(v) for v in np.asarray(win2)[:, 0] if int(v) >= 0}
+        assert winners2 == {0}
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_differential(self, seed):
+        rng = random.Random(seed)
+        docs = [LoroDoc(peer=i + 1) for i in range(2)]
+        keys = ["bold", "italic", "color"]
+        for _ in range(60):
+            d = rng.choice(docs)
+            t = d.get_text("t")
+            r = rng.random()
+            if len(t) == 0 or r < 0.45:
+                t.insert(rng.randint(0, len(t)), rng.choice(["ab", "xyz", "m"]))
+            elif r < 0.6:
+                pos = rng.randint(0, len(t) - 1)
+                t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+            elif len(t) >= 2:
+                s = rng.randint(0, len(t) - 2)
+                e = rng.randint(s + 1, len(t))
+                k = rng.choice(keys)
+                if rng.random() < 0.3:
+                    t.unmark(s, e, k)
+                else:
+                    t.mark(s, e, k, rng.choice([True, "red", 7]))
+            if rng.random() < 0.3:
+                s, d2 = rng.sample(docs, 2)
+                d2.import_(s.export_updates(d2.oplog_vv()))
+        for _ in range(2):
+            for s in docs:
+                for d2 in docs:
+                    if s is not d2:
+                        d2.import_(s.export_updates(d2.oplog_vv()))
+        host = docs[0].get_text("t").get_richtext_value()
+        assert docs[1].get_text("t").get_richtext_value() == host
+        assert _device_richtext(docs[0]) == host, f"seed {seed}"

@@ -105,13 +105,15 @@ class MovableListState(ContainerState):
         parent = _resolve_run_cont(c.parent, peer, op.counter)
         _, slots = self.seq.integrate_insert(peer, op.counter, parent, c.side, [c.elem], lamport)
         new_slot = slots[0]
+        # hide immediately: event positions below must be computed on a
+        # state that does NOT yet contain the destination slot (the diff
+        # is delete-then-insert; the winner case re-shows it)
+        self.seq.set_visible(new_slot, 0)
         if entry is None:
-            self.seq.set_visible(new_slot, 0)  # unknown element (trimmed history)
-            return None
+            return None  # unknown element (trimmed history)
         new_key = (lamport, peer)
         if new_key <= entry.pos_key:
-            self.seq.set_visible(new_slot, 0)  # stale move: invisible slot
-            return None
+            return None  # stale move: slot stays invisible
         d = Delta()
         # hide old winning slot
         old = self.seq.by_id.get((entry.slot.peer, entry.slot.counter))

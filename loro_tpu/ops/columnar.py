@@ -209,8 +209,9 @@ def extract_map_ops(changes: Sequence[Change]) -> MapExtract:
 
 def peer_counter_perm(peer: np.ndarray, counter: np.ndarray, parent: np.ndarray):
     """Shared (peer, counter)-ordering plumbing for extractors: returns
-    (perm, remapped_parent) where parent indexes are rewritten through
-    the permutation (the fugue_order input contract)."""
+    (perm, inv, remapped_parent) where parent indexes are rewritten
+    through the permutation (the fugue_order input contract); `inv` maps
+    old row -> new row for remapping any other row references."""
     n = len(peer)
     perm = np.lexsort((counter, peer)) if n else np.zeros(0, np.int64)
     inv = np.empty(n, np.int64)
@@ -218,7 +219,7 @@ def peer_counter_perm(peer: np.ndarray, counter: np.ndarray, parent: np.ndarray)
     out_parent = np.asarray(parent)[perm].astype(np.int64)
     mask = out_parent >= 0
     out_parent[mask] = inv[out_parent[mask]]
-    return perm, out_parent.astype(np.int32)
+    return perm, inv, out_parent.astype(np.int32)
 
 
 def extract_seq_from_payload(payload: bytes, cid: ContainerID) -> Optional[SeqExtract]:
@@ -304,13 +305,21 @@ class ChainExtract:
         return int(self.parent.shape[0])
 
 
-def chain_columns(ex: SeqExtract, pad_n: Optional[int] = None, pad_c: Optional[int] = None):
-    """Padded numpy ChainColumns for the chain-contracted device path."""
-    from .fugue_batch import ChainColumns
+def chain_columns(
+    ex: SeqExtract, pad_n: Optional[int] = None, pad_c: Optional[int] = None, bucket: bool = False
+):
+    """Padded numpy ChainColumns for the chain-contracted device path.
+    With bucket=True, both dims pad to power-of-two buckets (shares the
+    jit cache across varying sizes) without a separate contract pass."""
+    from .fugue_batch import ChainColumns, pad_bucket
 
     ch = contract_chains(ex)
-    n = pad_n or ex.n
-    c = pad_c or ch.n_chains
+    if bucket:
+        n = pad_n or pad_bucket(max(1, ex.n))
+        c = pad_c or pad_bucket(max(1, ch.n_chains))
+    else:
+        n = pad_n or ex.n
+        c = pad_c or ch.n_chains
 
     def pad(a, size, fill):
         if a.shape[0] == size:

@@ -42,7 +42,12 @@ class MovableCols(NamedTuple):
 
 
 def movable_merge_doc(cols: MovableCols, n_elems: int) -> Tuple[jax.Array, jax.Array]:
-    """Returns (ordered value indexes i32[S] padded with -1, count)."""
+    """Returns (ordered value indexes i32[S] padded with -1, count).
+
+    CONTRACT: every element index in cols (seq.content, set_elem) must
+    be < n_elems — larger indexes are silently clamped into the dump
+    slot by XLA scatter semantics.  Callers must size/assert n_elems
+    host-side (see extract_movable's elems list)."""
     seq = cols.seq
     s = seq.parent.shape[0]
     elem = jnp.where(seq.valid, seq.content, n_elems)  # pads -> dump elem
@@ -176,7 +181,7 @@ def extract_movable(changes, cid):
                 deleted[i] = True
     from .columnar import peer_counter_perm
 
-    perm, parent = peer_counter_perm(arr[:, 2], arr[:, 3], arr[:, 0])
+    perm, _inv, parent = peer_counter_perm(arr[:, 2], arr[:, 3], arr[:, 0])
     k = len(sets)
     sarr = np.asarray(sets, np.int64).reshape(k, 4) if k else np.zeros((0, 4), np.int64)
     seq = SeqColumns(

@@ -190,9 +190,7 @@ def extract_richtext(changes, cid):
                     a["deleted"] = True
     from .columnar import peer_counter_perm
 
-    perm, parent = peer_counter_perm(arr[:, 2], arr[:, 3], arr[:, 0])
-    inv = np.empty(n, np.int64)
-    inv[perm] = np.arange(n)
+    perm, inv, parent = peer_counter_perm(arr[:, 2], arr[:, 3], arr[:, 0])
     seq = SeqColumns(
         parent=parent.astype(np.int32),
         side=arr[perm, 1].astype(np.int32),

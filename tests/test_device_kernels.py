@@ -114,13 +114,12 @@ def _device_text_chains(doc):
     """Chain-contracted device path (bucket-padded for jit reuse)."""
     import jax.numpy as jnp
 
-    from loro_tpu.ops.columnar import chain_columns, contract_chains
-    from loro_tpu.ops.fugue_batch import ChainColumns, chain_materialize, pad_bucket
+    from loro_tpu.ops.columnar import chain_columns
+    from loro_tpu.ops.fugue_batch import ChainColumns, chain_materialize
 
     changes = _changes_of(doc)
     ex = extract_seq_container(changes, doc.get_text("t").id)
-    nc = contract_chains(ex).n_chains
-    cols = chain_columns(ex, pad_n=pad_bucket(ex.n), pad_c=pad_bucket(max(1, nc)))
+    cols = chain_columns(ex, bucket=True)
     cols = ChainColumns(*[jnp.asarray(a) for a in cols])
     codes, count = chain_materialize(cols)
     return "".join(chr(c) for c in np.asarray(codes)[: int(count)])

@@ -1,0 +1,122 @@
+"""Event-consistency fuzzer: a mirror driven ONLY by events must track
+the real document values (reference: crates/fuzz local_events.rs —
+event streams are the UI contract; positions/deltas must be exact)."""
+import random
+
+import pytest
+
+from loro_tpu import CounterDiff, Delta, LoroDoc, MapDiff
+
+
+class Mirror:
+    """Replays DocDiff events onto plain Python values."""
+
+    def __init__(self, doc: LoroDoc):
+        self.values = {}
+        self.doc = doc
+        doc.subscribe_root(self.on_event)
+
+    def on_event(self, ev) -> None:
+        for cd in ev.diffs:
+            cid = cd.id
+            d = cd.diff
+            if isinstance(d, Delta):
+                if cid.ctype.name == "Text":
+                    cur = self.values.get(cid, "")
+                    self.values[cid] = d.apply_to_text(cur)
+                else:
+                    cur = self.values.get(cid, [])
+                    self.values[cid] = d.apply_to_list(list(cur))
+            elif isinstance(d, MapDiff):
+                cur = dict(self.values.get(cid, {}))
+                cur.update(d.updated)
+                for k in d.deleted:
+                    cur.pop(k, None)
+                self.values[cid] = cur
+            elif isinstance(d, CounterDiff):
+                self.values[cid] = self.values.get(cid, 0.0) + d.delta
+
+    def assert_matches(self) -> None:
+        for cid, mirrored in self.values.items():
+            st = self.doc.state.get(cid)
+            if st is None:
+                continue
+            actual = st.get_value()
+            if cid.ctype.name == "Text":
+                assert mirrored == actual, f"text mirror diverged for {cid}"
+            elif cid.ctype.name in ("List", "MovableList"):
+                assert list(mirrored) == actual, f"list mirror diverged for {cid}"
+            elif cid.ctype.name == "Map":
+                assert mirrored == actual, f"map mirror diverged for {cid}"
+            elif cid.ctype.name == "Counter":
+                assert abs(mirrored - actual) < 1e-9, f"counter mirror diverged"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_event_mirror_consistency(seed):
+    rng = random.Random(seed)
+    a = LoroDoc(peer=1)
+    b = LoroDoc(peer=2)
+    mirror = Mirror(a)
+    for step in range(120):
+        r = rng.random()
+        d = a if r < 0.6 else b
+        kind = rng.randint(0, 3)
+        if kind == 0:
+            t = d.get_text("text")
+            if len(t) and rng.random() < 0.35:
+                pos = rng.randint(0, len(t) - 1)
+                t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+            else:
+                t.insert(rng.randint(0, len(t)), rng.choice(["ab", "X", "123"]))
+        elif kind == 1:
+            l = d.get_list("list")
+            if len(l) and rng.random() < 0.3:
+                l.delete(rng.randint(0, len(l) - 1), 1)
+            else:
+                l.insert(rng.randint(0, len(l)), rng.randint(0, 9))
+        elif kind == 2:
+            m = d.get_map("map")
+            if rng.random() < 0.25:
+                m.delete(rng.choice("xyz"))
+            else:
+                m.set(rng.choice("xyz"), rng.randint(0, 99))
+        else:
+            d.get_counter("cnt").increment(1)
+        d.commit()
+        if rng.random() < 0.35:
+            # exchange updates in both directions; a's import emits events
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+            mirror.assert_matches()
+    a.import_(b.export_updates(a.oplog_vv()))
+    mirror.assert_matches()
+
+
+def test_movable_list_event_mirror():
+    rng = random.Random(42)
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    mirror = Mirror(a)
+    a.get_movable_list("ml").push("a", "b", "c")
+    a.commit()
+    b.import_(a.export_snapshot())
+    for _ in range(60):
+        d = rng.choice([a, b])
+        ml = d.get_movable_list("ml")
+        n = len(ml)
+        r = rng.random()
+        if n == 0 or r < 0.3:
+            ml.insert(rng.randint(0, n), rng.randint(0, 9))
+        elif r < 0.55:
+            ml.move(rng.randint(0, n - 1), rng.randint(0, n - 1))
+        elif r < 0.8:
+            ml.set(rng.randint(0, n - 1), rng.randint(10, 19))
+        else:
+            ml.delete(rng.randint(0, n - 1), 1)
+        d.commit()
+        if rng.random() < 0.4:
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+            mirror.assert_matches()
+    a.import_(b.export_updates(a.oplog_vv()))
+    mirror.assert_matches()

@@ -42,6 +42,7 @@ def _device_values(doc):
         set_value=jnp.asarray(padset(cols.set_value, 0)),
         set_valid=jnp.asarray(padset(cols.set_valid, False)),
     )
+    assert len(elems) <= 4096  # kernel contract: indexes < n_elems
     out, count = movable_merge_doc(cols, 4096)
     out = np.asarray(out)[: int(count)]
     return [values[i] if i >= 0 else None for i in out]

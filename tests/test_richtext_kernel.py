@@ -11,9 +11,7 @@ from loro_tpu.ops.richtext_batch import RichtextCols, extract_richtext, richtext
 def _device_richtext(doc):
     import jax.numpy as jnp
 
-    from loro_tpu.ops.fugue_batch import SeqColumns
-
-    from loro_tpu.ops.fugue_batch import pad_bucket, pad_seq_columns
+    from loro_tpu.ops.fugue_batch import SeqColumns, pad_bucket, pad_seq_columns
 
     doc.commit()
     cid = doc.get_text("t").id

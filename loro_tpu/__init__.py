@@ -42,6 +42,9 @@ from .models.handlers import (
     TextHandler,
     TreeHandler,
 )
+from .awareness import Awareness, EphemeralStore
+from .cursor import AbsolutePosition, Cursor, CursorSide, get_cursor, get_cursor_pos
+from .undo import UndoManager
 
 __version__ = "0.1.0"
 
@@ -82,4 +85,12 @@ __all__ = [
     "TreeHandler",
     "CounterHandler",
     "Handler",
+    "UndoManager",
+    "Cursor",
+    "CursorSide",
+    "AbsolutePosition",
+    "get_cursor",
+    "get_cursor_pos",
+    "Awareness",
+    "EphemeralStore",
 ]

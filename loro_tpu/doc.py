@@ -189,7 +189,12 @@ class LoroDoc:
         self._txn = None
         self.oplog.import_local_change(change)
         self.state.vv.extend_to_include(change.id_span())
-        self.state.frontiers = self.oplog.frontiers
+        if self._detached:
+            # stay on the branch: state head is this change, not the
+            # merged oplog frontiers
+            self.state.frontiers = Frontiers([change.last_id()])
+        else:
+            self.state.frontiers = self.oplog.frontiers
         if change.peer not in self._seen_peers:
             self._seen_peers.add(change.peer)
             for cb in self._first_commit_from_peer_subs:
@@ -583,6 +588,10 @@ class LoroDoc:
     # time travel
     # ------------------------------------------------------------------
     def checkout_to_latest(self) -> None:
+        self.commit()
+        if not self._detached and self.state.frontiers == self.oplog.frontiers:
+            return  # already attached at head (reference loro.rs:1543
+            # early-returns and must not renew the peer id)
         self.checkout(self.oplog.frontiers)
         self._detached = False
 
@@ -612,6 +621,12 @@ class LoroDoc:
         # checkout always detaches (reference loro.rs:1625); only
         # checkout_to_latest re-attaches
         self._detached = True
+        if self.config.editable_detached_mode:
+            # a peer's ops must stay a counter prefix (VersionVector
+            # representability); branch edits therefore need a fresh
+            # peer id — same behavior as the reference's editable
+            # detached mode
+            self.set_peer_id(random.getrandbits(63))
         if record:
             diffs = self._value_level_diffs(old_values)
             if diffs:
@@ -745,6 +760,73 @@ class LoroDoc:
 
     def get_deep_value(self) -> Dict[str, Any]:
         return self.state.get_deep_value()
+
+    def get_by_str_path(self, path: str):
+        """Navigate "container/key/index/..." to a handler or value
+        (reference: loro.rs get_by_str_path)."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise LoroError("empty path")
+        cur: Any = None
+        for i, part in enumerate(parts):
+            if i == 0:
+                candidates = [
+                    cid for cid in self.state.states if cid.is_root and cid.name == part
+                ]
+                if not candidates:
+                    return None
+                cur = self.get_container(candidates[0])
+                continue
+            if not hasattr(cur, "get"):
+                return None
+            from .models.handlers import ListHandler, MovableListHandler
+
+            if isinstance(cur, (ListHandler, MovableListHandler)):
+                try:
+                    idx = int(part)
+                except ValueError:
+                    return None  # list segments must be numeric
+                if idx < 0 or idx >= len(cur):
+                    return None
+                cur = cur.get(idx)
+            else:  # map: keys are strings (numeric-looking keys stay strings)
+                cur = cur.get(part)
+            if cur is None:
+                return None
+        return cur
+
+    # -- history inspection (reference: change meta APIs) --------------
+    def len_changes(self) -> int:
+        return self.oplog.total_changes()
+
+    def get_change(self, id: ID) -> Optional[Dict[str, Any]]:
+        """Change metadata covering `id` (reference: ChangeMeta)."""
+        ch = self.oplog.change_at(id)
+        if ch is None:
+            return None
+        return {
+            "id": ch.id,
+            "peer": ch.peer,
+            "lamport": ch.lamport,
+            "timestamp": ch.timestamp,
+            "deps": ch.deps,
+            "len": ch.atom_len(),
+            "message": ch.message,
+        }
+
+    def get_changed_containers_in(self, id: ID, length: int) -> set:
+        """Container ids touched by ops in [id, id+len)."""
+        out = set()
+        ch = self.oplog.change_at(id)
+        while ch is not None and ch.ctr_start < id.counter + length:
+            for op in ch.ops:
+                if op.ctr_end > id.counter and op.counter < id.counter + length:
+                    out.add(op.container)
+            nxt = ID(id.peer, ch.ctr_end)
+            if nxt.counter >= id.counter + length:
+                break
+            ch = self.oplog.change_at(nxt)
+        return out
 
     def diagnose_size(self) -> Dict[str, int]:
         return self.oplog.diagnose_size()

@@ -26,7 +26,11 @@ class Transaction:
         self.start_counter = doc.oplog.next_counter(doc.peer)
         self.next_counter = self.start_counter
         self.start_lamport = doc.oplog.next_lamport
-        self.deps: Frontiers = doc.oplog.frontiers
+        # detached-editable docs branch from the *state* version, not the
+        # oplog head (reference: editable_detached_mode forks history)
+        self.deps: Frontiers = (
+            doc.state.frontiers if doc.is_detached() else doc.oplog.frontiers
+        )
         self.start_frontiers: Frontiers = doc.state.frontiers
         self.ops: List[Op] = []
         self.diffs: Dict[ContainerID, List[Diff]] = {}

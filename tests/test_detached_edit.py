@@ -1,0 +1,85 @@
+"""Editable detached mode: branching history from an old version
+(reference: configure.rs editable_detached_mode + one_doc_fuzzer's
+branch/merge-on-one-doc pattern)."""
+import random
+
+import pytest
+
+from loro_tpu import Frontiers, LoroDoc
+
+
+def make_editable(doc: LoroDoc) -> LoroDoc:
+    doc.config.editable_detached_mode = True
+    return doc
+
+
+class TestEditableDetached:
+    def test_branch_and_merge(self):
+        doc = make_editable(LoroDoc(peer=1))
+        t = doc.get_text("t")
+        t.insert(0, "main1 ")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.insert(6, "main2")
+        doc.commit()
+        doc.checkout(f1)  # detached at "main1 "
+        assert doc.get_text("t").to_string() == "main1 "
+        doc.get_text("t").insert(6, "branch")  # edit the old version
+        doc.commit()
+        assert doc.get_text("t").to_string() == "main1 branch"
+        # re-attach: both lines merge
+        doc.checkout_to_latest()
+        s = doc.get_text("t").to_string()
+        assert "main2" in s and "branch" in s
+        assert s.startswith("main1 ")
+
+    def test_branch_syncs_to_peer(self):
+        a = make_editable(LoroDoc(peer=1))
+        b = LoroDoc(peer=2)
+        a.get_text("t").insert(0, "base")
+        a.commit()
+        f = a.oplog_frontiers()
+        a.get_text("t").insert(4, "-later")
+        a.commit()
+        a.checkout(f)
+        a.get_text("t").insert(4, "+fork")
+        a.commit()
+        a.checkout_to_latest()
+        b.import_(a.export_snapshot())
+        assert b.get_text("t").to_string() == a.get_text("t").to_string()
+
+    def test_deep_branching_fuzz(self):
+        rng = random.Random(5)
+        doc = make_editable(LoroDoc(peer=1))
+        frontier_pool = []
+        for step in range(60):
+            t = doc.get_text("t")
+            if len(t) and rng.random() < 0.3:
+                pos = rng.randint(0, len(t) - 1)
+                t.delete(pos, min(rng.randint(1, 2), len(t) - pos))
+            else:
+                t.insert(rng.randint(0, len(t)), rng.choice("abc"))
+            doc.commit()
+            frontier_pool.append(doc.state_frontiers())
+            if rng.random() < 0.25 and frontier_pool:
+                doc.checkout(rng.choice(frontier_pool))
+            if rng.random() < 0.3:
+                doc.checkout_to_latest()
+        doc.checkout_to_latest()
+        # the doc replays identically into a fresh replica
+        b = LoroDoc(peer=2)
+        b.import_(doc.export_updates())
+        assert b.get_text("t").to_string() == doc.get_text("t").to_string()
+
+    def test_default_mode_still_raises(self):
+        from loro_tpu import LoroError
+
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "x")
+        doc.commit()
+        f = doc.oplog_frontiers()
+        doc.get_text("t").insert(1, "y")
+        doc.commit()
+        doc.checkout(f)
+        with pytest.raises(LoroError):
+            doc.get_text("t").insert(0, "nope")

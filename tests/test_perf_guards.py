@@ -1,0 +1,67 @@
+"""Anti-quadratic perf guards (reference: crates/loro/tests/
+perf_import_quadratic.rs + perf_text_insert_quadratic.rs — asserting
+scaling shape, not absolute numbers)."""
+import time
+
+import pytest
+
+from loro_tpu import LoroDoc
+
+
+def _time_text_insert(n: int) -> float:
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("t")
+    t0 = time.perf_counter()
+    for i in range(n):
+        t.insert(i, "x")
+    doc.commit()
+    return time.perf_counter() - t0
+
+
+def _time_import(n_updates: int) -> float:
+    a = LoroDoc(peer=1)
+    blobs = []
+    t = a.get_text("t")
+    for i in range(n_updates):
+        vv = a.oplog_vv()
+        t.insert(len(t), f"w{i} ")
+        a.commit()
+        blobs.append(a.export_updates(vv))
+    b = LoroDoc(peer=2)
+    t0 = time.perf_counter()
+    for blob in blobs:
+        b.import_(blob)
+    return time.perf_counter() - t0
+
+
+def _median3(fn, *args) -> float:
+    return sorted(fn(*args) for _ in range(3))[1]
+
+
+def test_text_insert_not_quadratic():
+    small = max(_median3(_time_text_insert, 2000), 1e-4)
+    big = _median3(_time_text_insert, 8000)
+    # 4x work: quadratic would be ~16x; n log n with noise stays well under
+    assert big / small < 10, f"text insert scaling {big/small:.1f}x for 4x work"
+
+
+def test_import_not_quadratic():
+    small = max(_median3(_time_import, 100), 1e-4)
+    big = _median3(_time_import, 400)
+    assert big / small < 10, f"import scaling {big/small:.1f}x for 4x work"
+
+
+def test_checkout_bounded():
+    """Checkout cost stays proportional to history, not history^2."""
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("t")
+    fs = []
+    for i in range(300):
+        t.insert(len(t), "ab")
+        doc.commit()
+        fs.append(doc.oplog_frontiers())
+    t0 = time.perf_counter()
+    doc.checkout(fs[10])
+    doc.checkout_to_latest()
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"checkout round-trip took {dt:.2f}s"

@@ -25,7 +25,7 @@ layer (parallel/fleet.py) shards the doc axis over the device mesh.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -75,9 +75,18 @@ def fugue_order(cols: SeqColumns) -> jax.Array:
     return _order_core(cols.parent, cols.side, cols.valid)
 
 
-def _order_core(parent_in: jax.Array, side_in: jax.Array, valid_in: jax.Array) -> jax.Array:
+def _order_core(
+    parent_in: jax.Array,
+    side_in: jax.Array,
+    valid_in: jax.Array,
+    sib_keys: Optional[Tuple[jax.Array, ...]] = None,
+) -> jax.Array:
     """Euler-tour in-order ranking over generic node arrays (element- or
-    chain-level).  Input contract as in fugue_order."""
+    chain-level).  Without `sib_keys`, rows must obey the (peer, counter)
+    order contract (fugue_order); with `sib_keys` (e.g. peer_hi, peer_lo,
+    counter arrays) sibling order comes from an explicit lexsort instead
+    — row order becomes irrelevant, which the incremental/append path
+    needs (appended rows land at the end of the buffer)."""
     n = parent_in.shape[0]
     n1 = n + 1
     root = n  # virtual root element index
@@ -89,10 +98,16 @@ def _order_core(parent_in: jax.Array, side_in: jax.Array, valid_in: jax.Array) -
     side = jnp.concatenate([side_in.astype(jnp.int32), jnp.array([1], jnp.int32)])
     valid = jnp.concatenate([valid_in, jnp.array([False])])  # root not a child
 
-    # -- sibling groups: ONE stable sort by (parent, side); (peer,
-    # counter) order within groups comes from the input contract -------
     key = jnp.where(parent < big, parent * 2 + side, big)
-    order = jnp.argsort(key, stable=True).astype(jnp.int32)
+    if sib_keys is None:
+        # ONE stable sort by (parent, side); (peer, counter) order within
+        # groups comes from the input row-order contract
+        order = jnp.argsort(key, stable=True).astype(jnp.int32)
+    else:
+        minor = [
+            jnp.concatenate([k.astype(jnp.uint32), jnp.zeros(1, jnp.uint32)]) for k in sib_keys
+        ]
+        order = jnp.lexsort(tuple(reversed(minor)) + (key,)).astype(jnp.int32)
     p_s = parent[order]
     s_s = side[order]
     prev_same = (p_s == jnp.roll(p_s, 1)) & (s_s == jnp.roll(s_s, 1))
@@ -182,17 +197,13 @@ def visible_order(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
     return perm.astype(jnp.int32), visible.sum().astype(jnp.int32)
 
 
-def materialize_content(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
-    """Gather content codes of visible elements in document order.
-    Returns (codes i32[N] with tail padding = -1, count).
-
-    Sort-free compaction: ranks are unique values < m = 3*(N+1), so a
-    scatter into an m-bucket histogram + exclusive cumsum yields each
-    visible element's final position directly."""
-    n = cols.parent.shape[0]
-    rank, _ = _visit_dist(cols)
+def _compact(rank: jax.Array, visible: jax.Array, content: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Sort-free compaction shared by both element-table layouts: ranks
+    are unique values < m = 3*(N+1), so a scatter into an m-bucket
+    histogram + exclusive cumsum yields each visible element's final
+    position directly; invisible rows scatter out of range (dropped)."""
+    n = rank.shape[0]
     m = 3 * (n + 1)
-    visible = cols.valid & ~cols.deleted
     rk = jnp.clip(rank, 0, m - 1)
     hist = jnp.zeros(m, jnp.int32).at[jnp.where(visible, rk, m - 1)].add(
         visible.astype(jnp.int32)
@@ -200,11 +211,57 @@ def materialize_content(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
     pos_of_rank = jnp.cumsum(hist) - hist  # exclusive prefix sum
     pos = pos_of_rank[rk]
     count = visible.sum().astype(jnp.int32)
-    # invisible rows target index n -> dropped (no collisions)
     codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
-        cols.content, mode="drop"
+        content, mode="drop"
     )
     return codes, count
+
+
+def materialize_content(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """Gather content codes of visible elements in document order.
+    Returns (codes i32[N] with tail padding = -1, count)."""
+    rank, _ = _visit_dist(cols)
+    return _compact(rank, cols.valid & ~cols.deleted, cols.content)
+
+
+class SeqColumnsU(NamedTuple):
+    """Row-order-free element table for the incremental/append path:
+    peers carried as explicit u64 halves so sibling order needs no
+    batch-wide rank dictionary and appended rows may sit anywhere."""
+
+    parent: jax.Array  # i32[N]
+    side: jax.Array  # i32[N]
+    peer_hi: jax.Array  # u32[N]
+    peer_lo: jax.Array  # u32[N]
+    counter: jax.Array  # i32[N] (non-negative)
+    deleted: jax.Array  # bool[N]
+    content: jax.Array  # i32[N]
+    valid: jax.Array  # bool[N]
+
+
+def fugue_order_u(cols: SeqColumnsU) -> jax.Array:
+    return _order_core(
+        cols.parent,
+        cols.side,
+        cols.valid,
+        sib_keys=(cols.peer_hi, cols.peer_lo, cols.counter.astype(jnp.uint32)),
+    )
+
+
+def materialize_content_u(cols: SeqColumnsU) -> Tuple[jax.Array, jax.Array]:
+    """Order + compact for the row-order-free table (content=-1 rows —
+    anchors — are invisible)."""
+    rank = fugue_order_u(cols)
+    visible = cols.valid & ~cols.deleted & (cols.content >= 0)
+    return _compact(rank, visible, cols.content)
+
+
+materialize_content_u_batch = jax.vmap(materialize_content_u)
+
+
+@jax.jit
+def merge_docs_u(cols: SeqColumnsU) -> Tuple[jax.Array, jax.Array]:
+    return materialize_content_u_batch(cols)
 
 
 class ChainColumns(NamedTuple):

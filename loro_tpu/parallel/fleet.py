@@ -174,6 +174,199 @@ class Fleet:
         return out
 
 
+class DeviceDocBatch:
+    """Device-resident document batch with incremental ingest.
+
+    SURVEY.md §7 step 9: "state lives on device for bulk workloads".
+    The element tables stay on device between syncs; each `append` ships
+    only the new rows/tombstones, and `texts()` re-resolves order in one
+    launch.  Uses the row-order-free kernel (SeqColumnsU) because
+    appended rows land in the buffer tail, not in (peer, counter) order.
+    """
+
+    def __init__(self, n_docs: int, capacity: int, mesh=None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_docs = n_docs
+        d_mesh = self.mesh.shape[DOC_AXIS]
+        self.d = ((n_docs + d_mesh - 1) // d_mesh) * d_mesh  # mesh-padded
+        n_docs = self.d
+        self.cap = capacity
+        self.counts = np.zeros(n_docs, np.int64)  # used rows per doc
+        # host-side id -> row resolution per doc
+        self.id2row: List[Dict[Tuple[int, int], int]] = [dict() for _ in range(n_docs)]
+        from ..ops.fugue_batch import SeqColumnsU
+
+        sh = doc_sharding(self.mesh)
+        z = lambda dt, fill: jax.device_put(
+            np.full((n_docs, capacity), fill, dt), sh
+        )
+        self.cols = SeqColumnsU(
+            parent=z(np.int32, -1),
+            side=z(np.int32, 0),
+            peer_hi=z(np.uint32, 0),
+            peer_lo=z(np.uint32, 0),
+            counter=z(np.int32, 0),
+            deleted=z(bool, True),
+            content=z(np.int32, -1),
+            valid=z(bool, False),
+        )
+
+    # ------------------------------------------------------------------
+    def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
+        """Incremental ingest: each doc's new causally-ordered changes
+        (None = no update).  Inserts (chars AND style anchors — anchors
+        are real Fugue nodes other inserts may parent on) become new
+        rows; deletes tombstone rows from any epoch.  All validation and
+        id-map staging happens before any state mutates, so a capacity
+        error leaves the batch untouched.  One device scatter per call."""
+        from ..core.change import SeqDelete, SeqInsert, StyleAnchor
+        from ..ops.fugue_batch import pad_bucket
+        from ..oplog.oplog import _RunCont
+
+        per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
+        rows_per_doc: List[List[Tuple[int, int, int, int, int]]] = []
+        overlays: List[Dict[Tuple[int, int], int]] = []
+        del_pairs: List[Tuple[int, int]] = []
+        for di, changes in enumerate(per_doc_changes):
+            rows: List[Tuple[int, int, int, int, int]] = []  # parent,side,counter,content,peer
+            overlay: Dict[Tuple[int, int], int] = {}  # staged id -> row
+            rows_per_doc.append(rows)
+            overlays.append(overlay)
+            if not changes:
+                continue
+            base = int(self.counts[di])
+            idmap = self.id2row[di]
+
+            def resolve(key, idmap=idmap, overlay=overlay):
+                r = overlay.get(key)
+                return idmap[key] if r is None else r
+
+            for ch in changes:
+                for op in ch.ops:
+                    if op.container != cid:
+                        continue
+                    c = op.content
+                    if isinstance(c, SeqInsert):
+                        body = [c.content] if isinstance(c.content, StyleAnchor) else c.content
+                        for j in range(len(body)):
+                            if j == 0:
+                                if isinstance(c.parent, _RunCont):
+                                    prow = resolve((ch.peer, op.counter - 1))
+                                elif c.parent is None:
+                                    prow = -1
+                                else:
+                                    prow = resolve((c.parent.peer, c.parent.counter))
+                                side = int(c.side)
+                            else:
+                                prow = base + len(rows) - 1
+                                side = 1
+                            overlay[(ch.peer, op.counter + j)] = base + len(rows)
+                            content = -1 if isinstance(body[j], StyleAnchor) else ord(body[j])
+                            rows.append((prow, side, op.counter + j, content, ch.peer))
+                    elif isinstance(c, SeqDelete):
+                        for sp in c.spans:
+                            for ctr in range(sp.start, sp.end):
+                                try:
+                                    del_pairs.append((di, resolve((sp.peer, ctr))))
+                                except KeyError:
+                                    pass  # target outside this batch's history
+
+        max_new = pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16) if any(
+            rows_per_doc
+        ) else 0
+        # validate BEFORE mutating: the scatter window is max_new wide,
+        # so every updated doc needs base + max_new <= capacity
+        # (dynamic_update_slice would silently clamp otherwise)
+        for di, rows in enumerate(rows_per_doc):
+            if rows and int(self.counts[di]) + max_new > self.cap:
+                raise RuntimeError(
+                    f"DeviceDocBatch capacity exceeded for doc {di}: "
+                    f"{self.counts[di]} + {max_new} > {self.cap}"
+                )
+        # commit staged id maps
+        for di, overlay in enumerate(overlays):
+            if overlay:
+                self.id2row[di].update(overlay)
+        if max_new:
+            blk_shape = (self.d, max_new)
+            blk = {
+                "parent": np.full(blk_shape, -1, np.int32),
+                "side": np.zeros(blk_shape, np.int32),
+                "peer_hi": np.zeros(blk_shape, np.uint32),
+                "peer_lo": np.zeros(blk_shape, np.uint32),
+                "counter": np.zeros(blk_shape, np.int32),
+                "deleted": np.ones(blk_shape, bool),
+                "content": np.full(blk_shape, -1, np.int32),
+                "valid": np.zeros(blk_shape, bool),
+            }
+            offsets = np.zeros(self.d, np.int32)
+            for di, rows in enumerate(rows_per_doc):
+                if not rows:
+                    continue
+                k = len(rows)
+                arr = np.asarray([(r[0], r[1], r[2], r[3]) for r in rows], np.int64)
+                pu = np.asarray([r[4] for r in rows], np.uint64)
+                blk["parent"][di, :k] = arr[:, 0]
+                blk["side"][di, :k] = arr[:, 1]
+                blk["peer_hi"][di, :k] = (pu >> np.uint64(32)).astype(np.uint32)
+                blk["peer_lo"][di, :k] = (pu & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                blk["counter"][di, :k] = arr[:, 2]
+                blk["deleted"][di, :k] = False
+                blk["content"][di, :k] = arr[:, 3]
+                blk["valid"][di, :k] = True
+                offsets[di] = int(self.counts[di])
+                self.counts[di] += k
+            sh = doc_sharding(self.mesh)
+            blk_dev = {f: jax.device_put(v, sh) for f, v in blk.items()}
+            self.cols = _scatter_rows(
+                self.cols, blk_dev, jax.device_put(offsets, replicated(self.mesh))
+            )
+        self.mark_deleted(del_pairs)
+
+    def mark_deleted(self, pairs: Sequence[Tuple[int, int]]) -> None:
+        """Tombstone (doc, device_row) pairs (delete ops referencing
+        earlier appends).  Padded to buckets (idempotent repeats of the
+        first pair) to bound retraces."""
+        from ..ops.fugue_batch import pad_bucket
+
+        if not pairs:
+            return
+        k = pad_bucket(len(pairs), floor=16)
+        padded = list(pairs) + [pairs[0]] * (k - len(pairs))
+        d_idx = np.asarray([p[0] for p in padded], np.int32)
+        r_idx = np.asarray([p[1] for p in padded], np.int32)
+        deleted = self.cols.deleted.at[(jnp.asarray(d_idx), jnp.asarray(r_idx))].set(True)
+        self.cols = self.cols._replace(deleted=deleted)
+
+    def resolve_row(self, doc: int, peer: int, counter: int) -> Optional[int]:
+        return self.id2row[doc].get((peer, counter))
+
+    def texts(self) -> List[str]:
+        from ..ops.fugue_batch import merge_docs_u
+
+        codes, counts = merge_docs_u(self.cols)
+        codes = np.asarray(codes)
+        counts = np.asarray(counts)
+        return ["".join(map(chr, codes[i, : counts[i]])) for i in range(self.n_docs)]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(cols, blk, offsets):
+    """Write each doc's new-row block at its per-doc offset (donated
+    update — the old buffer is reused, no [D, N] copy).  Padding rows of
+    a block restore the window's previous values so short updates don't
+    clobber neighbors."""
+
+    def per_field(col, nbl, vbl, off):
+        window = jax.lax.dynamic_slice(col, (off,), (nbl.shape[0],))
+        return jax.lax.dynamic_update_slice(col, jnp.where(vbl, nbl, window), (off,))
+
+    out = {}
+    for f in cols._fields:
+        out[f] = jax.vmap(per_field)(getattr(cols, f), blk[f], blk["valid"], offsets)
+    return type(cols)(**out)
+
+
 @functools.lru_cache(maxsize=32)
 def _lww_batch_fn(mesh, n_slots: int):
     in_sh = NamedSharding(mesh, P(DOC_AXIS))

@@ -1,0 +1,120 @@
+"""DeviceDocBatch: incremental device-resident merge vs host engine."""
+import random
+
+import numpy as np
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.parallel.fleet import DeviceDocBatch
+
+
+def _changes_between(doc, from_vv):
+    doc.commit()
+    return doc.oplog.changes_between(from_vv, doc.oplog_vv())
+
+
+class TestDeviceDocBatch:
+    def test_initial_plus_incremental(self):
+        docs = [LoroDoc(peer=i + 1) for i in range(3)]
+        cid = docs[0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=3, capacity=1024)
+        # epoch 1
+        marks = []
+        for d in docs:
+            d.get_text("t").insert(0, f"doc{d.peer} ")
+            d.commit()
+            marks.append(d.oplog_vv())
+        batch.append_changes([d.oplog.changes_in_causal_order() for d in docs], cid)
+        assert batch.texts() == [d.get_text("t").to_string() for d in docs]
+        # epoch 2: edits referencing epoch-1 elements (incl. deletes)
+        for d in docs:
+            t = d.get_text("t")
+            t.insert(4, "-mid-")
+            t.delete(0, 2)
+        batch.append_changes(
+            [_changes_between(d, mv) for d, mv in zip(docs, marks)], cid
+        )
+        assert batch.texts() == [d.get_text("t").to_string() for d in docs]
+
+    def test_sparse_updates(self):
+        docs = [LoroDoc(peer=10 + i) for i in range(4)]
+        cid = docs[0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=4, capacity=512)
+        for d in docs:
+            d.get_text("t").insert(0, "base")
+            d.commit()
+        batch.append_changes([d.oplog.changes_in_causal_order() for d in docs], cid)
+        marks = [d.oplog_vv() for d in docs]
+        docs[1].get_text("t").insert(4, "!")
+        docs[3].get_text("t").delete(0, 1)
+        updates = [None, _changes_between(docs[1], marks[1]), None, _changes_between(docs[3], marks[3])]
+        batch.append_changes(updates, cid)
+        assert batch.texts() == [d.get_text("t").to_string() for d in docs]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_incremental_fuzz(self, seed):
+        rng = random.Random(seed)
+        n_docs = 3
+        docs = [LoroDoc(peer=i + 1) for i in range(n_docs)]
+        cid = docs[0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=n_docs, capacity=2048)
+        marks = [d.oplog_vv() for d in docs]
+        for epoch in range(5):
+            for d in docs:
+                t = d.get_text("t")
+                for _ in range(rng.randint(1, 10)):
+                    if len(t) and rng.random() < 0.35:
+                        pos = rng.randint(0, len(t) - 1)
+                        t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+                    else:
+                        t.insert(rng.randint(0, len(t)), rng.choice(["ab", "z", "qrs"]))
+            updates = []
+            for i, d in enumerate(docs):
+                chs = _changes_between(d, marks[i])
+                marks[i] = d.oplog_vv()
+                updates.append(chs)
+            batch.append_changes(updates, cid)
+            assert batch.texts() == [
+                d.get_text("t").to_string() for d in docs
+            ], f"seed {seed} epoch {epoch}"
+
+    def test_capacity_guard(self):
+        doc = LoroDoc(peer=1)
+        cid = doc.get_text("t").id
+        doc.get_text("t").insert(0, "x" * 100)
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=64)
+        with pytest.raises(RuntimeError):
+            batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        # failed append leaves the batch untouched (review finding)
+        assert batch.counts[0] == 0 and not batch.id2row[0]
+
+    def test_anchor_parent_resolution(self):
+        """Inserts adjacent to mark boundaries parent on anchor elements
+        (review finding: anchors must register in the id map)."""
+        doc = LoroDoc(peer=1)
+        cid = doc.get_text("t").id
+        t = doc.get_text("t")
+        t.insert(0, "bold text")
+        t.mark(0, 4, "bold", True)
+        t.insert(4, "er")  # lands adjacent to the end anchor
+        t.insert(0, ">")  # adjacent to the start anchor
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=256)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        assert batch.texts() == [t.to_string()]
+
+    def test_incremental_after_marks(self):
+        doc = LoroDoc(peer=1)
+        cid = doc.get_text("t").id
+        t = doc.get_text("t")
+        t.insert(0, "abc")
+        t.mark(0, 3, "bold", True)
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=256)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        mark = doc.oplog_vv()
+        t.insert(3, "d")  # parents on the end-anchor region
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], cid)
+        assert batch.texts() == [t.to_string()]

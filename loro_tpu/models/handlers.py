@@ -93,10 +93,59 @@ class TextHandler(Handler):
     def insert(self, pos: int, s: str) -> None:
         if not s:
             return
-        if pos > len(self._state):
-            raise IndexError(f"insert pos {pos} > len {len(self._state)}")
-        parent, side = self._state.seq.placement_for_visible_pos(pos)
+        st = self._state
+        if pos > len(st):
+            raise IndexError(f"insert pos {pos} > len {len(st)}")
+        if st.n_anchors:
+            parent, side = self._placement_with_expand(pos)
+        else:
+            parent, side = st.seq.placement_for_visible_pos(pos)
         self._apply(SeqInsert(parent, side, s))
+
+    def _placement_with_expand(self, pos: int):
+        """Anchor-aware placement: text typed at a mark boundary inherits
+        the style iff the style's expand behavior says so (reference:
+        ExpandType — "after" (default) grows past the end anchor,
+        "none"/"before" does not; "before"/"both" grow before the start
+        anchor).  Implemented by choosing which boundary anchors the new
+        text lands after."""
+        from ..utils.treap import Treap
+
+        st = self._state
+        styles = self.doc.config.text_style_config
+        if pos == 0:
+            a = None
+            cur = st.seq.treap.first()
+        else:
+            a = st.seq.elem_at(pos - 1)
+            assert a is not None
+            cur = Treap.successor(a)
+        # walk the invisible window (tombstoned chars + anchors) after the
+        # left neighbor: tombstones are style-neutral and stepped over so
+        # anchors beyond them still govern placement (deleting a char at
+        # a mark boundary must not change expand behavior)
+        while cur is not None and cur.vis_w == 0:
+            if getattr(cur, "is_anchor", False) and not cur.deleted:
+                anch: StyleAnchor = cur.content
+                exp = styles.get(anch.key, "after")
+                if anch.is_start:
+                    # range starts here: typing before it inherits only
+                    # for expand "before"/"both" -> step inside
+                    advance = exp in ("before", "both")
+                else:
+                    # range ends here: typing after inherits for
+                    # "after"/"both" -> stay inside (before the anchor)
+                    advance = exp in ("none", "before")
+                if not advance:
+                    break
+            a = cur
+            cur = Treap.successor(cur)
+        if a is None:
+            f = st.seq.treap.first()
+            if f is None:
+                return None, Side.Right
+            return f.id, Side.Left
+        return st.seq._placement_after(a)
 
     def delete(self, pos: int, length: int) -> None:
         if length <= 0:

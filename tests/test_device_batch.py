@@ -52,30 +52,43 @@ class TestDeviceDocBatch:
         assert batch.texts() == [d.get_text("t").to_string() for d in docs]
 
     @pytest.mark.parametrize("seed", range(4))
-    def test_incremental_fuzz(self, seed):
+    def test_incremental_fuzz_multi_peer(self, seed):
+        """Each resident doc is a 2-replica pair with concurrent edits —
+        exercises the u64 (peer_hi, peer_lo) sibling lexsort, which
+        single-peer docs never touch (review finding).  Peer ids span
+        both u32 halves."""
         rng = random.Random(seed)
         n_docs = 3
-        docs = [LoroDoc(peer=i + 1) for i in range(n_docs)]
-        cid = docs[0].get_text("t").id
+        pairs = []
+        for i in range(n_docs):
+            # one small peer id, one > 2^32 (hi half nonzero)
+            a = LoroDoc(peer=i + 1)
+            b = LoroDoc(peer=(1 << 33) + rng.getrandbits(20) + i)
+            pairs.append((a, b))
+        cid = pairs[0][0].get_text("t").id
         batch = DeviceDocBatch(n_docs=n_docs, capacity=2048)
-        marks = [d.oplog_vv() for d in docs]
+        marks = [a.oplog_vv() for a, _ in pairs]
         for epoch in range(5):
-            for d in docs:
-                t = d.get_text("t")
-                for _ in range(rng.randint(1, 10)):
-                    if len(t) and rng.random() < 0.35:
-                        pos = rng.randint(0, len(t) - 1)
-                        t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
-                    else:
-                        t.insert(rng.randint(0, len(t)), rng.choice(["ab", "z", "qrs"]))
+            for a, b in pairs:
+                for d in (a, b):
+                    t = d.get_text("t")
+                    for _ in range(rng.randint(1, 6)):
+                        if len(t) and rng.random() < 0.35:
+                            pos = rng.randint(0, len(t) - 1)
+                            t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+                        else:
+                            t.insert(rng.randint(0, len(t)), rng.choice(["ab", "z", "qrs"]))
+                # merge the pair: concurrent sibling runs now coexist
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
             updates = []
-            for i, d in enumerate(docs):
-                chs = _changes_between(d, marks[i])
-                marks[i] = d.oplog_vv()
+            for i, (a, _) in enumerate(pairs):
+                chs = _changes_between(a, marks[i])
+                marks[i] = a.oplog_vv()
                 updates.append(chs)
             batch.append_changes(updates, cid)
             assert batch.texts() == [
-                d.get_text("t").to_string() for d in docs
+                a.get_text("t").to_string() for a, _ in pairs
             ], f"seed {seed} epoch {epoch}"
 
     def test_capacity_guard(self):

@@ -132,6 +132,75 @@ class TestRichText:
         assert sa[0]["attributes"]["color"] in ("red", "blue")
 
 
+class TestStyleExpand:
+    def test_default_expand_after(self):
+        """Typing at the end of a bold range inherits bold."""
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "bold")
+        t.mark(0, 4, "bold", True)
+        t.insert(4, "er")
+        assert t.get_richtext_value() == [{"insert": "bolder", "attributes": {"bold": True}}]
+
+    def test_expand_none_for_links(self):
+        doc = LoroDoc(peer=1)
+        doc.config.text_style_config["link"] = "none"
+        t = doc.get_text("t")
+        t.insert(0, "site")
+        t.mark(0, 4, "link", "x.com")
+        t.insert(4, "!")
+        segs = t.get_richtext_value()
+        assert segs == [
+            {"insert": "site", "attributes": {"link": "x.com"}},
+            {"insert": "!"},
+        ]
+
+    def test_expand_before(self):
+        doc = LoroDoc(peer=1)
+        doc.config.text_style_config["hl"] = "before"
+        t = doc.get_text("t")
+        t.insert(0, "ab")
+        t.mark(1, 2, "hl", True)
+        t.insert(1, "X")  # typed just before the range start: inherits
+        segs = t.get_richtext_value()
+        assert segs == [
+            {"insert": "a"},
+            {"insert": "Xb", "attributes": {"hl": True}},
+        ]
+
+    def test_expand_through_tombstones(self):
+        """Deleted chars at a mark boundary must not change expand
+        behavior (review finding)."""
+        doc = LoroDoc(peer=1)
+        doc.config.text_style_config["link"] = "none"
+        t = doc.get_text("t")
+        t.insert(0, "site")
+        t.mark(0, 4, "link", "x.com")
+        t.delete(3, 1)  # tombstone 'e' right before the end anchor
+        t.insert(3, "!")
+        assert t.get_richtext_value() == [
+            {"insert": "sit", "attributes": {"link": "x.com"}},
+            {"insert": "!"},
+        ]
+        doc2 = LoroDoc(peer=2)
+        doc2.config.text_style_config["hl"] = "before"
+        t2 = doc2.get_text("t")
+        t2.insert(0, "ab")
+        t2.mark(1, 2, "hl", True)
+        t2.delete(0, 1)
+        t2.insert(0, "X")
+        assert t2.get_richtext_value() == [{"insert": "Xb", "attributes": {"hl": True}}]
+
+    def test_expand_none_midrange_still_styles(self):
+        doc = LoroDoc(peer=1)
+        doc.config.text_style_config["link"] = "none"
+        t = doc.get_text("t")
+        t.insert(0, "abcd")
+        t.mark(0, 4, "link", "u")
+        t.insert(2, "X")  # strictly inside: styled regardless of expand
+        assert t.get_richtext_value()[0] == {"insert": "abXcd", "attributes": {"link": "u"}}
+
+
 class TestList:
     def test_basic(self):
         doc = LoroDoc(peer=1)

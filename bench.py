@@ -100,6 +100,58 @@ def bench_tree() -> None:
     _emit(f"tree moves merged/sec ({docs}-doc batch, {m} moves/doc)", docs * m / dt)
 
 
+def bench_movable() -> None:
+    """BASELINE config ~4/5 hybrid: movable-list concurrent move/set."""
+    import jax
+    import numpy as np
+
+    from loro_tpu.ops.fugue_batch import SeqColumns
+    from loro_tpu.ops.movable_batch import MovableCols, movable_merge_batch
+
+    docs = int(os.environ.get("BENCH_DOCS", "256"))
+    s = int(os.environ.get("BENCH_SLOTS", "8192"))  # slots per doc
+    n_elems = s // 2
+    rng = np.random.default_rng(0)
+    # synthetic but structurally real: first half = insert slots
+    # (right-spine), second half = move slots pointing at random elems
+    parent = np.concatenate(
+        [np.arange(-1, n_elems - 1, dtype=np.int32), rng.integers(0, n_elems, s - n_elems).astype(np.int32)]
+    )
+    elem = np.concatenate(
+        [np.arange(n_elems, dtype=np.int32), rng.integers(0, n_elems, s - n_elems).astype(np.int32)]
+    )
+    lam = np.concatenate(
+        [np.arange(n_elems, dtype=np.int32), rng.integers(n_elems, 4 * n_elems, s - n_elems).astype(np.int32)]
+    )
+    seq = SeqColumns(
+        parent=np.broadcast_to(parent, (docs, s)).copy(),
+        side=np.ones((docs, s), np.int32),
+        peer=np.zeros((docs, s), np.int32),
+        counter=np.broadcast_to(np.arange(s, dtype=np.int32), (docs, s)).copy(),
+        deleted=np.zeros((docs, s), bool),
+        content=np.broadcast_to(elem, (docs, s)).copy(),
+        valid=np.ones((docs, s), bool),
+    )
+    cols = MovableCols(
+        seq=SeqColumns(*[jax.device_put(a) for a in seq]),
+        lamport=jax.device_put(np.broadcast_to(lam, (docs, s)).copy()),
+        set_elem=jax.device_put(np.broadcast_to(np.arange(n_elems, dtype=np.int32), (docs, n_elems)).copy()),
+        set_lamport=jax.device_put(np.zeros((docs, n_elems), np.int32)),
+        set_peer=jax.device_put(np.zeros((docs, n_elems), np.int32)),
+        set_value=jax.device_put(np.broadcast_to(np.arange(n_elems, dtype=np.int32), (docs, n_elems)).copy()),
+        set_valid=jax.device_put(np.ones((docs, n_elems), bool)),
+    )
+    out = movable_merge_batch(cols, n_elems)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = movable_merge_batch(cols, n_elems)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    _emit(f"movable_list ops merged/sec ({docs}-doc batch, {s} slots/doc)", docs * s / dt)
+
+
 def main() -> None:
     # bench runs on the real chip (ambient platform) by default; an
     # explicit JAX_PLATFORMS env must win even though the axon plugin
@@ -114,6 +166,8 @@ def main() -> None:
         return bench_map()
     if config == "tree":
         return bench_tree()
+    if config == "movable":
+        return bench_movable()
 
     from loro_tpu.bench_utils import automerge_final_text, automerge_seq_extract
     from loro_tpu.ops.columnar import chain_columns

@@ -118,6 +118,61 @@ class VersionVector:
     def from_json(d: Dict[str, int]) -> "VersionVector":
         return VersionVector({int(p): c for p, c in d.items()})
 
+    def encode(self) -> bytes:
+        """Compact binary form (reference: VersionVector::encode) —
+        varint count, then per entry u64-LE peer + varint counter."""
+        import struct
+
+        out = bytearray()
+        entries = sorted((p, c) for p, c in self._m.items() if c > 0)
+        _uvarint(out, len(entries))
+        for p, c in entries:
+            out += struct.pack("<Q", p)
+            _uvarint(out, c)
+        return bytes(out)
+
+    @staticmethod
+    def decode(data: bytes) -> "VersionVector":
+        """Raises ValueError on malformed/truncated input (wire API)."""
+        import struct
+
+        try:
+            pos = [0]
+            n = _read_uvarint(data, pos)
+            if n > len(data):  # cheap sanity bound before allocating
+                raise ValueError("version vector count exceeds payload")
+            m = {}
+            for _ in range(n):
+                (p,) = struct.unpack_from("<Q", data, pos[0])
+                pos[0] += 8
+                m[p] = _read_uvarint(data, pos)
+            return VersionVector(m)
+        except (IndexError, struct.error) as e:
+            raise ValueError(f"malformed version vector: {e}") from e
+
+
+def _uvarint(out: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | 0x80 if v else b)
+        if not v:
+            return
+
+
+def _read_uvarint(data: bytes, pos: List[int]) -> int:
+    v = 0
+    shift = 0
+    while True:
+        b = data[pos[0]]
+        pos[0] += 1
+        v |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return v
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint overflow")
+
 
 class Frontiers:
     """A minimal set of DAG head ids.  reference: version/frontiers.rs.

@@ -16,7 +16,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 from .codec import json_schema as jcodec
 from .config import Configure
 from .core.change import Change
-from .core.ids import ContainerID, ContainerType, ID, PeerID
+from .core.ids import ContainerID, ContainerType, ID, IdSpan, PeerID
 from .core.version import Frontiers, VersionRange, VersionVector
 from .event import (
     ContainerDiff,
@@ -204,7 +204,7 @@ class LoroDoc:
             self._emit(txn.diffs, origin or txn.origin, EventTriggerKind.Local, txn.start_frontiers)
         # local update push (reference: txn.rs:78-90 subscribe_local_update)
         if self._local_update_subs:
-            payload = self._encode_changes([change], EncodeMode.JsonUpdates)
+            payload = self._encode_changes([change], EncodeMode.ColumnarUpdates)
             for cb in self._local_update_subs:
                 cb(payload)
 
@@ -392,6 +392,43 @@ class LoroDoc:
             return self._import_changes(changes, origin)
 
     import_bytes = import_
+
+    def import_batch(self, blobs: Sequence[bytes], origin: str = "import") -> ImportStatus:
+        """Import several update blobs atomically-ish (reference:
+        loro.rs import_batch): decode everything first, then apply as
+        one causally-sorted set so cross-blob dependencies resolve in
+        one pass."""
+        self.commit()
+        all_changes: List[Change] = []
+        snapshots: List[bytes] = []
+        for blob in blobs:
+            mode, payload = self._parse_envelope(blob)
+            if mode in (
+                EncodeMode.FastSnapshot,
+                EncodeMode.ShallowSnapshot,
+                EncodeMode.StateOnly,
+            ):
+                snapshots.append(blob)
+            else:
+                all_changes.extend(self._decode_changes(mode, payload))
+        success = VersionRange()
+        pending: Optional[VersionRange] = None
+
+        def fold(st: ImportStatus) -> None:
+            nonlocal pending
+            for p, (s, e) in st.success.items():
+                success.extend_to_include(IdSpan(p, s, e))
+            if st.pending is not None:
+                if pending is None:
+                    pending = VersionRange()
+                for p, (s, e) in st.pending.items():
+                    pending.extend_to_include(IdSpan(p, s, e))
+
+        for blob in snapshots:
+            fold(self.import_(blob, origin))
+        if all_changes or (not snapshots):
+            fold(self._import_changes(all_changes, origin))
+        return ImportStatus(success, pending)
 
     def _parse_envelope(self, data: bytes) -> Tuple[EncodeMode, bytes]:
         if len(data) < 10 or data[:4] != MAGIC:
@@ -830,6 +867,34 @@ class LoroDoc:
 
     def diagnose_size(self) -> Dict[str, int]:
         return self.oplog.diagnose_size()
+
+    def analyze(self) -> Dict[str, Dict[str, Any]]:
+        """Per-container size introspection (reference: state/analyzer.rs
+        DocAnalysis)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for cid, st in self.state.states.items():
+            info: Dict[str, Any] = {"type": cid.ctype.name, "depth": self.state.depth_of(cid)}
+            seq = getattr(st, "seq", None)
+            if seq is not None:
+                n_deleted = 0
+                n_anchors = 0
+                for e in seq.all_elems():
+                    if e.deleted:
+                        n_deleted += 1
+                    elif getattr(e, "is_anchor", False):
+                        n_anchors += 1
+                info["elements"] = seq.total_len
+                info["visible"] = seq.visible_len
+                info["tombstones"] = n_deleted  # live anchors are not garbage
+                if n_anchors:
+                    info["anchors"] = n_anchors
+            elif hasattr(st, "entries"):
+                info["entries"] = len(st.entries)
+            elif hasattr(st, "nodes"):
+                info["nodes"] = len(st.nodes)
+                info["moves"] = len(st.moves)
+            out[str(cid)] = info
+        return out
 
     def __len__(self) -> int:
         return len(self.state.states)

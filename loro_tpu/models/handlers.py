@@ -89,6 +89,47 @@ class TextHandler(Handler):
     def slice(self, start: int, end: int) -> str:
         return self.to_string()[start:end]
 
+    # -- utf16 index space (JS interop; reference tracks unicode/utf16/
+    # utf8/entity lengths per rope node) ------------------------------
+    def len_utf16(self) -> int:
+        return sum(1 + (ord(e.content) > 0xFFFF) for e in self._state.seq.visible_elems())
+
+    def utf16_to_unicode(self, u16: int) -> int:
+        """Convert a utf16 offset to a codepoint position.  Offsets
+        landing inside a surrogate pair are rejected (the reference
+        errors on non-boundary utf16 indices rather than silently
+        snapping — a JS peer's bug must not become data loss)."""
+        acc = 0
+        for i, e in enumerate(self._state.seq.visible_elems()):
+            if acc == u16:
+                return i
+            if acc > u16:
+                raise IndexError(f"utf16 pos {u16} is inside a surrogate pair")
+            acc += 1 + (ord(e.content) > 0xFFFF)
+        if acc < u16:
+            raise IndexError(f"utf16 pos {u16} > len {acc}")
+        if acc > u16:
+            raise IndexError(f"utf16 pos {u16} is inside a surrogate pair")
+        return len(self._state)
+
+    def unicode_to_utf16(self, pos: int) -> int:
+        acc = 0
+        for i, e in enumerate(self._state.seq.visible_elems()):
+            if i >= pos:
+                return acc
+            acc += 1 + (ord(e.content) > 0xFFFF)
+        if pos > len(self._state):
+            raise IndexError(pos)
+        return acc
+
+    def insert_utf16(self, u16_pos: int, s: str) -> None:
+        self.insert(self.utf16_to_unicode(u16_pos), s)
+
+    def delete_utf16(self, u16_pos: int, u16_len: int) -> None:
+        start = self.utf16_to_unicode(u16_pos)
+        end = self.utf16_to_unicode(u16_pos + u16_len)
+        self.delete(start, end - start)
+
     # -- writes -------------------------------------------------------
     def insert(self, pos: int, s: str) -> None:
         if not s:

@@ -45,3 +45,17 @@ def shard_doc_batch(mesh: Mesh, tree):
     """Place a pytree of [D, ...] arrays with the doc axis sharded."""
     sh = doc_sharding(mesh)
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)
+
+
+def make_global_mesh(op_parallel: int = 1) -> Mesh:
+    """Multi-host fleet mesh: all devices across all processes.
+
+    The DCN story for a CRDT fleet is simple because documents are
+    independent (SURVEY.md §2.4): shard the doc axis over every chip of
+    every host; per-host ingest feeds its local shard (jax makes arrays
+    from per-host shards via make_array_from_process_local_data), and
+    NO cross-host collectives run during a merge — DCN only carries the
+    control plane and any cross-host doc rebalancing.  Call
+    jax.distributed.initialize() before this in each host process.
+    """
+    return make_mesh(jax.devices(), op_parallel=op_parallel)

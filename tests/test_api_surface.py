@@ -1,0 +1,134 @@
+"""API-surface parity tests: import_batch, analyze, utf16 space,
+VersionVector bytes, local-update binary payloads."""
+import pytest
+
+from loro_tpu import LoroDoc, VersionVector
+
+
+class TestImportBatch:
+    def test_out_of_order_blobs_resolve_in_one_pass(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "one")
+        blob1 = a.export_updates()
+        vv1 = a.oplog_vv()
+        a.get_text("t").insert(3, " two")
+        blob2 = a.export_updates(vv1)
+        b = LoroDoc(peer=2)
+        status = b.import_batch([blob2, blob1])  # reversed order
+        assert b.get_text("t").to_string() == "one two"
+        assert status.pending is None
+
+    def test_mixed_snapshot_and_updates(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "base")
+        snap = a.export_snapshot()
+        vv = a.oplog_vv()
+        a.get_text("t").insert(4, "+d")
+        delta = a.export_updates(vv)
+        b = LoroDoc(peer=2)
+        b.import_batch([delta, snap])
+        assert b.get_text("t").to_string() == "base+d"
+
+
+class TestAnalyze:
+    def test_analyze(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello")
+        t.delete(1, 2)
+        doc.get_map("m").set("k", 1)
+        tree = doc.get_tree("tr")
+        tree.create()
+        doc.commit()
+        a = doc.analyze()
+        text_info = a["cid:root-t:Text"]
+        assert text_info["visible"] == 3 and text_info["tombstones"] == 2
+        assert a["cid:root-m:Map"]["entries"] == 1
+        assert a["cid:root-tr:Tree"]["nodes"] == 1
+
+
+class TestUtf16:
+    def test_roundtrip(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "a𝄞b")  # 𝄞 is 2 utf16 units
+        assert t.len_utf16() == 4
+        assert t.unicode_to_utf16(2) == 3
+        assert t.utf16_to_unicode(3) == 2
+        t.insert_utf16(3, "X")
+        assert t.to_string() == "a𝄞Xb"
+        t.delete_utf16(1, 2)  # removes the surrogate pair
+        assert t.to_string() == "aXb"
+
+    def test_oob(self):
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "ab")
+        with pytest.raises(IndexError):
+            doc.get_text("t").utf16_to_unicode(5)
+
+    def test_mid_surrogate_rejected(self):
+        """Offsets inside a surrogate pair error instead of snapping
+        (review finding: silent over-deletion)."""
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "𝄞b")
+        with pytest.raises(IndexError):
+            t.utf16_to_unicode(1)
+        with pytest.raises(IndexError):
+            t.delete_utf16(0, 1)
+        assert t.to_string() == "𝄞b"  # untouched
+
+
+class TestAnalyzeAnchors:
+    def test_live_anchors_not_tombstones(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello")
+        t.mark(0, 3, "bold", True)
+        doc.commit()
+        info = doc.analyze()["cid:root-t:Text"]
+        assert info["tombstones"] == 0 and info["anchors"] == 2
+
+
+class TestImportBatchStatus:
+    def test_status_merges_snapshot_spans(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "base")
+        snap = a.export_snapshot()
+        vv = a.oplog_vv()
+        a.get_text("t").insert(4, "+d")
+        delta = a.export_updates(vv)
+        b = LoroDoc(peer=2)
+        status = b.import_batch([delta, snap])
+        spans = dict(status.success.items())
+        assert spans[1][0] == 0 and spans[1][1] >= 6  # full range reported
+
+
+class TestVvDecodeErrors:
+    def test_truncated(self):
+        vv = VersionVector({1: 5, 2: 9})
+        blob = vv.encode()
+        for cut in (1, 5, len(blob) - 1):
+            with pytest.raises(ValueError):
+                VersionVector.decode(blob[:cut])
+
+
+class TestVersionVectorBytes:
+    def test_roundtrip(self):
+        vv = VersionVector({1: 5, (1 << 50) + 3: 1000000})
+        assert VersionVector.decode(vv.encode()) == vv
+        assert VersionVector.decode(VersionVector().encode()) == VersionVector()
+
+
+class TestLocalUpdateBinary:
+    def test_payload_is_columnar(self):
+        from loro_tpu import EncodeMode
+
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        blobs = []
+        a.subscribe_local_update(blobs.append)
+        a.get_text("t").insert(0, "rt")
+        a.commit()
+        assert blobs and blobs[0][5] == EncodeMode.ColumnarUpdates.value
+        b.import_(blobs[0])
+        assert b.get_text("t").to_string() == "rt"

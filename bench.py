@@ -178,13 +178,20 @@ def main() -> None:
         pad_bucket,
     )
 
-    docs_total = int(os.environ.get("BENCH_DOCS", "256"))
-    chunk = int(os.environ.get("BENCH_CHUNK", "32"))
+    # conservative defaults: one modest-size compile + small uploads (a
+    # killed mid-flight TPU launch can wedge the tunnel — CLAUDE.md);
+    # scale up with BENCH_DOCS/BENCH_CHUNK when the chip budget allows
+    docs_total = int(os.environ.get("BENCH_DOCS", "64"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "8"))
     limit = os.environ.get("BENCH_TXN_LIMIT")
     limit = int(limit) if limit else None
 
+    def note(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
     from loro_tpu.ops.columnar import contract_chains
 
+    note("bench: extracting trace (cached after first run)...")
     ex, n_ops = automerge_seq_extract(limit=limit)
     n_chains = contract_chains(ex).n_chains
     cols1 = chain_columns(ex, pad_n=pad_bucket(ex.n), pad_c=pad_bucket(n_chains))
@@ -192,13 +199,16 @@ def main() -> None:
     # broadcast one trace across the chunk's doc axis (each doc pays the
     # full merge; contents identical — the kernel can't exploit that)
     batched = ChainColumns(*[np.broadcast_to(a, (chunk,) + a.shape).copy() for a in cols1])
+    note(f"bench: uploading {chunk}-doc chunk ({ex.n} elements/doc)...")
     dev_cols = ChainColumns(*[jax.device_put(a) for a in batched])
 
     # correctness: one doc's materialized text == ground truth
+    note("bench: compiling + correctness check...")
     codes, counts = chain_merge_docs(dev_cols)
     got = "".join(map(chr, np.asarray(codes[0])[: int(counts[0])]))
     want = automerge_final_text(limit=limit)
     assert got == want, f"device merge mismatch: {len(got)} vs {len(want)} chars"
+    note("bench: timing...")
 
     # timed region: merge launches covering docs_total documents; merged
     # state stays on device, only per-doc checksums return

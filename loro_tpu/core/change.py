@@ -189,8 +189,8 @@ class Change:
 
     def can_merge_right(self, other: "Change", merge_interval_s: int) -> bool:
         """Whether `other` can be RLE-merged onto self (same peer,
-        contiguous counters, dep-on-self, close timestamps).
-        reference: change merging in oplog/change_store."""
+        contiguous counters, dep-on-self, close timestamps, equal
+        commit messages — reference change.rs can_merge_right)."""
         return (
             other.peer == self.peer
             and other.ctr_start == self.ctr_end
@@ -198,6 +198,5 @@ class Change:
             and len(other.deps) == 1
             and next(iter(other.deps)) == self.last_id()
             and abs(other.timestamp - self.timestamp) <= merge_interval_s
-            and other.message is None
-            and self.message is None
+            and other.message == self.message
         )

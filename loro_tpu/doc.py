@@ -106,6 +106,7 @@ class LoroDoc:
         self.state = DocState()
         self.observer = Observer()
         self.config = Configure()
+        self.oplog.config = self.config
         self._txn: Optional[Transaction] = None
         self._detached = False
         # (state bytes, vv, frontiers) of the frozen shallow-history root

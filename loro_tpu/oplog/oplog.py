@@ -54,6 +54,10 @@ class OpLog:
         self._starts: Dict[PeerID, List[Counter]] = {}
         self.pending = PendingChanges()
         self.next_lamport: Lamport = 0
+        # the owning doc's Configure (None for bare oplogs in tests);
+        # governs the local-commit RLE-merge window (reference:
+        # configure.rs merge_interval)
+        self.config = None
 
     # -- queries ------------------------------------------------------
     @property
@@ -89,11 +93,25 @@ class OpLog:
 
     def import_local_change(self, change: Change) -> None:
         """Single mutation point for local commits
-        (reference: oplog.rs:191-220 insert_new_change)."""
+        (reference: oplog.rs:191-220 insert_new_change).  Consecutive
+        small commits RLE-merge into one stored Change when they form a
+        linear extension within the merge interval."""
         assert change.ctr_start == self.vv.get(change.peer), "non-contiguous local change"
         for d in change.deps:
             assert self.dag.contains(d), f"local change dep missing: {d}"
+        interval = self.config.merge_interval_s if self.config is not None else 1000
+        lst = self.changes.get(change.peer)
+        if lst and lst[-1].can_merge_right(change, interval):
+            lst[-1].ops.extend(change.ops)
+            self._register_span(change)
+            return
         self._insert_change(change)
+
+    def _register_span(self, ch: Change) -> None:
+        """DAG/lamport bookkeeping shared by fresh inserts and RLE-merges."""
+        self.dag.add_node(ch.peer, ch.ctr_start, ch.ctr_end, ch.lamport, tuple(ch.deps))
+        if ch.lamport_end > self.next_lamport:
+            self.next_lamport = ch.lamport_end
 
     # -- remote import ------------------------------------------------
     def import_changes(self, changes: Iterable[Change]) -> Tuple[List[Change], VersionRange]:
@@ -151,13 +169,9 @@ class OpLog:
         )
 
     def _insert_change(self, ch: Change) -> None:
-        lst = self.changes.setdefault(ch.peer, [])
-        starts = self._starts.setdefault(ch.peer, [])
-        lst.append(ch)
-        starts.append(ch.ctr_start)
-        self.dag.add_node(ch.peer, ch.ctr_start, ch.ctr_end, ch.lamport, tuple(ch.deps))
-        if ch.lamport_end > self.next_lamport:
-            self.next_lamport = ch.lamport_end
+        self.changes.setdefault(ch.peer, []).append(ch)
+        self._starts.setdefault(ch.peer, []).append(ch.ctr_start)
+        self._register_span(ch)
 
     # -- export -------------------------------------------------------
     def changes_since(self, vv: VersionVector) -> List[Change]:

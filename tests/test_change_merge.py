@@ -1,0 +1,99 @@
+"""Change RLE-merging on local commits (reference: change merging with
+merge_interval, change_store.rs) + snapshot decode robustness."""
+import random
+
+import pytest
+
+from loro_tpu import DecodeError, ExportMode, LoroDoc, VersionVector
+
+
+class TestChangeMerge:
+    def test_consecutive_commits_merge(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        for i in range(20):
+            t.insert(len(t), "x")
+            doc.commit()
+        assert doc.len_changes() == 1  # all RLE-merged
+        assert doc.oplog.total_ops() == 20
+
+    def test_differing_messages_block_merge(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "a")
+        doc.commit(message="first")
+        t.insert(1, "b")
+        doc.commit()  # message None != "first"
+        assert doc.len_changes() == 2
+
+    def test_equal_messages_merge(self):
+        """reference change.rs: equal commit messages RLE-merge."""
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "a")
+        doc.commit(message="autosave")
+        t.insert(1, "b")
+        doc.commit(message="autosave")
+        assert doc.len_changes() == 1
+
+    def test_merge_interval_zero_disables(self):
+        doc = LoroDoc(peer=1)
+        doc.config.merge_interval_s = -1
+        doc.config.record_timestamp = True
+        t = doc.get_text("t")
+        t.insert(0, "a")
+        doc.commit()
+        t.insert(1, "b")
+        doc.commit()
+        assert doc.len_changes() == 2
+
+    def test_remote_import_breaks_merge_chain(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "a")
+        a.commit()
+        b.get_text("t").insert(0, "b")
+        a.import_(b.export_updates())
+        a.get_text("t").insert(0, "c")  # deps now include b's head
+        a.commit()
+        assert a.len_changes() >= 2  # c-change can't merge into a-change
+        # replica equality preserved through merging
+        c = LoroDoc(peer=3)
+        c.import_(a.export_snapshot())
+        assert c.get_deep_value() == a.get_deep_value()
+
+    def test_merged_changes_slice_on_export(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        for i in range(10):
+            t.insert(len(t), str(i % 10))
+            a.commit()
+        b = LoroDoc(peer=2)
+        # export a partial range of the merged change
+        b.import_(a.export(ExportMode.UpdatesInRange(VersionVector(), VersionVector({1: 5}))))
+        assert b.get_text("t").to_string() == "01234"
+        b.import_(a.export_updates(b.oplog_vv()))
+        assert b.get_text("t").to_string() == a.get_text("t").to_string()
+
+
+class TestSnapshotRobustness:
+    @pytest.mark.parametrize("mode_name", ["Snapshot", "StateOnly"])
+    def test_bitflip_never_crashes(self, mode_name):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        t.insert(0, "snapshot payload with some content")
+        t.mark(0, 8, "bold", True)
+        a.get_movable_list("ml").push(1, 2, 3)
+        a.commit()
+        mode = ExportMode.Snapshot if mode_name == "Snapshot" else ExportMode.StateOnly
+        blob = bytearray(a.export(mode))
+        rng = random.Random(1)
+        for _ in range(40):
+            i = rng.randrange(10, len(blob))
+            mutated = bytearray(blob)
+            mutated[i] ^= 1 << rng.randrange(8)
+            b = LoroDoc(peer=2)
+            try:
+                b.import_(bytes(mutated))
+            except DecodeError:
+                pass  # the contract: corrupt bytes -> typed DecodeError
+                # (anything else propagates and fails the test)

@@ -1,0 +1,55 @@
+"""Collaborative rich-text editor session (host path walkthrough).
+
+Run: python examples/collab_editor.py
+"""
+import os, sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import loro_tpu as lt
+from loro_tpu.undo import UndoManager
+from loro_tpu.cursor import get_cursor, get_cursor_pos
+
+
+def main() -> None:
+    alice, bob = lt.LoroDoc(peer=1), lt.LoroDoc(peer=2)
+    alice.config.text_style_config["link"] = "none"
+
+    doc = alice.get_text("article")
+    doc.insert(0, "CRDTs merge without conflicts.")
+    doc.mark(0, 5, "bold", True)
+    alice.commit()
+
+    # bob joins from a snapshot
+    bob.import_(alice.export_snapshot())
+
+    # concurrent edits + a cursor that survives them
+    cursor = get_cursor(alice, doc, 6)  # before "merge"
+    undo = UndoManager(alice)
+    doc.insert(6, "always ")
+    alice.commit()
+    bob.get_text("article").insert(0, "[draft] ")
+    bob.commit()
+
+    # two-round sync
+    alice.import_(bob.export_updates(alice.oplog_vv()))
+    bob.import_(alice.export_updates(bob.oplog_vv()))
+    assert alice.get_deep_value() == bob.get_deep_value()
+
+    print("merged:", alice.get_text("article").to_string())
+    print("cursor now at:", get_cursor_pos(alice, cursor).pos)
+    print("rich segments:", alice.get_text("article").get_richtext_value()[:2])
+
+    undo.undo()  # undoes only alice's "always ", keeps bob's prefix
+    print("after undo:", alice.get_text("article").to_string())
+
+    # time travel
+    f = alice.oplog_frontiers()
+    alice.checkout(lt.Frontiers())
+    print("at genesis:", alice.get_value())
+    alice.checkout_to_latest()
+    print("back to latest:", alice.get_text("article").to_string())
+
+
+if __name__ == "__main__":
+    main()

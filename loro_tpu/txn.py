@@ -48,7 +48,9 @@ class Transaction:
         self.doc.state._register_children(op, self.peer)
         st = self.doc.state.get_or_create(cid)
         d = st.apply_op(op, self.peer, lamport)
-        if d is not None:
+        # diff objects are only kept when someone will consume them
+        # (reference skips event building with no subscribers)
+        if d is not None and self.doc.observer.has_subscribers():
             self.diffs.setdefault(cid, []).append(d)
         self.ops.append(op)
         self.next_counter += op.atom_len()

@@ -1,0 +1,36 @@
+"""Pallas Wyllie-ranking kernel vs the XLA loop (interpret mode on the
+CPU mesh; hardware lowering is profiled on TPU separately)."""
+import numpy as np
+import pytest
+
+from loro_tpu.ops.pallas_rank import HAVE_PALLAS, wyllie_rank, wyllie_rank_xla
+
+pytestmark = pytest.mark.skipif(not HAVE_PALLAS, reason="pallas unavailable")
+
+
+def _random_ring(m: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(m).astype(np.int32)
+    succ = np.empty(m, np.int32)
+    succ[perm[:-1]] = perm[1:]
+    succ[perm[-1]] = perm[-1]  # terminal self-loop
+    return succ
+
+
+@pytest.mark.parametrize("m", [8, 64, 257, 1024])
+def test_matches_xla(m):
+    import jax.numpy as jnp
+
+    succ = jnp.asarray(_random_ring(m, m))
+    got = np.asarray(wyllie_rank(succ, interpret=True))
+    want = np.asarray(wyllie_rank_xla(succ))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_distances_are_list_positions():
+    import jax.numpy as jnp
+
+    succ = jnp.asarray(_random_ring(512, 7))
+    dist = np.asarray(wyllie_rank(succ, interpret=True))
+    # unique distances 0..m-1, strictly decreasing along the ring
+    assert sorted(dist.tolist()) == list(range(512))

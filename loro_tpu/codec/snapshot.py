@@ -28,6 +28,10 @@ from .binary import Reader, Writer, _Dicts, _read_cid, _read_value, _write_cid, 
 
 S_MAP, S_SEQ, S_MOVABLE, S_TREE, S_COUNTER, S_UNKNOWN = range(6)
 
+# bump on any incompatible state-table layout change (v2: per-element
+# deleted_by records in sequence tables)
+STATE_FORMAT = 2
+
 # element content tags for sequence states
 E_CHAR, E_VALUE, E_ANCHOR, E_ELEMREF = range(4)
 
@@ -54,6 +58,11 @@ def _write_seq(w: Writer, d: _Dicts, seq: FugueSeq) -> None:
         # bit2: invisible though not deleted (movable-list stale slots)
         flags = int(e.fside) | (2 if e.deleted else 0) | (4 if e.vis_w == 0 else 0)
         w.u8(flags)
+        # deletion records (version-diff visibility evaluation)
+        w.varint(len(e.deleted_by))
+        for did in e.deleted_by:
+            w.varint(d.peer(did.peer))
+            w.zigzag(did.counter)
         c = e.content
         if isinstance(c, StyleAnchor):
             w.u8(E_ANCHOR)
@@ -85,6 +94,7 @@ def _read_seq(r: Reader, peers: List[int], keys: List[str], cids: List[Container
         lamport = r.varint()
         pref = r.varint()
         flags = r.u8()
+        deleted_by = [ID(peers[r.varint()], r.zigzag()) for _ in range(r.varint())]
         tag = r.u8()
         if tag == E_ANCHOR:
             key = keys[r.varint()]
@@ -101,6 +111,7 @@ def _read_seq(r: Reader, peers: List[int], keys: List[str], cids: List[Container
         # fparent linked in a second pass — a parent can appear *later*
         # in traversal order (L-children precede their parent)
         e = SeqElem(peer, counter, content, None, Side(flags & 1), lamport)
+        e.deleted_by = deleted_by
         if flags & 2:
             e.deleted = True
         invisible = bool(flags & 6) or e.is_anchor
@@ -287,6 +298,7 @@ def encode_doc_state(doc_state, parents: Dict) -> bytes:
             d.peer(c.peer)  # type: ignore[arg-type]
 
     w = Writer()
+    w.u8(STATE_FORMAT)
     w.varint(len(d.peers))
     for p in d.peers:
         w.u64le(p)
@@ -307,6 +319,9 @@ def encode_doc_state(doc_state, parents: Dict) -> bytes:
 def decode_doc_state(buf: bytes):
     """Returns (states dict, parents dict)."""
     r = Reader(buf)
+    fmt = r.u8()
+    if fmt != STATE_FORMAT:
+        raise ValueError(f"unsupported snapshot state format {fmt} (want {STATE_FORMAT})")
     peers = [r.u64le() for _ in range(r.varint())]
     keys = [r.str_() for _ in range(r.varint())]
     cids = [_read_cid(r, peers) for _ in range(r.varint())]

@@ -643,10 +643,11 @@ class LoroDoc:
             raise LoroError(f"checkout target not in history (shallow/trimmed?): {e}") from e
         if self._shallow_base is not None and not (self.oplog.dag.shallow_since_vv <= target_vv):
             raise LoroError("cannot checkout below the shallow root")
-        cur_vv = self.state.vv
+        cur_vv = self.state.vv.copy()
         record = self.observer.has_subscribers()
         old_values = self._container_values() if record else None
         from_f = self.state.frontiers
+        pre_state = self.state
         if cur_vv <= target_vv:
             chs = self.oplog.changes_between(cur_vv, target_vv)
             self.state.apply_changes(chs, record=False)
@@ -666,20 +667,22 @@ class LoroDoc:
             # detached mode
             self.set_peer_id(random.getrandbits(63))
         if record:
-            diffs = self._value_level_diffs(old_values)
+            diffs = self._value_level_diffs(old_values, skip_seq=True)
+            for cid, d in self._seq_diff_batch(cur_vv, target_vv, (self.state, pre_state)).items():
+                diffs[cid] = [d]
             if diffs:
                 self._emit(diffs, "checkout", EventTriggerKind.Checkout, from_f)
 
     def _container_values(self) -> Dict[ContainerID, Any]:
         return {cid: st.get_value() for cid, st in self.state.states.items()}
 
-    def _value_level_diffs(self, old_values: Dict[ContainerID, Any]) -> Dict[ContainerID, List]:
-        """Value-level diffs for checkout events (exact for map/counter/
-        tree, positional for sequences via difflib).  TODO(round2):
-        replay-based exact deltas like the reference's persistent
-        DiffCalculator."""
+    def _value_level_diffs(
+        self, old_values: Dict[ContainerID, Any], skip_seq: bool = False
+    ) -> Dict[ContainerID, List]:
+        """Value-level diffs (exact for map/counter/tree; sequences are
+        handled by _seq_diff_batch when skip_seq)."""
         new_values = self._container_values()
-        batch = _diff_values(old_values, new_values, self.state)
+        batch = _diff_values(old_values, new_values, self.state, skip_seq=skip_seq)
         return {cid: [d] for cid, d in batch.items()}
 
     # ------------------------------------------------------------------
@@ -687,11 +690,13 @@ class LoroDoc:
     # apply_diff, loro.rs:1232 revert_to)
     # ------------------------------------------------------------------
     def _state_at(self, frontiers: Frontiers) -> DocState:
+        return self._state_at_vv(self.oplog.dag.frontiers_to_vv(frontiers), frontiers)
+
+    def _state_at_vv(self, vv: VersionVector, frontiers: Optional[Frontiers] = None) -> DocState:
         """Materialize a throwaway DocState at an arbitrary version by
         causal replay (the reference reaches the same states via its
         persistent Checkout DiffCalculator).  Shallow docs replay from
         the frozen base state, never below it."""
-        vv = self.oplog.dag.frontiers_to_vv(frontiers)
         st = DocState()
         from_vv = VersionVector()
         if self._shallow_base is not None:
@@ -706,17 +711,43 @@ class LoroDoc:
             from_vv = base_vv
         st.apply_changes(self.oplog.changes_between(from_vv, vv), record=False)
         st.vv = vv
-        st.frontiers = frontiers
+        st.frontiers = frontiers if frontiers is not None else self.oplog.dag.vv_to_frontiers(vv)
         return st
 
     def diff(self, a: Frontiers, b: Frontiers) -> Dict[ContainerID, Any]:
-        """DiffBatch turning state(a) into state(b) (value-level).
+        """DiffBatch turning state(a) into state(b).  Sequence containers
+        get EXACT deltas via element-identity visibility at each version
+        (per-element deletion records); other containers diff by value.
         Endpoints equal to the live state reuse it instead of replaying
         the full history."""
         self.commit()  # uncommitted ops would desync state vs frontiers
+        va = self.oplog.dag.frontiers_to_vv(a)
+        vb = self.oplog.dag.frontiers_to_vv(b)
         sa = self.state if a == self.state.frontiers else self._state_at(a)
         sb = self.state if b == self.state.frontiers else self._state_at(b)
-        return _state_diff(sa, sb)
+        batch = _state_diff(sa, sb, skip_seq=True)
+        batch.update(self._seq_diff_batch(va, vb, (self.state, sb, sa)))
+        return batch
+
+    def _seq_diff_batch(
+        self, va: VersionVector, vb: VersionVector, candidates
+    ) -> Dict[ContainerID, Any]:
+        """Exact element-identity deltas for every sequence container,
+        computed on whichever candidate state covers both versions (a
+        union replay as the last resort).  Scans ALL sequence containers
+        — identity changes with equal values still produce deltas."""
+        union = va.join(vb)
+        u_state = next((s for s in candidates if s is not None and union <= s.vv), None)
+        if u_state is None:
+            u_state = self._state_at_vv(union)
+        out: Dict[ContainerID, Any] = {}
+        for cid, st in u_state.states.items():
+            if cid.ctype not in (ContainerType.Text, ContainerType.List):
+                continue
+            d = st.seq.delta_between(va, vb, as_text=cid.ctype == ContainerType.Text)
+            if not d.is_empty():
+                out[cid] = d
+        return out
 
     def apply_diff(self, batch: Dict[ContainerID, Any], origin: str = "apply_diff") -> None:
         """Apply a DiffBatch as new local ops."""
@@ -901,11 +932,11 @@ class LoroDoc:
         return len(self.state.states)
 
 
-def _state_diff(sa: DocState, sb: DocState) -> Dict[ContainerID, Any]:
+def _state_diff(sa: DocState, sb: DocState, skip_seq: bool = False) -> Dict[ContainerID, Any]:
     """Value-level DiffBatch turning sa's values into sb's."""
     va = {cid: st.get_value() for cid, st in sa.states.items()}
     vb = {cid: st.get_value() for cid, st in sb.states.items()}
-    return _diff_values(va, vb, sb)
+    return _diff_values(va, vb, sb, skip_seq=skip_seq)
 
 
 def _seq_delta(old, new, keys_a=None, keys_b=None, as_tuple=False) -> Delta:
@@ -937,12 +968,17 @@ def _list_delta(old_l: List[Any], new_l: List[Any]) -> Delta:
 
 
 def _diff_values(
-    va: Dict[ContainerID, Any], vb: Dict[ContainerID, Any], target_state: DocState
+    va: Dict[ContainerID, Any],
+    vb: Dict[ContainerID, Any],
+    target_state: DocState,
+    skip_seq: bool = False,
 ) -> Dict[ContainerID, Any]:
     from .event import CounterDiff
 
     out: Dict[ContainerID, Any] = {}
     for cid in set(va) | set(vb):
+        if skip_seq and cid.ctype in (ContainerType.Text, ContainerType.List):
+            continue  # exact deltas computed separately (no difflib cost)
         old_v = va.get(cid)
         new_v = vb.get(cid)
         if old_v == new_v:

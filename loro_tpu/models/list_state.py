@@ -27,7 +27,7 @@ class ListState(ContainerState):
             pos, _ = self.seq.integrate_insert(peer, op.counter, parent, c.side, list(c.content), lamport)
             return Delta().retain(pos).insert(tuple(c.content))
         assert isinstance(c, SeqDelete)
-        removed = self.seq.integrate_delete(c.spans)
+        removed = self.seq.integrate_delete(c.spans, deleter=ID(peer, op.counter))
         if not removed:
             return None
         # each removal's position is relative to the state after the

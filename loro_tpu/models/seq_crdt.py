@@ -44,6 +44,7 @@ class SeqElem(TreapNode):
         "counter",
         "content",
         "deleted",
+        "deleted_by",  # List[ID] of delete-op atoms (for version diffs)
         "fparent",  # Optional[SeqElem]; None = root child
         "fside",  # Side
         "l_children",  # List[SeqElem] sorted by (peer, counter)
@@ -64,6 +65,7 @@ class SeqElem(TreapNode):
         self.counter = counter
         self.content = content
         self.deleted = False
+        self.deleted_by: List[ID] = []
         self.fparent = fparent
         self.fside = fside
         self.l_children: List[SeqElem] = []
@@ -215,14 +217,22 @@ class FugueSeq:
                 self.treap.insert_after(pred, n)
         self.by_id[(n.peer, n.counter)] = n
 
-    def integrate_delete(self, spans: Iterable[IdSpan]) -> List[Tuple[int, int]]:
+    def integrate_delete(
+        self, spans: Iterable[IdSpan], deleter: Optional[ID] = None
+    ) -> List[Tuple[int, int]]:
         """Tombstone elements by id.  Returns visible (pos, len) ranges
-        that disappeared (merged, descending-safe order of single units)."""
+        that disappeared (merged, descending-safe order of single units).
+        `deleter` (the delete op's id) is recorded per element so
+        version diffs can evaluate visibility at any vv."""
         removed: List[Tuple[int, int]] = []
         for span in spans:
             for c in range(span.start, span.end):
                 e = self.by_id.get((span.peer, c))
-                if e is None or e.deleted:
+                if e is None:
+                    continue
+                if deleter is not None:
+                    e.deleted_by.append(deleter)
+                if e.deleted:
                     continue
                 pos = self.treap.visible_rank(e)
                 had = e.vis_w
@@ -231,6 +241,27 @@ class FugueSeq:
                 if had:
                     removed.append((pos, 1))
         return _merge_removed(removed)
+
+    def delta_between(self, va, vb, as_text: bool):
+        """Exact delta turning the visible sequence at version `va` into
+        the one at `vb` (both must be within this seq's history).
+        Element visibility at V: inserted (id in V) and not deleted by
+        any delete-op in V."""
+        from ..event import Delta
+
+        d = Delta()
+        for e in self.all_elems():
+            if e.is_anchor:
+                continue
+            in_a = va.includes(e.id) and not any(va.includes(x) for x in e.deleted_by)
+            in_b = vb.includes(e.id) and not any(vb.includes(x) for x in e.deleted_by)
+            if in_a and in_b:
+                d.retain(1)
+            elif in_a:
+                d.delete(1)
+            elif in_b:
+                d.insert(e.content if as_text else (e.content,))
+        return d.chop()
 
     def set_visible(self, elem: SeqElem, vis_w: int) -> None:
         """Directly control an element's visible width (MovableList uses

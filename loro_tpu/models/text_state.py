@@ -43,7 +43,7 @@ class TextState(ContainerState):
             )
             return Delta().retain(pos).insert(c.content, attrs or None)
         assert isinstance(c, SeqDelete)
-        removed = self.seq.integrate_delete(c.spans)
+        removed = self.seq.integrate_delete(c.spans, deleter=ID(peer, op.counter))
         if not removed:
             return None
         out = Delta()

@@ -161,14 +161,20 @@ def _order_core(
     succ = succ.at[VISIT0 + root].set(jnp.where(has_r[root], ENTER0 + first_r[root], EXIT0 + root))
 
     # -- Wyllie list ranking: distance to terminal --------------------
-    dist = jnp.where(succ == tok_ids, 0, 1).astype(jnp.int32)
-    n_steps = max(1, int(np.ceil(np.log2(max(m, 2)))))
+    from .pallas_rank import use_pallas_rank, wyllie_rank
 
-    def body(_, carry):
-        d, s = carry
-        return d + d[s], s[s]
+    if use_pallas_rank():
+        # VMEM-resident pointer doubling (opt-in until TPU-profiled)
+        dist = wyllie_rank(succ)
+    else:
+        dist = jnp.where(succ == tok_ids, 0, 1).astype(jnp.int32)
+        n_steps = max(1, int(np.ceil(np.log2(max(m, 2)))))
 
-    dist, _ = jax.lax.fori_loop(0, n_steps, body, (dist, succ))
+        def body(_, carry):
+            d, s = carry
+            return d + d[s], s[s]
+
+        dist, _ = jax.lax.fori_loop(0, n_steps, body, (dist, succ))
     # in-order position: larger distance-to-end = earlier
     visit_dist = dist[VISIT0 : VISIT0 + n1]
     rank = visit_dist[root] - visit_dist[:n]  # monotone along the traversal

@@ -113,6 +113,62 @@ class TestUndo:
         assert tree.contains(r)
 
 
+class TestExactSeqDiff:
+    def test_identity_aware_delete_position(self):
+        """diff() must report WHICH chars were deleted, not just a
+        minimal edit: deleting the first 'ab' of 'abab' is
+        [delete 2, retain 2], not difflib's tail-biased answer."""
+        from loro_tpu import Delete, Retain
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "abab")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.delete(0, 2)
+        doc.commit()
+        f2 = doc.oplog_frontiers()
+        batch = doc.diff(f1, f2)
+        delta = next(iter(batch.values()))
+        # trailing retain chopped: exact answer is a leading delete
+        # (difflib's tail-biased answer would be [Retain(2), Delete(2)])
+        assert delta.items == [Delete(2)]
+
+    def test_equal_values_different_identity(self):
+        """Delete+reinsert of identical text still yields the exact
+        delta (review finding: value-equal endpoints were dropped)."""
+        from loro_tpu import Delete, Insert
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "ab")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.delete(0, 2)
+        t.insert(0, "ab")
+        doc.commit()
+        f2 = doc.oplog_frontiers()
+        batch = doc.diff(f1, f2)
+        delta = next(iter(batch.values()))
+        assert delta.insert_len() == 2 and delta.delete_len() == 2
+
+    def test_cross_branch_diff(self):
+        """diff between two concurrent branches (neither contains the
+        other) — exact deltas from the union state."""
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "base")
+        sync(a, b)
+        a.commit()
+        fa = a.oplog_frontiers()
+        b.get_text("t").insert(4, "-B")
+        b.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        fb = b.oplog_frontiers()
+        batch = a.diff(fa, fb)
+        delta = next(iter(batch.values()))
+        assert delta.apply_to_text("base") == "base-B"
+
+
 class TestDiffRevert:
     def test_diff_and_apply(self):
         doc = LoroDoc(peer=1)

@@ -26,11 +26,13 @@ class ContainerState(ABC):
         self.cid = cid
 
     @abstractmethod
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         """Integrate one op (local or remote, causally ordered) and return
         the event diff it produced (None if no observable change).
         `peer` is the authoring peer; `lamport` is the lamport of the
-        op's first atom."""
+        op's first atom.  With record=False the integration happens but
+        no diff is built (positions/rank queries skipped — the fast
+        path when nothing consumes events)."""
 
     @abstractmethod
     def get_value(self) -> Any:

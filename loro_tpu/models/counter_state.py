@@ -18,7 +18,7 @@ class CounterState(ContainerState):
         super().__init__(cid)
         self.value: float = 0.0
 
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         c = op.content
         assert isinstance(c, CounterIncr)
         self.value += c.delta

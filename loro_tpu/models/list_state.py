@@ -20,14 +20,20 @@ class ListState(ContainerState):
         super().__init__(cid)
         self.seq = FugueSeq()
 
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         c = op.content
         if isinstance(c, SeqInsert):
             parent = _resolve_run_cont(c.parent, peer, op.counter)
-            pos, _ = self.seq.integrate_insert(peer, op.counter, parent, c.side, list(c.content), lamport)
+            pos, _ = self.seq.integrate_insert(
+                peer, op.counter, parent, c.side, list(c.content), lamport, compute_pos=record
+            )
+            if not record:
+                return None
             return Delta().retain(pos).insert(tuple(c.content))
         assert isinstance(c, SeqDelete)
-        removed = self.seq.integrate_delete(c.spans, deleter=ID(peer, op.counter))
+        removed = self.seq.integrate_delete(
+            c.spans, deleter=ID(peer, op.counter), compute_pos=record
+        )
         if not removed:
             return None
         # each removal's position is relative to the state after the

@@ -36,7 +36,7 @@ class MapState(ContainerState):
         super().__init__(cid)
         self.entries: Dict[str, MapEntry] = {}
 
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         c = op.content
         assert isinstance(c, MapSet)
         cur = self.entries.get(c.key)

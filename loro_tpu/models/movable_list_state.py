@@ -44,27 +44,33 @@ class MovableListState(ContainerState):
         self.elems: Dict[ID, ElemEntry] = {}
 
     # ------------------------------------------------------------------
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         c = op.content
         if isinstance(c, SeqInsert):
-            return self._apply_insert(op, c, peer, lamport)
+            return self._apply_insert(op, c, peer, lamport, record)
         if isinstance(c, SeqDelete):
-            return self._apply_delete(c)
+            return self._apply_delete(c, record)
         if isinstance(c, MovableSet):
-            return self._apply_set(c, peer, lamport)
+            return self._apply_set(c, peer, lamport, record)
         assert isinstance(c, MovableMove)
-        return self._apply_move(op, c, peer, lamport)
+        return self._apply_move(op, c, peer, lamport, record)
 
-    def _apply_insert(self, op: Op, c: SeqInsert, peer: int, lamport: int) -> Optional[Diff]:
+    def _apply_insert(
+        self, op: Op, c: SeqInsert, peer: int, lamport: int, record: bool
+    ) -> Optional[Diff]:
         parent = _resolve_run_cont(c.parent, peer, op.counter)
         elem_ids = [ID(peer, op.counter + j) for j in range(len(c.content))]
-        pos, slots = self.seq.integrate_insert(peer, op.counter, parent, c.side, elem_ids, lamport)
+        pos, slots = self.seq.integrate_insert(
+            peer, op.counter, parent, c.side, elem_ids, lamport, compute_pos=record
+        )
         for j, (eid, v) in enumerate(zip(elem_ids, c.content)):
             key = (lamport + j, peer)
             self.elems[eid] = ElemEntry(v, key, key, eid)
+        if not record:
+            return None
         return Delta().retain(pos).insert(tuple(c.content))
 
-    def _apply_delete(self, c: SeqDelete) -> Optional[Diff]:
+    def _apply_delete(self, c: SeqDelete, record: bool) -> Optional[Diff]:
         out = Delta()
         changed = False
         for span in c.spans:
@@ -73,19 +79,19 @@ class MovableListState(ContainerState):
                 if slot is None or slot.deleted:
                     continue
                 was_visible = slot.vis_w > 0
-                pos = self.seq.treap.visible_rank(slot) if was_visible else 0
+                pos = self.seq.treap.visible_rank(slot) if (record and was_visible) else 0
                 slot.deleted = True
                 self.seq.set_visible(slot, 0)
                 eid: ID = slot.content
                 entry = self.elems.get(eid)
                 if entry is not None and entry.slot == ID(span.peer, ctr):
                     entry.deleted = True
-                if was_visible:
+                if record and was_visible:
                     out = out.compose(Delta().retain(pos).delete(1))
                     changed = True
         return out if changed else None
 
-    def _apply_set(self, c: MovableSet, peer: int, lamport: int) -> Optional[Diff]:
+    def _apply_set(self, c: MovableSet, peer: int, lamport: int, record: bool) -> Optional[Diff]:
         entry = self.elems.get(c.elem)
         if entry is None:
             return None  # element unknown (trimmed history)
@@ -93,17 +99,21 @@ class MovableListState(ContainerState):
             return None
         entry.value = c.value
         entry.value_key = (lamport, peer)
-        if entry.deleted:
+        if not record or entry.deleted:
             return None
         pos = self.seq.visible_index_of(entry.slot)
         if pos is None:
             return None
         return Delta().retain(pos).delete(1).compose(Delta().retain(pos).insert((c.value,)))
 
-    def _apply_move(self, op: Op, c: MovableMove, peer: int, lamport: int) -> Optional[Diff]:
+    def _apply_move(
+        self, op: Op, c: MovableMove, peer: int, lamport: int, record: bool
+    ) -> Optional[Diff]:
         entry = self.elems.get(c.elem)
         parent = _resolve_run_cont(c.parent, peer, op.counter)
-        _, slots = self.seq.integrate_insert(peer, op.counter, parent, c.side, [c.elem], lamport)
+        _, slots = self.seq.integrate_insert(
+            peer, op.counter, parent, c.side, [c.elem], lamport, compute_pos=False
+        )
         new_slot = slots[0]
         # hide immediately: event positions below must be computed on a
         # state that does NOT yet contain the destination slot (the diff
@@ -119,9 +129,10 @@ class MovableListState(ContainerState):
         old = self.seq.by_id.get((entry.slot.peer, entry.slot.counter))
         was_visible = old is not None and old.vis_w > 0
         if was_visible:
-            old_pos = self.seq.treap.visible_rank(old)
+            if record:
+                old_pos = self.seq.treap.visible_rank(old)
+                d = d.compose(Delta().retain(old_pos).delete(1))
             self.seq.set_visible(old, 0)
-            d = d.compose(Delta().retain(old_pos).delete(1))
         entry.pos_key = new_key
         entry.slot = ID(peer, op.counter)
         revived = entry.deleted and not new_slot.deleted
@@ -129,8 +140,11 @@ class MovableListState(ContainerState):
         if not new_slot.deleted:
             # the new slot becomes visible (move destination)
             self.seq.set_visible(new_slot, 1)
-            new_pos = self.seq.treap.visible_rank(new_slot)
-            d = d.compose(Delta().retain(new_pos).insert((entry.value,)))
+            if record:
+                new_pos = self.seq.treap.visible_rank(new_slot)
+                d = d.compose(Delta().retain(new_pos).insert((entry.value,)))
+        if not record:
+            return None
         return d if (was_visible or revived or not new_slot.deleted) else None
 
     # -- queries ------------------------------------------------------

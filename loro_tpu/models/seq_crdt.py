@@ -155,11 +155,13 @@ class FugueSeq:
         side: Side,
         contents: Sequence[Any],
         lamport: int = 0,
+        compute_pos: bool = True,
     ) -> Tuple[int, List[SeqElem]]:
         """Insert a run of elements with ids (peer, counter+j).  Element 0
         is placed per (parent, side); element j>0 chains as Right child of
         element j-1 (RLE right-spine, like the reference's FugueSpan runs).
-        Returns (visible position of first element, created elems)."""
+        Returns (visible position of first element — -1 when
+        compute_pos=False — and the created elems)."""
         first = SeqElem(peer, counter, contents[0], None, side, lamport)
         self._place(first, parent, side)
         elems = [first]
@@ -172,7 +174,7 @@ class FugueSeq:
             self.by_id[(peer, counter + j)] = e
             elems.append(e)
             prev = e
-        pos = self.treap.visible_rank(first)
+        pos = self.treap.visible_rank(first) if compute_pos else -1
         return pos, elems
 
     def _place(self, n: SeqElem, parent: Optional[ID], side: Side) -> None:
@@ -218,12 +220,13 @@ class FugueSeq:
         self.by_id[(n.peer, n.counter)] = n
 
     def integrate_delete(
-        self, spans: Iterable[IdSpan], deleter: Optional[ID] = None
+        self, spans: Iterable[IdSpan], deleter: Optional[ID] = None, compute_pos: bool = True
     ) -> List[Tuple[int, int]]:
         """Tombstone elements by id.  Returns visible (pos, len) ranges
-        that disappeared (merged, descending-safe order of single units).
-        `deleter` (the delete op's id) is recorded per element so
-        version diffs can evaluate visibility at any vv."""
+        that disappeared (merged, descending-safe order of single units;
+        empty when compute_pos=False).  `deleter` (the delete op's id)
+        is recorded per element so version diffs can evaluate visibility
+        at any vv."""
         removed: List[Tuple[int, int]] = []
         for span in spans:
             for c in range(span.start, span.end):
@@ -234,12 +237,12 @@ class FugueSeq:
                     e.deleted_by.append(deleter)
                 if e.deleted:
                     continue
-                pos = self.treap.visible_rank(e)
-                had = e.vis_w
+                if compute_pos:
+                    pos = self.treap.visible_rank(e)
+                    if e.vis_w:
+                        removed.append((pos, 1))
                 e.deleted = True
                 self.treap.set_visible(e, 0)
-                if had:
-                    removed.append((pos, 1))
         return _merge_removed(removed)
 
     def delta_between(self, va, vb, as_text: bool):

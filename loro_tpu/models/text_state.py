@@ -27,23 +27,31 @@ class TextState(ContainerState):
         self.n_anchors = 0  # fast path: style scans skipped when 0
 
     # -- op application ----------------------------------------------
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         c = op.content
         if isinstance(c, SeqInsert):
             parent = _resolve_run_cont(c.parent, peer, op.counter)
             if isinstance(c.content, StyleAnchor):
-                self.seq.integrate_insert(peer, op.counter, parent, c.side, [c.content], lamport)
+                self.seq.integrate_insert(
+                    peer, op.counter, parent, c.side, [c.content], lamport, compute_pos=False
+                )
                 self.n_anchors += 1
                 # anchors are invisible; the style change event is the
                 # attribute delta over the covered visible range
-                return self._style_event_for_anchor(peer, op.counter)
-            pos, _ = self.seq.integrate_insert(peer, op.counter, parent, c.side, c.content, lamport)
+                return self._style_event_for_anchor(peer, op.counter) if record else None
+            pos, _ = self.seq.integrate_insert(
+                peer, op.counter, parent, c.side, c.content, lamport, compute_pos=record
+            )
+            if not record:
+                return None
             attrs = (
                 self._styles_at_elem(self.seq.by_id[(peer, op.counter)]) if self.n_anchors else {}
             )
             return Delta().retain(pos).insert(c.content, attrs or None)
         assert isinstance(c, SeqDelete)
-        removed = self.seq.integrate_delete(c.spans, deleter=ID(peer, op.counter))
+        removed = self.seq.integrate_delete(
+            c.spans, deleter=ID(peer, op.counter), compute_pos=record
+        )
         if not removed:
             return None
         out = Delta()

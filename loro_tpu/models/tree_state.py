@@ -42,29 +42,31 @@ class TreeState(ContainerState):
         self.moves: List[Tuple[Tuple[int, int, int], TreeMove]] = []
 
     # ------------------------------------------------------------------
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         c = op.content
         assert isinstance(c, TreeMove)
         key = (lamport, peer, op.counter)
         entry = (key, c)
         if not self.moves or self.moves[-1][0] < key:
             self.moves.append(entry)
-            return self._apply_move(key, c)
+            return self._apply_move(key, c, record)
         # out-of-order in lamport: insert into log and replay
         i = bisect.bisect_left(self.moves, key, key=lambda e: e[0])
         if i < len(self.moves) and self.moves[i][0] == key:
             return None  # duplicate
         self.moves.insert(i, entry)
-        return self._replay_all()
+        return self._replay_all(record)
 
-    def _apply_move(self, key: Tuple, c: TreeMove) -> Optional[Diff]:
+    def _apply_move(self, key: Tuple, c: TreeMove, record: bool = True) -> Optional[Diff]:
         target = c.target
         parent = TRASH if c.is_delete else c.parent
         if parent is not None and parent != TRASH and self._creates_cycle(target, parent):
             return None  # not effected
         was = self.nodes.get(target)
-        was_alive = was is not None and not self._is_deleted(target)
+        was_alive = record and was is not None and not self._is_deleted(target)
         self.nodes[target] = TreeNode(parent, c.position, key)
+        if not record:
+            return None
         now_alive = not self._is_deleted(target)
         d = TreeDiff()
         if was_alive and not now_alive:
@@ -81,10 +83,14 @@ class TreeState(ContainerState):
             return None  # dead -> dead: invisible
         return d
 
-    def _replay_all(self) -> Optional[Diff]:
+    def _replay_all(self, record: bool = True) -> Optional[Diff]:
         """Rebuild node table by replaying the sorted move log, then diff
         old vs new tables (reference retreat/forward, tree.rs:230-396)."""
-        old = {t: (n.parent, n.position) for t, n in self.nodes.items() if not self._is_deleted(t)}
+        old = (
+            {t: (n.parent, n.position) for t, n in self.nodes.items() if not self._is_deleted(t)}
+            if record
+            else {}
+        )
         self.nodes = {}
         for key, c in self.moves:
             target = c.target
@@ -92,6 +98,8 @@ class TreeState(ContainerState):
             if parent is not None and parent != TRASH and self._creates_cycle(target, parent):
                 continue
             self.nodes[target] = TreeNode(parent, c.position, key)
+        if not record:
+            return None
         d = TreeDiff()
         new_alive = {t for t in self.nodes if not self._is_deleted(t)}
         for t in old:

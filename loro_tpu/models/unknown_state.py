@@ -15,7 +15,7 @@ class UnknownState(ContainerState):
         super().__init__(cid)
         self.ops: List[Op] = []
 
-    def apply_op(self, op: Op, peer: int, lamport: int) -> Optional[Diff]:
+    def apply_op(self, op: Op, peer: int, lamport: int, record: bool = True) -> Optional[Diff]:
         self.ops.append(op)
         return None
 

@@ -65,7 +65,7 @@ class DocState:
                 lamport = ch.lamport + (op.counter - ch.ctr_start)
                 self._register_children(op, ch.peer)
                 st = self.get_or_create(op.container)
-                d = st.apply_op(op, ch.peer, lamport)
+                d = st.apply_op(op, ch.peer, lamport, record=record)
                 if record and d is not None:
                     diffs.setdefault(op.container, []).append(d)
             self.vv.extend_to_include(ch.id_span())

@@ -9,10 +9,11 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, TYPE_CHECKING
 
-from .core.change import Change, Op, OpContent
-from .core.ids import ContainerID, ID
+from .core.change import Change, MapSet, MovableSet, Op, OpContent, SeqInsert, TreeMove
+from .core.ids import ContainerID, ID, TreeID
 from .core.version import Frontiers
 from .event import Diff
+from .models.handlers import _ChildMarker, _TreeTargetMarker
 
 if TYPE_CHECKING:  # pragma: no cover
     from .doc import LoroDoc
@@ -47,10 +48,11 @@ class Transaction:
         lamport = self.start_lamport + (counter - self.start_counter)
         self.doc.state._register_children(op, self.peer)
         st = self.doc.state.get_or_create(cid)
-        d = st.apply_op(op, self.peer, lamport)
+        record = self.doc.observer.has_subscribers()
+        d = st.apply_op(op, self.peer, lamport, record=record)
         # diff objects are only kept when someone will consume them
         # (reference skips event building with no subscribers)
-        if d is not None and self.doc.observer.has_subscribers():
+        if d is not None and record:
             self.diffs.setdefault(cid, []).append(d)
         self.ops.append(op)
         self.next_counter += op.atom_len()
@@ -62,10 +64,6 @@ class Transaction:
     def _resolve_markers(self, content: OpContent, counter: int) -> OpContent:
         """Replace handler-side child/tree markers with real ids — the
         child container id / tree node id is the op's own (peer, counter)."""
-        from .core.change import MapSet, MovableSet, SeqInsert, TreeMove
-        from .core.ids import TreeID
-        from .models.handlers import _ChildMarker, _TreeTargetMarker
-
         if isinstance(content, MapSet) and isinstance(content.value, _ChildMarker):
             cid = ContainerID.normal(self.peer, counter, content.value.ctype)
             content.value.cid = cid

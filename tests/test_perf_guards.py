@@ -39,8 +39,9 @@ def _median3(fn, *args) -> float:
 
 
 def test_text_insert_not_quadratic():
-    small = max(_median3(_time_text_insert, 2000), 1e-4)
-    big = _median3(_time_text_insert, 8000)
+    # sizes large enough that interpreter warmup noise doesn't dominate
+    small = max(_median3(_time_text_insert, 4000), 1e-3)
+    big = _median3(_time_text_insert, 16000)
     # 4x work: quadratic would be ~16x; n log n with noise stays well under
     assert big / small < 10, f"text insert scaling {big/small:.1f}x for 4x work"
 

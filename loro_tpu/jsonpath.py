@@ -9,8 +9,7 @@ reference: crates/loro-internal/src/jsonpath/ (pest grammar + evaluator
   .*  [*]               wildcard
   ..key  ..*            recursive descent
   [?(@.k op lit)]       filters (==, !=, <, <=, >, >=)
-Results are deep values; handler-level results available via
-query_handlers.
+Results are deep values (container contents resolve recursively).
 """
 from __future__ import annotations
 

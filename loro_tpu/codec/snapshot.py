@@ -29,8 +29,8 @@ from .binary import Reader, Writer, _Dicts, _read_cid, _read_value, _write_cid, 
 S_MAP, S_SEQ, S_MOVABLE, S_TREE, S_COUNTER, S_UNKNOWN = range(6)
 
 # bump on any incompatible state-table layout change (v2: per-element
-# deleted_by records in sequence tables)
-STATE_FORMAT = 2
+# deleted_by records; v3: movable-list slot/set histories)
+STATE_FORMAT = 3
 
 # element content tags for sequence states
 E_CHAR, E_VALUE, E_ANCHOR, E_ELEMREF = range(4)
@@ -170,6 +170,17 @@ def encode_container_state(w: Writer, d: _Dicts, st) -> None:
             w.varint(d.peer(entry.slot.peer))
             w.zigzag(entry.slot.counter)
             w.u8(1 if entry.deleted else 0)
+            w.varint(len(entry.slots))
+            for sid in entry.slots:
+                w.varint(d.peer(sid.peer))
+                w.zigzag(sid.counter)
+            w.varint(len(entry.sets))
+            for lam, sp, oid, val in entry.sets:
+                w.varint(lam)
+                w.varint(d.peer(sp))
+                w.varint(d.peer(oid.peer))
+                w.zigzag(oid.counter)
+                _write_value(w, d, val)
     elif isinstance(st, TreeState):
         w.u8(S_TREE)
         w.varint(len(st.moves))
@@ -235,6 +246,11 @@ def decode_container_state(
             slot = ID(peers[r.varint()], r.zigzag())
             entry = ElemEntry(value, vk, pk, slot)
             entry.deleted = bool(r.u8())
+            entry.slots = [ID(peers[r.varint()], r.zigzag()) for _ in range(r.varint())]
+            entry.sets = [
+                (r.varint(), peers[r.varint()], ID(peers[r.varint()], r.zigzag()), _read_value(r, cids))
+                for _ in range(r.varint())
+            ]
             st.elems[eid] = entry
         return st
     if tag == S_TREE:

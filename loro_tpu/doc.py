@@ -742,9 +742,12 @@ class LoroDoc:
             u_state = self._state_at_vv(union)
         out: Dict[ContainerID, Any] = {}
         for cid, st in u_state.states.items():
-            if cid.ctype not in (ContainerType.Text, ContainerType.List):
+            if cid.ctype == ContainerType.MovableList:
+                d = st.delta_between(va, vb)
+            elif cid.ctype in (ContainerType.Text, ContainerType.List):
+                d = st.seq.delta_between(va, vb, as_text=cid.ctype == ContainerType.Text)
+            else:
                 continue
-            d = st.seq.delta_between(va, vb, as_text=cid.ctype == ContainerType.Text)
             if not d.is_empty():
                 out[cid] = d
         return out
@@ -977,7 +980,11 @@ def _diff_values(
 
     out: Dict[ContainerID, Any] = {}
     for cid in set(va) | set(vb):
-        if skip_seq and cid.ctype in (ContainerType.Text, ContainerType.List):
+        if skip_seq and cid.ctype in (
+            ContainerType.Text,
+            ContainerType.List,
+            ContainerType.MovableList,
+        ):
             continue  # exact deltas computed separately (no difflib cost)
         old_v = va.get(cid)
         new_v = vb.get(cid)

@@ -27,7 +27,7 @@ from .seq_crdt import FugueSeq, SeqElem
 
 
 class ElemEntry:
-    __slots__ = ("value", "value_key", "pos_key", "slot", "deleted")
+    __slots__ = ("value", "value_key", "pos_key", "slot", "deleted", "slots", "sets")
 
     def __init__(self, value: Any, value_key: Tuple[int, int], pos_key: Tuple[int, int], slot: ID):
         self.value = value
@@ -35,6 +35,9 @@ class ElemEntry:
         self.pos_key = pos_key  # (lamport, peer) of winning slot
         self.slot = slot  # winning slot id
         self.deleted = False
+        # full histories for version-diff evaluation:
+        self.slots: List[ID] = [slot]  # every position slot ever created
+        self.sets: List[Tuple[int, int, ID, Any]] = []  # (lamport, peer, op id, value)
 
 
 class MovableListState(ContainerState):
@@ -51,7 +54,7 @@ class MovableListState(ContainerState):
         if isinstance(c, SeqDelete):
             return self._apply_delete(c, record)
         if isinstance(c, MovableSet):
-            return self._apply_set(c, peer, lamport, record)
+            return self._apply_set(c, peer, lamport, record, op_id=ID(peer, op.counter))
         assert isinstance(c, MovableMove)
         return self._apply_move(op, c, peer, lamport, record)
 
@@ -65,7 +68,9 @@ class MovableListState(ContainerState):
         )
         for j, (eid, v) in enumerate(zip(elem_ids, c.content)):
             key = (lamport + j, peer)
-            self.elems[eid] = ElemEntry(v, key, key, eid)
+            entry = ElemEntry(v, key, key, eid)
+            entry.sets.append((lamport + j, peer, eid, v))  # creation value
+            self.elems[eid] = entry
         if not record:
             return None
         return Delta().retain(pos).insert(tuple(c.content))
@@ -91,10 +96,14 @@ class MovableListState(ContainerState):
                     changed = True
         return out if changed else None
 
-    def _apply_set(self, c: MovableSet, peer: int, lamport: int, record: bool) -> Optional[Diff]:
+    def _apply_set(
+        self, c: MovableSet, peer: int, lamport: int, record: bool, op_id: Optional[ID] = None
+    ) -> Optional[Diff]:
         entry = self.elems.get(c.elem)
         if entry is None:
             return None  # element unknown (trimmed history)
+        if op_id is not None:
+            entry.sets.append((lamport, peer, op_id, c.value))
         if entry.value_key >= (lamport, peer):
             return None
         entry.value = c.value
@@ -121,6 +130,7 @@ class MovableListState(ContainerState):
         self.seq.set_visible(new_slot, 0)
         if entry is None:
             return None  # unknown element (trimmed history)
+        entry.slots.append(ID(peer, op.counter))
         new_key = (lamport, peer)
         if new_key <= entry.pos_key:
             return None  # stale move: slot stays invisible
@@ -146,6 +156,57 @@ class MovableListState(ContainerState):
         if not record:
             return None
         return d if (was_visible or revived or not new_slot.deleted) else None
+
+    # -- version diffs -------------------------------------------------
+    def _slot_visible_at(self, slot: SeqElem, v) -> bool:
+        """Slot shows the element at version v iff it exists, isn't
+        deleted, and is the LWW winner among the element's slots in v."""
+        if not v.includes(slot.id) or any(v.includes(x) for x in slot.deleted_by):
+            return False
+        entry = self.elems.get(slot.content)
+        if entry is None:
+            return False
+        best = None
+        for sid in entry.slots:
+            if not v.includes(sid):
+                continue
+            se = self.seq.by_id.get((sid.peer, sid.counter))
+            if se is None:
+                continue
+            k = (se.lamport, se.peer)
+            if best is None or k > best[0]:
+                best = (k, se)
+        return best is not None and best[1] is slot
+
+    def _value_at(self, elem_id: ID, v) -> Any:
+        entry = self.elems.get(elem_id)
+        best = None
+        if entry is not None:
+            for lam, peer, oid, val in entry.sets:
+                if v.includes(oid) and (best is None or (lam, peer) > best[0]):
+                    best = ((lam, peer), val)
+        return best[1] if best else None
+
+    def delta_between(self, va, vb) -> Delta:
+        """Exact delta turning the list at va into the list at vb
+        (element/slot identity aware; value changes become replace)."""
+        d = Delta()
+        for slot in self.seq.all_elems():
+            a_vis = self._slot_visible_at(slot, va)
+            b_vis = self._slot_visible_at(slot, vb)
+            if a_vis and b_vis:
+                a_val = self._value_at(slot.content, va)
+                b_val = self._value_at(slot.content, vb)
+                if a_val == b_val:
+                    d.retain(1)
+                else:
+                    d.delete(1)
+                    d.insert((b_val,))
+            elif a_vis:
+                d.delete(1)
+            elif b_vis:
+                d.insert((self._value_at(slot.content, vb),))
+        return d.chop()
 
     # -- queries ------------------------------------------------------
     def get_value(self) -> List[Any]:

@@ -169,6 +169,56 @@ class TestExactSeqDiff:
         assert delta.apply_to_text("base") == "base-B"
 
 
+class TestMovableExactDiff:
+    def test_move_and_set_diff(self):
+        from loro_tpu import Delete, Insert, Retain
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("ml")
+        ml.push("a", "b", "c")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        ml.move(0, 2)  # -> b c a
+        ml.set(0, "B")  # -> B c a
+        doc.commit()
+        f2 = doc.oplog_frontiers()
+        batch = doc.diff(f1, f2)
+        delta = next(iter(batch.values()))
+        assert delta.apply_to_list(["a", "b", "c"]) == ["B", "c", "a"]
+        # identity-aware: the move is delete@0 + insert@2, not a rewrite
+        assert delta.delete_len() == 2 and delta.insert_len() == 2
+
+    def test_checkout_event_exact(self):
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("ml")
+        ml.push(1, 2, 3)
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        ml.move(2, 0)
+        doc.commit()
+        events = []
+        doc.subscribe_root(events.append)
+        doc.checkout(f1)
+        delta = events[-1].diffs[0].diff
+        assert delta.apply_to_list([3, 1, 2]) == [1, 2, 3]
+        doc.checkout_to_latest()
+
+    def test_snapshot_preserves_histories(self):
+        a = LoroDoc(peer=1)
+        ml = a.get_movable_list("ml")
+        ml.push("x", "y")
+        a.commit()
+        f1 = a.oplog_frontiers()
+        ml.move(0, 1)
+        ml.set(0, "Y")
+        a.commit()
+        f2 = a.oplog_frontiers()
+        b = LoroDoc(peer=2)
+        b.import_(a.export_snapshot())
+        delta = next(iter(b.diff(f1, f2).values()))
+        assert delta.apply_to_list(["x", "y"]) == ["Y", "x"]
+
+
 class TestDiffRevert:
     def test_diff_and_apply(self):
         doc = LoroDoc(peer=1)

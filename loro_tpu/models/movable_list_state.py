@@ -52,9 +52,9 @@ class MovableListState(ContainerState):
         if isinstance(c, SeqInsert):
             return self._apply_insert(op, c, peer, lamport, record)
         if isinstance(c, SeqDelete):
-            return self._apply_delete(c, record)
+            return self._apply_delete(c, record, ID(peer, op.counter))
         if isinstance(c, MovableSet):
-            return self._apply_set(c, peer, lamport, record, op_id=ID(peer, op.counter))
+            return self._apply_set(c, peer, lamport, record, ID(peer, op.counter))
         assert isinstance(c, MovableMove)
         return self._apply_move(op, c, peer, lamport, record)
 
@@ -75,13 +75,18 @@ class MovableListState(ContainerState):
             return None
         return Delta().retain(pos).insert(tuple(c.content))
 
-    def _apply_delete(self, c: SeqDelete, record: bool) -> Optional[Diff]:
+    def _apply_delete(self, c: SeqDelete, record: bool, op_id: ID) -> Optional[Diff]:
         out = Delta()
         changed = False
         for span in c.spans:
             for ctr in range(span.start, span.end):
                 slot = self.seq.by_id.get((span.peer, ctr))
-                if slot is None or slot.deleted:
+                if slot is None:
+                    continue
+                # record the deleter even on already-dead slots so
+                # version diffs can evaluate visibility at any vv
+                slot.deleted_by.append(op_id)
+                if slot.deleted:
                     continue
                 was_visible = slot.vis_w > 0
                 pos = self.seq.treap.visible_rank(slot) if (record and was_visible) else 0
@@ -97,13 +102,12 @@ class MovableListState(ContainerState):
         return out if changed else None
 
     def _apply_set(
-        self, c: MovableSet, peer: int, lamport: int, record: bool, op_id: Optional[ID] = None
+        self, c: MovableSet, peer: int, lamport: int, record: bool, op_id: ID
     ) -> Optional[Diff]:
         entry = self.elems.get(c.elem)
         if entry is None:
             return None  # element unknown (trimmed history)
-        if op_id is not None:
-            entry.sets.append((lamport, peer, op_id, c.value))
+        entry.sets.append((lamport, peer, op_id, c.value))
         if entry.value_key >= (lamport, peer):
             return None
         entry.value = c.value
@@ -158,25 +162,33 @@ class MovableListState(ContainerState):
         return d if (was_visible or revived or not new_slot.deleted) else None
 
     # -- version diffs -------------------------------------------------
-    def _slot_visible_at(self, slot: SeqElem, v) -> bool:
+    def _winner_at(self, elem_id: ID, v, cache: Dict[ID, Optional[SeqElem]]) -> Optional[SeqElem]:
+        """LWW-winning slot of an element within version v (memoized per
+        diff so an element moved M times costs O(M) once, not per slot)."""
+        if elem_id in cache:
+            return cache[elem_id]
+        entry = self.elems.get(elem_id)
+        best = None
+        if entry is not None:
+            for sid in entry.slots:
+                if not v.includes(sid):
+                    continue
+                se = self.seq.by_id.get((sid.peer, sid.counter))
+                if se is None:
+                    continue
+                k = (se.lamport, se.peer)
+                if best is None or k > best[0]:
+                    best = (k, se)
+        win = best[1] if best is not None else None
+        cache[elem_id] = win
+        return win
+
+    def _slot_visible_at(self, slot: SeqElem, v, cache: Dict[ID, Optional[SeqElem]]) -> bool:
         """Slot shows the element at version v iff it exists, isn't
         deleted, and is the LWW winner among the element's slots in v."""
         if not v.includes(slot.id) or any(v.includes(x) for x in slot.deleted_by):
             return False
-        entry = self.elems.get(slot.content)
-        if entry is None:
-            return False
-        best = None
-        for sid in entry.slots:
-            if not v.includes(sid):
-                continue
-            se = self.seq.by_id.get((sid.peer, sid.counter))
-            if se is None:
-                continue
-            k = (se.lamport, se.peer)
-            if best is None or k > best[0]:
-                best = (k, se)
-        return best is not None and best[1] is slot
+        return self._winner_at(slot.content, v, cache) is slot
 
     def _value_at(self, elem_id: ID, v) -> Any:
         entry = self.elems.get(elem_id)
@@ -191,9 +203,11 @@ class MovableListState(ContainerState):
         """Exact delta turning the list at va into the list at vb
         (element/slot identity aware; value changes become replace)."""
         d = Delta()
+        cache_a: Dict[ID, Optional[SeqElem]] = {}
+        cache_b: Dict[ID, Optional[SeqElem]] = {}
         for slot in self.seq.all_elems():
-            a_vis = self._slot_visible_at(slot, va)
-            b_vis = self._slot_visible_at(slot, vb)
+            a_vis = self._slot_visible_at(slot, va, cache_a)
+            b_vis = self._slot_visible_at(slot, vb, cache_b)
             if a_vis and b_vis:
                 a_val = self._value_at(slot.content, va)
                 b_val = self._value_at(slot.content, vb)

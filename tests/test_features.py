@@ -203,6 +203,28 @@ class TestMovableExactDiff:
         assert delta.apply_to_list([3, 1, 2]) == [1, 2, 3]
         doc.checkout_to_latest()
 
+    def test_delete_diff(self):
+        """Regression: movable deletes must appear in version diffs
+        (deleted_by was not recorded — review finding)."""
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("ml")
+        ml.push("a", "b", "c")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        ml.delete(1, 1)
+        doc.commit()
+        f2 = doc.oplog_frontiers()
+        batch = doc.diff(f1, f2)
+        delta = next(iter(batch.values()))
+        assert delta.apply_to_list(["a", "b", "c"]) == ["a", "c"]
+        # and the checkout event restores it
+        events = []
+        doc.subscribe_root(events.append)
+        doc.checkout(f1)
+        d2 = events[-1].diffs[0].diff
+        assert d2.apply_to_list(["a", "c"]) == ["a", "b", "c"]
+        doc.checkout_to_latest()
+
     def test_snapshot_preserves_histories(self):
         a = LoroDoc(peer=1)
         ml = a.get_movable_list("ml")

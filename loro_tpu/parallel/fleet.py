@@ -134,6 +134,129 @@ class Fleet:
         return self.merge_text_docs(extracts)
 
     # ------------------------------------------------------------------
+    # movable list merge
+    # ------------------------------------------------------------------
+    def merge_movable_changes(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[list]:
+        """Batched movable-list merge: per-doc change lists -> final
+        value lists (one vmapped launch)."""
+        import jax.numpy as jnp
+
+        from ..ops.fugue_batch import SeqColumns, pad_bucket, pad_seq_columns
+        from ..ops.movable_batch import MovableCols, extract_movable, movable_merge_batch
+
+        extracts = [extract_movable(chs, cid) for chs in docs_changes]
+        s = pad_bucket(max(1, max(c.seq.parent.shape[0] for c, _, _ in extracts)))
+        k = pad_bucket(max(1, max(c.set_elem.shape[0] for c, _, _ in extracts)), floor=16)
+        n_elems = pad_bucket(max(1, max(len(e) for _, e, _ in extracts)), floor=16)
+        d = len(extracts)
+        d_mesh = self.mesh.shape[DOC_AXIS]
+        d_pad = ((d + d_mesh - 1) // d_mesh) * d_mesh
+
+        def padk(a, fill, dtype):
+            out = np.full(k, fill, dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        def pads(a, fill, dtype):
+            out = np.full(s, fill, dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        seq_stack = []
+        lam, se, sl, sp, sv, svd = [], [], [], [], [], []
+        for c, _, _ in extracts:
+            seq_stack.append(pad_seq_columns(c.seq, s))
+            lam.append(pads(c.lamport, 0, np.int32))
+            se.append(padk(c.set_elem, 0, np.int32))
+            sl.append(padk(c.set_lamport, 0, np.int32))
+            sp.append(padk(c.set_peer, 0, np.int32))
+            sv.append(padk(c.set_value, 0, np.int32))
+            svd.append(padk(c.set_valid, False, bool))
+        empty_seq = pad_seq_columns(
+            SeqColumns(*[np.zeros(0, dt) for dt in (np.int32,) * 4 + (bool, np.int32, bool)]), s
+        )
+        while len(seq_stack) < d_pad:
+            seq_stack.append(empty_seq)
+            lam.append(np.zeros(s, np.int32))
+            se.append(np.zeros(k, np.int32))
+            sl.append(np.zeros(k, np.int32))
+            sp.append(np.zeros(k, np.int32))
+            sv.append(np.zeros(k, np.int32))
+            svd.append(np.zeros(k, bool))
+        sh = doc_sharding(self.mesh)
+        cols = MovableCols(
+            seq=SeqColumns(
+                *[
+                    jax.device_put(np.stack([getattr(q, f) for q in seq_stack]), sh)
+                    for f in SeqColumns._fields
+                ]
+            ),
+            lamport=jax.device_put(np.stack(lam), sh),
+            set_elem=jax.device_put(np.stack(se), sh),
+            set_lamport=jax.device_put(np.stack(sl), sh),
+            set_peer=jax.device_put(np.stack(sp), sh),
+            set_value=jax.device_put(np.stack(sv), sh),
+            set_valid=jax.device_put(np.stack(svd), sh),
+        )
+        out, counts = movable_merge_batch(cols, n_elems)
+        out = np.asarray(out)
+        counts = np.asarray(counts)
+        results = []
+        for i, (_, _, values) in enumerate(extracts):
+            idxs = out[i, : counts[i]]
+            results.append([values[j] if j >= 0 else None for j in idxs])
+        return results
+
+    # ------------------------------------------------------------------
+    # tree merge
+    # ------------------------------------------------------------------
+    def merge_tree_changes(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[dict]:
+        """Batched movable-tree merge: per-doc change lists -> parent
+        maps {TreeID: parent TreeID | None} of alive nodes."""
+        import jax.numpy as jnp
+
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.tree_batch import (
+            ABSENT,
+            ROOT,
+            TRASH,
+            TreeOpCols,
+            extract_tree_ops,
+            is_deleted_batch,
+            pad_tree_cols,
+            tree_merge_batch,
+        )
+
+        extracted = [extract_tree_ops(chs, cid) for chs in docs_changes]
+        m = pad_bucket(max(1, max(c.target.shape[0] for c, _, _ in extracted)), floor=16)
+        n = max(1, max(len(nodes) for _, nodes, _ in extracted))
+        d = len(extracted)
+        d_mesh = self.mesh.shape[DOC_AXIS]
+        d_pad = ((d + d_mesh - 1) // d_mesh) * d_mesh
+        padded = [pad_tree_cols(c, m) for c, _, _ in extracted]
+        empty = TreeOpCols(
+            target=np.zeros(m, np.int32), parent=np.full(m, ROOT, np.int32), valid=np.zeros(m, bool)
+        )
+        padded += [empty] * (d_pad - d)
+        sh = doc_sharding(self.mesh)
+        cols = TreeOpCols(
+            *[jax.device_put(np.stack([getattr(c, f) for c in padded]), sh) for f in TreeOpCols._fields]
+        )
+        parents, _eff = tree_merge_batch(cols, n)
+        deleted = np.asarray(is_deleted_batch(parents))
+        parents = np.asarray(parents)
+        out = []
+        for i, (_, nodes, _) in enumerate(extracted):
+            res = {}
+            for j, tid in enumerate(nodes):
+                p = int(parents[i, j])
+                if p == ABSENT or deleted[i, j]:
+                    continue
+                res[tid] = None if p == ROOT else nodes[p]
+            out.append(res)
+        return out
+
+    # ------------------------------------------------------------------
     # LWW map merge
     # ------------------------------------------------------------------
     def merge_map_docs(self, extracts: Sequence[MapExtract]) -> List[Dict[str, object]]:

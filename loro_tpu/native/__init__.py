@@ -72,6 +72,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong,
             ctypes.c_int,
         ] + [ctypes.c_void_p] * 6 + [ctypes.c_longlong]
+        lib.loro_count_map_ops.restype = ctypes.c_longlong
+        lib.loro_count_map_ops.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+        lib.loro_explode_map.restype = ctypes.c_longlong
+        lib.loro_explode_map.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+        ] + [ctypes.c_void_p] * 5 + [ctypes.c_longlong]
         _lib = lib
         return lib
 
@@ -114,3 +121,33 @@ def explode_seq_payload(payload: bytes, target_cid_index: int):
     if wrote != n:
         raise ValueError("native decode failed (unresolvable refs or count mismatch)")
     return parent, side, peer, counter, deleted.astype(bool), content
+
+
+def explode_map_payload(payload: bytes):
+    """All MapSet/MapDel rows of a payload as numpy columns
+    (cid_idx, key_idx, lamport, peer_idx, value_ordinal|-1) or None when
+    the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.loro_count_map_ops(payload, len(payload))
+    if n < 0:
+        raise ValueError("native decode failed (malformed payload?)")
+    cid = np.empty(n, np.int32)
+    key = np.empty(n, np.int32)
+    lamport = np.empty(n, np.int32)
+    peer = np.empty(n, np.int32)
+    value = np.empty(n, np.int32)
+    wrote = lib.loro_explode_map(
+        payload,
+        len(payload),
+        cid.ctypes.data_as(ctypes.c_void_p),
+        key.ctypes.data_as(ctypes.c_void_p),
+        lamport.ctypes.data_as(ctypes.c_void_p),
+        peer.ctypes.data_as(ctypes.c_void_p),
+        value.ctypes.data_as(ctypes.c_void_p),
+        n,
+    )
+    if wrote != n:
+        raise ValueError("native decode failed (count mismatch)")
+    return cid, key, lamport, peer, value

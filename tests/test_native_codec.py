@@ -98,6 +98,31 @@ class TestNativeDecoder:
         ex = extract_seq_from_payload(_payload(doc), other)
         assert ex.n == 0
 
+    def test_map_explode_matches_python(self):
+        import numpy as np
+
+        from loro_tpu.native import explode_map_payload
+        from loro_tpu.ops.columnar import extract_map_ops
+
+        docs = [LoroDoc(peer=1), LoroDoc(peer=2)]
+        a, b = docs
+        a.get_map("m").set("x", 1)
+        a.get_map("m2").set("y", {"n": [1, 2]})
+        b.import_(a.export_updates())
+        b.get_map("m").set("x", 2)
+        b.get_map("m").delete("x")
+        b.get_text("t").insert(0, "noise")  # interleaved non-map ops
+        a.import_(b.export_updates(a.oplog_vv()))
+        payload = _payload(a)
+        out = explode_map_payload(payload)
+        assert out is not None
+        cid, key, lamport, peer, value = out
+        ex = extract_map_ops(a.oplog.changes_in_causal_order())
+        assert len(cid) == len(ex.slot)
+        np.testing.assert_array_equal(lamport, ex.lamport)
+        # deletes carry ordinal -1
+        assert (value == -1).sum() == 1
+
     def test_malformed_payload_raises(self):
         doc = LoroDoc(peer=1)
         doc.get_text("t").insert(0, "abcdef")

@@ -124,9 +124,12 @@ def explode_seq_payload(payload: bytes, target_cid_index: int):
 
 
 def explode_map_payload(payload: bytes):
-    """All MapSet/MapDel rows of a payload as numpy columns
-    (cid_idx, key_idx, lamport, peer_idx, value_ordinal|-1) or None when
-    the native library is unavailable."""
+    """All MapSet/MapDel rows of a payload, or None when the native
+    library is unavailable.  Returns a dict with numpy columns
+    (cid_idx, key_idx, lamport, peer_rank, value_ordinal|-1) and the
+    decoding tables (peers sorted-u64, keys, cids).  peer_rank follows
+    the sorted-peer ordering the LWW kernels' (lamport, peer) tie-break
+    contract requires — NOT wire registration order."""
     lib = _load()
     if lib is None:
         return None
@@ -150,4 +153,25 @@ def explode_map_payload(payload: bytes):
     )
     if wrote != n:
         raise ValueError("native decode failed (count mismatch)")
-    return cid, key, lamport, peer, value
+    # wire peer table is registration-ordered; remap to sorted ranks
+    # (same contract handling as extract_seq_from_payload)
+    from ..codec.binary import Reader, _read_cid
+
+    r = Reader(payload)
+    peers_wire = [r.u64le() for _ in range(r.varint())]
+    keys = [r.str_() for _ in range(r.varint())]
+    cids = [_read_cid(r, peers_wire) for _ in range(r.varint())]
+    order = np.argsort(np.asarray(peers_wire, np.uint64), kind="stable")
+    rank_of = np.empty(len(peers_wire), np.int32)
+    rank_of[order] = np.arange(len(peers_wire), dtype=np.int32)
+    peer_rank = rank_of[peer] if len(peers_wire) else peer
+    return {
+        "cid_idx": cid,
+        "key_idx": key,
+        "lamport": lamport,
+        "peer_rank": peer_rank.astype(np.int32),
+        "value_ordinal": value,
+        "peers": sorted(peers_wire),
+        "keys": keys,
+        "cids": cids,
+    }

@@ -36,6 +36,32 @@ class TextMergeResult:
     texts: List[str]
 
 
+def _mesh_pad(mesh, d: int) -> int:
+    """Doc count padded up to a multiple of the mesh's doc dimension."""
+    dm = mesh.shape[DOC_AXIS]
+    return ((d + dm - 1) // dm) * dm
+
+
+def _empty_seq_np(n: int):
+    """All-invalid numpy SeqColumns of n rows (doc-axis padding filler)."""
+    import numpy as _np
+
+    from ..ops.fugue_batch import SeqColumns, pad_seq_columns
+
+    return pad_seq_columns(
+        SeqColumns(
+            parent=_np.zeros(0, _np.int32),
+            side=_np.zeros(0, _np.int32),
+            peer=_np.zeros(0, _np.int32),
+            counter=_np.zeros(0, _np.int32),
+            deleted=_np.zeros(0, bool),
+            content=_np.zeros(0, _np.int32),
+            valid=_np.zeros(0, bool),
+        ),
+        n,
+    )
+
+
 class Fleet:
     """Batched merge front-end bound to a device mesh."""
 
@@ -71,9 +97,8 @@ class Fleet:
             self._text_fn = self._build_text_fn()
         tracing.instant("fleet.merge_text_docs", docs=len(extracts))
         n = pad_bucket(max(e.n for e in extracts))
-        d_mesh = self.mesh.shape[DOC_AXIS]
         d = len(extracts)
-        d_pad = pad_docs or ((d + d_mesh - 1) // d_mesh) * d_mesh
+        d_pad = pad_docs or _mesh_pad(self.mesh, d)
         cols_np = [e.to_seq_columns(pad_to=n) for e in extracts]
         empty = SeqColumns(
             parent=np.full(n, -1, np.int32),
@@ -134,6 +159,77 @@ class Fleet:
         return self.merge_text_docs(extracts)
 
     # ------------------------------------------------------------------
+    # rich text merge
+    # ------------------------------------------------------------------
+    def merge_richtext_changes(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[list]:
+        """Batched rich-text merge: per-doc change lists -> Quill-style
+        segment lists with resolved styles (one vmapped launch)."""
+        from ..ops.fugue_batch import pad_bucket, pad_seq_columns
+        from ..ops.richtext_batch import RichtextCols, extract_richtext, richtext_merge_batch
+
+        extracts = [extract_richtext(chs, cid) for chs in docs_changes]
+        n = pad_bucket(max(1, max(c.seq.parent.shape[0] for c, _, _ in extracts)))
+        p = pad_bucket(max(1, max(c.pair_start.shape[0] for c, _, _ in extracts)), floor=16)
+        n_keys = pad_bucket(max(1, max(len(k) for _, k, _ in extracts)), floor=4)
+        d = len(extracts)
+        d_pad = _mesh_pad(self.mesh, d)
+
+        def padp(a, fill, dtype):
+            out = np.full(p, fill, dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        from ..ops.fugue_batch import SeqColumns
+
+        seqs, fields = [], {f: [] for f in RichtextCols._fields if f != "seq"}
+        for c, _, _ in extracts:
+            seqs.append(pad_seq_columns(c.seq, n))
+            for f in fields:
+                a = getattr(c, f)
+                fields[f].append(padp(a, False if f == "pair_valid" else 0, a.dtype))
+        empty_seq = _empty_seq_np(n)
+        while len(seqs) < d_pad:
+            seqs.append(empty_seq)
+            for f in fields:
+                fields[f].append(
+                    np.zeros(p, bool) if f == "pair_valid" else np.zeros(p, np.int32)
+                )
+        sh = doc_sharding(self.mesh)
+        cols = RichtextCols(
+            seq=SeqColumns(
+                *[jax.device_put(np.stack([getattr(q, f) for q in seqs]), sh) for f in SeqColumns._fields]
+            ),
+            **{f: jax.device_put(np.stack(v), sh) for f, v in fields.items()},
+        )
+        codes, counts, bounds, win = richtext_merge_batch(cols, n_keys)
+        codes = np.asarray(codes)
+        counts = np.asarray(counts)
+        bounds = np.asarray(bounds)
+        win = np.asarray(win)
+        results = []
+        for i, (_, keys, values) in enumerate(extracts):
+            text = "".join(map(chr, codes[i, : counts[i]]))
+            segs: List[dict] = []
+            for r in range(bounds.shape[1] - 1):
+                lo, hi = int(bounds[i, r]), int(bounds[i, r + 1])
+                if lo >= hi:
+                    continue
+                attrs = {}
+                for ki in range(len(keys)):
+                    vi = int(win[i, r, ki])
+                    if vi >= 0:
+                        attrs[keys[ki]] = values[vi]
+                seg: dict = {"insert": text[lo:hi]}
+                if attrs:
+                    seg["attributes"] = attrs
+                if segs and segs[-1].get("attributes") == seg.get("attributes"):
+                    segs[-1]["insert"] += seg["insert"]
+                else:
+                    segs.append(seg)
+            results.append(segs)
+        return results
+
+    # ------------------------------------------------------------------
     # movable list merge
     # ------------------------------------------------------------------
     def merge_movable_changes(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[list]:
@@ -149,8 +245,7 @@ class Fleet:
         k = pad_bucket(max(1, max(c.set_elem.shape[0] for c, _, _ in extracts)), floor=16)
         n_elems = pad_bucket(max(1, max(len(e) for _, e, _ in extracts)), floor=16)
         d = len(extracts)
-        d_mesh = self.mesh.shape[DOC_AXIS]
-        d_pad = ((d + d_mesh - 1) // d_mesh) * d_mesh
+        d_pad = _mesh_pad(self.mesh, d)
 
         def padk(a, fill, dtype):
             out = np.full(k, fill, dtype)
@@ -172,9 +267,7 @@ class Fleet:
             sp.append(padk(c.set_peer, 0, np.int32))
             sv.append(padk(c.set_value, 0, np.int32))
             svd.append(padk(c.set_valid, False, bool))
-        empty_seq = pad_seq_columns(
-            SeqColumns(*[np.zeros(0, dt) for dt in (np.int32,) * 4 + (bool, np.int32, bool)]), s
-        )
+        empty_seq = _empty_seq_np(s)
         while len(seq_stack) < d_pad:
             seq_stack.append(empty_seq)
             lam.append(np.zeros(s, np.int32))
@@ -231,8 +324,7 @@ class Fleet:
         m = pad_bucket(max(1, max(c.target.shape[0] for c, _, _ in extracted)), floor=16)
         n = max(1, max(len(nodes) for _, nodes, _ in extracted))
         d = len(extracted)
-        d_mesh = self.mesh.shape[DOC_AXIS]
-        d_pad = ((d + d_mesh - 1) // d_mesh) * d_mesh
+        d_pad = _mesh_pad(self.mesh, d)
         padded = [pad_tree_cols(c, m) for c, _, _ in extracted]
         empty = TreeOpCols(
             target=np.zeros(m, np.int32), parent=np.full(m, ROOT, np.int32), valid=np.zeros(m, bool)
@@ -265,8 +357,7 @@ class Fleet:
         m = pad_bucket(max(1, max(len(e.slot) for e in extracts)))
         s = max(1, max(len(e.slots) for e in extracts))
         d = len(extracts)
-        d_mesh = self.mesh.shape[DOC_AXIS]
-        d_pad = ((d + d_mesh - 1) // d_mesh) * d_mesh
+        d_pad = _mesh_pad(self.mesh, d)
 
         def col(rows_list, fill, dtype):
             out = np.full((d_pad, m), fill, dtype)

@@ -47,6 +47,27 @@ def test_fleet_movable(fleet):
         assert got[i] == d.get_movable_list("ml").get_value(), f"doc {i}"
 
 
+def test_fleet_richtext(fleet):
+    docs = []
+    for i in range(5):
+        a, b = LoroDoc(peer=300 + 2 * i), LoroDoc(peer=301 + 2 * i)
+        t = a.get_text("t")
+        t.insert(0, f"richtext doc {i} body")
+        t.mark(0, 8, "bold", True)
+        b.import_(a.export_snapshot())
+        a.get_text("t").mark(4, 12, "color", "red")
+        b.get_text("t").unmark(2, 6, "bold")
+        b.get_text("t").insert(8, " XY")
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        a.commit()
+        docs.append(a)
+    cid = docs[0].get_text("t").id
+    got = fleet.merge_richtext_changes([d.oplog.changes_in_causal_order() for d in docs], cid)
+    for i, d in enumerate(docs):
+        assert got[i] == d.get_text("t").get_richtext_value(), f"doc {i}"
+
+
 def test_fleet_tree(fleet):
     docs = _make_docs(6, 2, "tree")
     cid = docs[0].get_tree("tr").id

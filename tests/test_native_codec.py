@@ -116,12 +116,34 @@ class TestNativeDecoder:
         payload = _payload(a)
         out = explode_map_payload(payload)
         assert out is not None
-        cid, key, lamport, peer, value = out
         ex = extract_map_ops(a.oplog.changes_in_causal_order())
-        assert len(cid) == len(ex.slot)
-        np.testing.assert_array_equal(lamport, ex.lamport)
+        assert len(out["cid_idx"]) == len(ex.slot)
+        np.testing.assert_array_equal(out["lamport"], ex.lamport)
+        np.testing.assert_array_equal(out["peer_rank"], ex.peer)  # rank contract
+        assert out["peers"] == ex.peers
         # deletes carry ordinal -1
-        assert (value == -1).sum() == 1
+        assert (out["value_ordinal"] == -1).sum() == 1
+
+    def test_map_explode_peer_rank_tiebreak(self):
+        """Regression (review finding): wire registration order must not
+        leak into peer ranks — peer 9 registered first still ranks after
+        peer 1 in the LWW tie-break ordering."""
+        import numpy as np
+
+        from loro_tpu.native import explode_map_payload
+        from loro_tpu.ops.columnar import extract_map_ops
+
+        a, b = LoroDoc(peer=9), LoroDoc(peer=1)
+        a.get_map("m").set("x", "from9")
+        a.commit()
+        b.get_map("m").set("x", "from1")
+        b.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        payload = _payload(a)
+        out = explode_map_payload(payload)
+        ex = extract_map_ops(a.oplog.changes_in_causal_order())
+        np.testing.assert_array_equal(out["peer_rank"], ex.peer)
+        assert out["peers"] == [1, 9]
 
     def test_malformed_payload_raises(self):
         doc = LoroDoc(peer=1)

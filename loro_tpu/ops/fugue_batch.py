@@ -343,6 +343,99 @@ def chain_merge_docs_checksum(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]
     return cs, counts
 
 
+def chain_contract_materialize_u(
+    cols: SeqColumnsU, c_pad: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Device-side chain contraction + order + compaction for the
+    row-order-free layout (the resident-batch path).
+
+    Chains (right-spine runs, columnar.contract_chains conditions) are
+    detected on device: row i links to row i-1 iff parent==i-1, side=R,
+    row i-1 has exactly one child and no L-children, and row i has no
+    L-children.  Cross-epoch runs simply stay split (appended rows are
+    only adjacent within their block) — correctness is unaffected, the
+    contraction is just slightly less aggressive.
+
+    `c_pad` is the static chain budget; returns (codes, count,
+    n_chains).  When n_chains > c_pad the output is INVALID and the
+    caller must retry with a bigger budget (DeviceDocBatch does)."""
+    n = cols.parent.shape[0]
+    valid = cols.valid
+    pgt = jnp.clip(cols.parent, 0, n - 1)
+    has_parent = valid & (cols.parent >= 0)
+    cc = jnp.zeros(n, jnp.int32).at[jnp.where(has_parent, pgt, n - 1)].add(
+        has_parent.astype(jnp.int32)
+    )
+    is_l = has_parent & (cols.side == 0)
+    lc = jnp.zeros(n, jnp.int32).at[jnp.where(is_l, pgt, n - 1)].add(is_l.astype(jnp.int32))
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    prev_ok = jnp.concatenate([jnp.zeros(1, bool), valid[:-1]])
+    link = (
+        valid
+        & prev_ok
+        & (cols.parent == idx - 1)
+        & (cols.side == 1)
+        & (jnp.roll(cc, 1) == 1)
+        & (jnp.roll(lc, 1) == 0)
+        & (lc == 0)
+    )
+    link = link.at[0].set(False)
+    is_head = valid & ~link
+    chain_id = jnp.cumsum(is_head.astype(jnp.int32)) - 1  # per valid row
+    chain_id = jnp.where(valid, chain_id, c_pad)  # pads -> dump
+    n_chains = is_head.sum().astype(jnp.int32)
+
+    cid_clip = jnp.clip(chain_id, 0, c_pad)
+    # chain-level attributes scattered from head rows (chain_id is the
+    # compact index — no sort needed)
+    def head_scatter(src, fill):
+        return jnp.full(c_pad + 1, fill, src.dtype).at[
+            jnp.where(is_head, cid_clip, c_pad)
+        ].set(src, mode="drop")[:c_pad]
+
+    head_row = head_scatter(idx, 0)
+    c_parent_row = head_scatter(jnp.where(cols.parent >= 0, cols.parent, -1), -1)
+    c_parent = jnp.where(
+        c_parent_row >= 0, chain_id[jnp.clip(c_parent_row, 0, n - 1)], -1
+    ).astype(jnp.int32)
+    c_side = head_scatter(cols.side.astype(jnp.int32), 0)
+    c_hi = head_scatter(cols.peer_hi, 0)
+    c_lo = head_scatter(cols.peer_lo, 0)
+    c_ctr = head_scatter(cols.counter.astype(jnp.uint32), 0)
+    c_valid = jnp.arange(c_pad) < n_chains
+
+    crank = _order_core(
+        c_parent, c_side, c_valid, sib_keys=(c_hi, c_lo, c_ctr)
+    )  # [c_pad]
+
+    # element placement (same segment arithmetic as chain_materialize)
+    visible = valid & ~cols.deleted & (cols.content >= 0)
+    vis_i = visible.astype(jnp.int32)
+    w = jnp.zeros(c_pad + 1, jnp.int32).at[cid_clip].add(vis_i)[:c_pad]
+    m = 3 * (c_pad + 1)
+    rk = jnp.clip(crank, 0, m - 1)
+    hist = jnp.zeros(m, jnp.int32).at[jnp.where(c_valid, rk, m - 1)].add(
+        jnp.where(c_valid, w, 0)
+    )
+    base_of_rank = jnp.cumsum(hist) - hist
+    base = base_of_rank[rk]
+    row_excl = jnp.cumsum(vis_i) - vis_i
+    head_excl = row_excl[jnp.clip(head_row, 0, n - 1)]
+    within = row_excl - head_excl[jnp.clip(chain_id, 0, c_pad - 1)]
+    pos = base[jnp.clip(chain_id, 0, c_pad - 1)] + within
+    count = vis_i.sum().astype(jnp.int32)
+    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
+        cols.content, mode="drop"
+    )
+    return codes, count, n_chains
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def chain_merge_docs_u(cols: SeqColumnsU, c_pad: int):
+    return jax.vmap(lambda c: chain_contract_materialize_u(c, c_pad))(cols)
+
+
 # batched-over-documents variants --------------------------------------
 fugue_order_batch = jax.vmap(fugue_order)
 visible_order_batch = jax.vmap(visible_order)

@@ -405,6 +405,7 @@ class DeviceDocBatch:
         self.d = ((n_docs + d_mesh - 1) // d_mesh) * d_mesh  # mesh-padded
         n_docs = self.d
         self.cap = capacity
+        self._c_pad = 256  # chain budget (doubles on overflow)
         self.counts = np.zeros(n_docs, np.int64)  # used rows per doc
         # host-side id -> row resolution per doc
         self.id2row: List[Dict[Tuple[int, int], int]] = [dict() for _ in range(n_docs)]
@@ -556,9 +557,19 @@ class DeviceDocBatch:
         return self.id2row[doc].get((peer, counter))
 
     def texts(self) -> List[str]:
-        from ..ops.fugue_batch import merge_docs_u
+        """Materialize every doc (one launch).  Uses the device-side
+        chain-contracted solver — ranking cost follows the actual chain
+        count, not the buffer capacity; the chain budget doubles and
+        retries on overflow (rare, compile-cached per bucket)."""
+        from ..ops.fugue_batch import chain_merge_docs_u
 
-        codes, counts = merge_docs_u(self.cols)
+        while True:
+            codes, counts, n_chains = chain_merge_docs_u(self.cols, self._c_pad)
+            max_chains = int(np.asarray(n_chains).max()) if self.d else 0
+            if max_chains <= self._c_pad:
+                break
+            while self._c_pad < max_chains:
+                self._c_pad *= 2
         codes = np.asarray(codes)
         counts = np.asarray(counts)
         return ["".join(map(chr, codes[i, : counts[i]])) for i in range(self.n_docs)]

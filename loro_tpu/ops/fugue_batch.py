@@ -284,43 +284,51 @@ class ChainColumns(NamedTuple):
     valid: jax.Array  # bool[N]
 
 
+def _place_by_chain(
+    crank: jax.Array,
+    c_valid: jax.Array,
+    chain_id: jax.Array,
+    head_row: jax.Array,
+    visible: jax.Array,
+    content: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Shared element placement for both chain paths: chain base
+    positions from a rank histogram + exclusive cumsum, within-chain
+    prefixes from row cumsums (chain rows are contiguous), then a
+    positional scatter of the content codes."""
+    c = crank.shape[0]
+    n = chain_id.shape[0]
+    vis_i = visible.astype(jnp.int32)
+    cid = jnp.clip(chain_id, 0, c)  # dump slot c for pads/overflow
+    w = jnp.zeros(c + 1, jnp.int32).at[cid].add(vis_i)[:c]
+    m = 3 * (c + 1)
+    rk = jnp.clip(crank, 0, m - 1)
+    hist = jnp.zeros(m, jnp.int32).at[jnp.where(c_valid, rk, m - 1)].add(
+        jnp.where(c_valid, w, 0)
+    )
+    base_of_rank = jnp.cumsum(hist) - hist
+    base = base_of_rank[rk]  # i32[C]
+    row_excl = jnp.cumsum(vis_i) - vis_i
+    head_excl = row_excl[jnp.clip(head_row, 0, n - 1)]  # i32[C]
+    within = row_excl - head_excl[jnp.clip(chain_id, 0, c - 1)]
+    pos = base[jnp.clip(chain_id, 0, c - 1)] + within
+    count = vis_i.sum().astype(jnp.int32)
+    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
+        content, mode="drop"
+    )
+    return codes, count
+
+
 def chain_materialize(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
     """Merge via chain contraction: rank C chains (C << N), then place
     all N elements with pure vector ops (segment sums / cumsum / one
     gather) — the gather-heavy ranking runs on the contracted tree only.
     Returns (codes i32[N] padded with -1, visible count)."""
     c = cols.c_parent.shape[0]
-    n = cols.chain_id.shape[0]
     crank = _order_core(cols.c_parent, cols.c_side, cols.c_valid)  # i32[C]
-    m = 3 * (c + 1)
     visible = cols.valid & ~cols.deleted
-    vis_i = visible.astype(jnp.int32)
-
-    # visible width per chain (chains are contiguous row ranges)
-    cid = jnp.where(cols.valid, cols.chain_id, c)  # pads -> dump chain
-    w = jnp.zeros(c + 1, jnp.int32).at[cid].add(vis_i)[:c]
-
-    # base position of each chain = total visible width of chains with
-    # smaller rank: histogram of widths by rank + exclusive cumsum
-    rk = jnp.clip(crank, 0, m - 1)
-    hist = jnp.zeros(m, jnp.int32).at[jnp.where(cols.c_valid, rk, m - 1)].add(
-        jnp.where(cols.c_valid, w, 0)
-    )
-    base_of_rank = jnp.cumsum(hist) - hist
-    base = base_of_rank[rk]  # i32[C]
-
-    # within-chain visible prefix: global exclusive cumsum minus the
-    # chain head's value (rows of a chain are contiguous)
-    row_excl = jnp.cumsum(vis_i) - vis_i
-    head_excl = row_excl[jnp.clip(cols.head_row, 0, n - 1)]  # i32[C]
-    within = row_excl - head_excl[jnp.clip(cols.chain_id, 0, c - 1)]
-
-    pos = base[jnp.clip(cols.chain_id, 0, c - 1)] + within
-    count = vis_i.sum().astype(jnp.int32)
-    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
-        cols.content, mode="drop"
-    )
-    return codes, count
+    chain_id = jnp.where(cols.valid, cols.chain_id, c)
+    return _place_by_chain(crank, cols.c_valid, chain_id, cols.head_row, visible, cols.content)
 
 
 chain_materialize_batch = jax.vmap(chain_materialize)
@@ -409,25 +417,8 @@ def chain_contract_materialize_u(
         c_parent, c_side, c_valid, sib_keys=(c_hi, c_lo, c_ctr)
     )  # [c_pad]
 
-    # element placement (same segment arithmetic as chain_materialize)
     visible = valid & ~cols.deleted & (cols.content >= 0)
-    vis_i = visible.astype(jnp.int32)
-    w = jnp.zeros(c_pad + 1, jnp.int32).at[cid_clip].add(vis_i)[:c_pad]
-    m = 3 * (c_pad + 1)
-    rk = jnp.clip(crank, 0, m - 1)
-    hist = jnp.zeros(m, jnp.int32).at[jnp.where(c_valid, rk, m - 1)].add(
-        jnp.where(c_valid, w, 0)
-    )
-    base_of_rank = jnp.cumsum(hist) - hist
-    base = base_of_rank[rk]
-    row_excl = jnp.cumsum(vis_i) - vis_i
-    head_excl = row_excl[jnp.clip(head_row, 0, n - 1)]
-    within = row_excl - head_excl[jnp.clip(chain_id, 0, c_pad - 1)]
-    pos = base[jnp.clip(chain_id, 0, c_pad - 1)] + within
-    count = vis_i.sum().astype(jnp.int32)
-    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
-        cols.content, mode="drop"
-    )
+    codes, count = _place_by_chain(crank, c_valid, chain_id, head_row, visible, cols.content)
     return codes, count, n_chains
 
 

@@ -91,6 +91,53 @@ class TestDeviceDocBatch:
                 a.get_text("t").to_string() for a, _ in pairs
             ], f"seed {seed} epoch {epoch}"
 
+    def test_chain_budget_overflow_retry(self):
+        """The static chain budget must double-and-retry on overflow
+        (review finding: path was uncovered).  Alternating-position
+        inserts defeat run merging, forcing many chains."""
+        import random
+
+        rng = random.Random(0)
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        for i in range(120):
+            t.insert(rng.randint(0, len(t)), "ab")
+        doc.commit()
+        cid = t.id
+        batch = DeviceDocBatch(n_docs=1, capacity=1024)
+        batch._c_pad = 16  # force overflow
+        batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        assert batch.texts() == [t.to_string()]
+        assert batch._c_pad > 16  # budget grew
+
+    def test_uncontracted_solver_agrees(self):
+        """merge_docs_u (no contraction) is the differential oracle for
+        the chain-contracted resident solver."""
+        import random
+
+        import numpy as np
+
+        from loro_tpu.ops.fugue_batch import chain_merge_docs_u, merge_docs_u
+
+        rng = random.Random(3)
+        docs = [LoroDoc(peer=i + 1) for i in range(2)]
+        cid = docs[0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=2, capacity=512)
+        for d in docs:
+            t = d.get_text("t")
+            for _ in range(60):
+                if len(t) and rng.random() < 0.3:
+                    pos = rng.randint(0, len(t) - 1)
+                    t.delete(pos, min(2, len(t) - pos))
+                else:
+                    t.insert(rng.randint(0, len(t)), rng.choice(["x", "yz"]))
+            d.commit()
+        batch.append_changes([d.oplog.changes_in_causal_order() for d in docs], cid)
+        full_codes, full_counts = merge_docs_u(batch.cols)
+        chain_codes, chain_counts, _ = chain_merge_docs_u(batch.cols, batch._c_pad)
+        np.testing.assert_array_equal(np.asarray(full_counts), np.asarray(chain_counts))
+        np.testing.assert_array_equal(np.asarray(full_codes), np.asarray(chain_codes))
+
     def test_capacity_guard(self):
         doc = LoroDoc(peer=1)
         cid = doc.get_text("t").id

@@ -36,6 +36,9 @@ class Transaction:
         self.ops: List[Op] = []
         self.diffs: Dict[ContainerID, List[Diff]] = {}
         self.message: Optional[str] = None
+        # pre-commit subscribers may override (reference: ChangeModifier
+        # sets commit message and timestamp)
+        self.timestamp_override: Optional[int] = None
 
     # ------------------------------------------------------------------
     def apply(self, cid: ContainerID, content: OpContent) -> int:
@@ -97,7 +100,10 @@ class Transaction:
     def build_change(self) -> Optional[Change]:
         if not self.ops:
             return None
-        ts = int(time.time()) if self.doc.config.record_timestamp else 0
+        if self.timestamp_override is not None:
+            ts = self.timestamp_override
+        else:
+            ts = int(time.time()) if self.doc.config.record_timestamp else 0
         return Change(
             id=ID(self.peer, self.start_counter),
             lamport=self.start_lamport,

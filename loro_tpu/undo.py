@@ -66,16 +66,33 @@ def _transform_batch(
 
 
 class UndoManager:
-    def __init__(self, doc: LoroDoc, max_stack: int = 100):
+    def __init__(self, doc: LoroDoc, max_stack: int = 100, merge_interval_ms: int = 0):
+        """merge_interval_ms: consecutive local commits closer than this
+        merge into one undo step (reference: UndoManager merge
+        interval); group_start()/group_end() group explicitly."""
         self.doc = doc
         self.max_stack = max_stack
+        self.merge_interval_ms = merge_interval_ms
         self.undo_stack: List[UndoItem] = []
         self.redo_stack: List[UndoItem] = []
         self._unsub = doc.subscribe_root(self._on_event)
         self._exclude_origins = {UNDO_ORIGIN, REDO_ORIGIN}
+        self._grouping = False
+        self._group_fresh = False
+        self._last_push_ms = 0.0
 
     def close(self) -> None:
         self._unsub()
+
+    # -- grouping (reference: undo group_start/group_end) --------------
+    def group_start(self) -> None:
+        self.doc.commit()
+        self._grouping = True
+        self._group_fresh = True  # first in-group commit opens a new item
+
+    def group_end(self) -> None:
+        self.doc.commit()
+        self._grouping = False
 
     # ------------------------------------------------------------------
     def _on_event(self, ev: DocDiff) -> None:
@@ -91,9 +108,28 @@ class UndoManager:
             elif ev.origin == REDO_ORIGIN:
                 self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
             else:
-                self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
-                if len(self.undo_stack) > self.max_stack:
-                    self.undo_stack.pop(0)
+                import time as _time
+
+                now = _time.monotonic() * 1000
+                if self._grouping:
+                    want_merge = not self._group_fresh
+                    self._group_fresh = False
+                else:
+                    want_merge = bool(
+                        self.merge_interval_ms
+                        and now - self._last_push_ms < self.merge_interval_ms
+                    )
+                mergeable = want_merge and self.undo_stack and not self.undo_stack[-1].post
+                if mergeable:
+                    # extend the top item's span to cover this commit
+                    self.undo_stack[-1] = UndoItem(
+                        self.undo_stack[-1].from_f, ev.to_frontiers, self.undo_stack[-1].post
+                    )
+                else:
+                    self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
+                    if len(self.undo_stack) > self.max_stack:
+                        self.undo_stack.pop(0)
+                self._last_push_ms = now
                 self.redo_stack.clear()
             return
         # remote import: transform both stacks

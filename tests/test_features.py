@@ -241,6 +241,57 @@ class TestMovableExactDiff:
         assert delta.apply_to_list(["x", "y"]) == ["Y", "x"]
 
 
+class TestUndoGrouping:
+    def test_group(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc)
+        t = doc.get_text("t")
+        t.insert(0, "one ")
+        doc.commit()
+        um.group_start()
+        t.insert(4, "two ")
+        doc.commit()
+        t.insert(8, "three")
+        doc.commit()
+        um.group_end()
+        um.undo()  # undoes the whole group
+        assert t.to_string() == "one "
+        um.undo()
+        assert t.to_string() == ""
+        um.redo()
+        assert t.to_string() == "one "
+        um.redo()
+        assert t.to_string() == "one two three"
+
+    def test_merge_interval(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc, merge_interval_ms=60_000)
+        t = doc.get_text("t")
+        t.insert(0, "a")
+        doc.commit()
+        t.insert(1, "b")
+        doc.commit()
+        um.undo()  # both merged into one step
+        assert t.to_string() == ""
+
+
+class TestPreCommitModifier:
+    def test_message_and_timestamp(self):
+        doc = LoroDoc(peer=1)
+
+        def modifier(txn):
+            txn.message = "signed"
+            txn.timestamp_override = 12345
+
+        doc.subscribe_pre_commit(modifier)
+        doc.get_text("t").insert(0, "x")
+        doc.commit()
+        from loro_tpu import ID
+
+        meta = doc.get_change(ID(1, 0))
+        assert meta["message"] == "signed" and meta["timestamp"] == 12345
+
+
 class TestDiffRevert:
     def test_diff_and_apply(self):
         doc = LoroDoc(peer=1)

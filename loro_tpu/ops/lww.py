@@ -65,3 +65,60 @@ def lww_merge_batch(cols: MapOpCols, n_slots: int):
 @functools.partial(jax.jit, static_argnums=(3,))
 def counter_merge_batch(slot, delta, valid, n_slots: int):
     return jax.vmap(lambda s, d, v: counter_merge_doc(s, d, v, n_slots))(slot, delta, valid)
+
+
+class LwwResident(NamedTuple):
+    """Device-resident per-(doc, slot) LWW winners.  Peers as u64 halves
+    so no batch-wide rank dictionary is needed (append path)."""
+
+    lamport: jax.Array  # i32[D, S]; NEG = slot untouched
+    peer_hi: jax.Array  # u32[D, S]
+    peer_lo: jax.Array  # u32[D, S]
+    value: jax.Array  # i32[D, S]; -1 = deleted, -2 = untouched
+
+
+def _blk_winners(slot, lam, hi, lo, val, valid, n_slots: int):
+    """Per-slot winners of one op block (four scatter-max passes over
+    the (lamport, peer_hi, peer_lo) order)."""
+    s = jnp.where(valid, slot, n_slots)
+    l = jnp.where(valid, lam, NEG)
+    w_l = jnp.full(n_slots + 1, NEG, jnp.int32).at[s].max(l)
+    at_l = valid & (lam == w_l[s])
+    # peers compare as unsigned u32 halves; sentinel 0 is safe for max
+    # because every slot with w_l > NEG has >= 1 candidate
+    h = jnp.where(at_l, hi, jnp.uint32(0))
+    w_h = jnp.zeros(n_slots + 1, jnp.uint32).at[jnp.where(at_l, s, n_slots)].max(h)
+    at_h = at_l & (hi == w_h[s])
+    lo_c = jnp.where(at_h, lo, jnp.uint32(0))
+    w_lo = jnp.zeros(n_slots + 1, jnp.uint32).at[jnp.where(at_h, s, n_slots)].max(lo_c)
+    is_win = at_h & (lo == w_lo[s])
+    w_v = jnp.full(n_slots + 1, -2, jnp.int32).at[jnp.where(is_win, s, n_slots)].max(
+        jnp.where(is_win, val, -2)
+    )
+    return w_l[:n_slots], w_h[:n_slots], w_lo[:n_slots], w_v[:n_slots]
+
+
+@functools.partial(jax.jit, static_argnums=(6,), donate_argnums=(0,))
+def lww_update_resident(
+    res: LwwResident, slot, lam, hi, lo_, valid, n_slots: int, value=None
+) -> LwwResident:
+    """Fold one append block into the resident winners (donated update).
+    `value` rides as the last arg for jit-arity reasons."""
+
+    def per_doc(r_lam, r_hi, r_lo, r_val, b_slot, b_lam, b_hi, b_lo, b_val, b_valid):
+        w_l, w_h, w_lo, w_v = _blk_winners(b_slot, b_lam, b_hi, b_lo, b_val, b_valid, n_slots)
+        blk_newer = (w_l > r_lam) | (
+            (w_l == r_lam) & ((w_h > r_hi) | ((w_h == r_hi) & (w_lo > r_lo)))
+        )
+        take = blk_newer & (w_l > NEG)
+        return (
+            jnp.where(take, w_l, r_lam),
+            jnp.where(take, w_h, r_hi),
+            jnp.where(take, w_lo, r_lo),
+            jnp.where(take, w_v, r_val),
+        )
+
+    out = jax.vmap(per_doc)(
+        res.lamport, res.peer_hi, res.peer_lo, res.value, slot, lam, hi, lo_, value, valid
+    )
+    return LwwResident(*out)

@@ -575,6 +575,98 @@ class DeviceDocBatch:
         return ["".join(map(chr, codes[i, : counts[i]])) for i in range(self.n_docs)]
 
 
+class DeviceMapBatch:
+    """Device-resident LWW-map winners for a doc batch (the map analog
+    of DeviceDocBatch).  Appends fold into per-(doc, slot) winners in
+    one donated launch; values live host-side as per-doc ordinal lists.
+    """
+
+    def __init__(self, n_docs: int, slot_capacity: int, mesh=None):
+        from ..ops.lww import NEG, LwwResident
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_docs = n_docs
+        self.d = _mesh_pad(self.mesh, n_docs)
+        self.s = slot_capacity
+        sh = doc_sharding(self.mesh)
+        z = lambda dt, fill: jax.device_put(np.full((self.d, self.s), fill, dt), sh)
+        self.res = LwwResident(
+            lamport=z(np.int32, int(NEG)),
+            peer_hi=z(np.uint32, 0),
+            peer_lo=z(np.uint32, 0),
+            value=z(np.int32, -2),
+        )
+        self.slot_of: List[Dict[Tuple[ContainerID, str], int]] = [dict() for _ in range(self.d)]
+        self.values: List[List] = [[] for _ in range(self.d)]
+
+    def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]]) -> None:
+        from ..core.change import MapSet
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.lww import lww_update_resident
+
+        per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
+        rows_per_doc = []
+        for di, changes in enumerate(per_doc_changes):
+            rows = []
+            rows_per_doc.append(rows)
+            if not changes:
+                continue
+            slot_of = self.slot_of[di]
+            vals = self.values[di]
+            for ch in changes:
+                for op in ch.ops:
+                    c = op.content
+                    if not isinstance(c, MapSet):
+                        continue
+                    key = (op.container, c.key)
+                    if key not in slot_of:
+                        assert len(slot_of) < self.s, "DeviceMapBatch slot capacity exceeded"
+                        slot_of[key] = len(slot_of)
+                    lam = ch.lamport + (op.counter - ch.ctr_start)
+                    if c.deleted:
+                        vi = -1
+                    else:
+                        vi = len(vals)
+                        vals.append(c.value)
+                    rows.append((slot_of[key], lam, ch.peer, vi))
+        m = pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16)
+        if not any(rows_per_doc):
+            return
+        slot = np.zeros((self.d, m), np.int32)
+        lam = np.zeros((self.d, m), np.int32)
+        hi = np.zeros((self.d, m), np.uint32)
+        lo = np.zeros((self.d, m), np.uint32)
+        val = np.full((self.d, m), -2, np.int32)
+        valid = np.zeros((self.d, m), bool)
+        for di, rows in enumerate(rows_per_doc):
+            for j, (s_, l_, p_, v_) in enumerate(rows):
+                slot[di, j] = s_
+                lam[di, j] = l_
+                hi[di, j] = p_ >> 32
+                lo[di, j] = p_ & 0xFFFFFFFF
+                val[di, j] = v_
+                valid[di, j] = True
+        sh = doc_sharding(self.mesh)
+        put = lambda a: jax.device_put(a, sh)
+        self.res = lww_update_resident(
+            self.res, put(slot), put(lam), put(hi), put(lo), put(valid), self.s, value=put(val)
+        )
+
+    def value_maps(self) -> List[Dict[str, object]]:
+        """Materialize {key: value} per doc (root-map keys flattened by
+        container)."""
+        win = np.asarray(self.res.value)
+        out = []
+        for di in range(self.n_docs):
+            m: Dict[str, object] = {}
+            for (cid, key), s_ in self.slot_of[di].items():
+                vi = int(win[di, s_])
+                if vi >= 0:
+                    m[key] = self.values[di][vi]
+            out.append(m)
+        return out
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows(cols, blk, offsets):
     """Write each doc's new-row block at its per-doc offset (donated

@@ -1,11 +1,63 @@
-"""DeviceDocBatch: incremental device-resident merge vs host engine."""
+"""Device-resident batches (text + map): incremental merge vs host."""
 import random
 
 import numpy as np
 import pytest
 
 from loro_tpu import LoroDoc
-from loro_tpu.parallel.fleet import DeviceDocBatch
+from loro_tpu.parallel.fleet import DeviceDocBatch, DeviceMapBatch
+
+
+class TestDeviceMapBatch:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_incremental_lww_fuzz(self, seed):
+        rng = random.Random(seed)
+        pairs = []
+        for i in range(3):
+            a = LoroDoc(peer=i + 1)
+            b = LoroDoc(peer=(1 << 34) + i)  # u64-hi peers exercise halves
+            pairs.append((a, b))
+        batch = DeviceMapBatch(n_docs=3, slot_capacity=64)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        for epoch in range(4):
+            for a, b in pairs:
+                for d in (a, b):
+                    m = d.get_map("m")
+                    for _ in range(rng.randint(1, 8)):
+                        if rng.random() < 0.2:
+                            m.delete(rng.choice("abcd"))
+                        else:
+                            m.set(rng.choice("abcd"), rng.randint(0, 99))
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+            ups = []
+            for i, (a, _) in enumerate(pairs):
+                ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
+                marks[i] = a.oplog_vv()
+            batch.append_changes(ups)
+            got = batch.value_maps()
+            for i, (a, _) in enumerate(pairs):
+                assert got[i] == a.get_map("m").get_value(), f"seed {seed} epoch {epoch} doc {i}"
+
+    def test_empty_append(self):
+        batch = DeviceMapBatch(n_docs=2, slot_capacity=8)
+        batch.append_changes([None, None])
+        assert batch.value_maps() == [{}, {}]
+
+    def test_high_bit_peer_tiebreak(self):
+        """u32 halves must compare unsigned: peer 2^63-ish beats a small
+        peer at equal lamport (would flip under int32 truncation)."""
+        big = (1 << 63) - 5
+        a, b = LoroDoc(peer=big), LoroDoc(peer=1)
+        a.get_map("m").set("k", "from_big")
+        a.commit()
+        b.get_map("m").set("k", "from_small")
+        b.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        batch = DeviceMapBatch(n_docs=1, slot_capacity=8)
+        batch.append_changes([a.oplog.changes_in_causal_order()])
+        assert batch.value_maps()[0] == a.get_map("m").get_value() == {"k": "from_big"}
 
 
 def _changes_between(doc, from_vv):

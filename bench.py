@@ -156,6 +156,40 @@ def bench_movable() -> None:
     _emit(f"movable_list ops merged/sec ({docs}-doc batch, {s} slots/doc)", docs * s / dt)
 
 
+def bench_size() -> None:
+    """Encoded-size harness (reference: examples/benches/mergeable_size
+    + encode.rs): bytes per op for updates / snapshot / state-only on
+    the automerge trace prefix."""
+    from loro_tpu import ExportMode, LoroDoc
+    from loro_tpu.bench_utils import load_automerge_patches
+
+    n_txn = int(os.environ.get("BENCH_TXN_LIMIT", "20000"))
+    patches, _ = load_automerge_patches(limit=n_txn)
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("text")
+    for pos, dels, ins in patches:
+        if dels:
+            t.delete(pos, dels)
+        if ins:
+            t.insert(pos, ins)
+    doc.commit()
+    updates = len(doc.export_updates())
+    snapshot = len(doc.export(ExportMode.Snapshot))
+    state_only = len(doc.export(ExportMode.StateOnly))
+    n_ops = len(patches)
+    print(
+        json.dumps(
+            {
+                "metric": f"update bytes/op ({n_ops} ops; snapshot={snapshot}B state_only={state_only}B)",
+                "value": round(updates / n_ops, 2),
+                "unit": "bytes/op",
+                "vs_baseline": 1.0,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     # bench runs on the real chip (ambient platform) by default; an
     # explicit JAX_PLATFORMS env must win even though the axon plugin
@@ -172,6 +206,8 @@ def main() -> None:
         return bench_tree()
     if config == "movable":
         return bench_movable()
+    if config == "size":
+        return bench_size()
 
     from loro_tpu.bench_utils import automerge_final_text, automerge_seq_extract
     from loro_tpu.ops.columnar import chain_columns

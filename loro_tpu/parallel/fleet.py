@@ -349,6 +349,55 @@ class Fleet:
         return out
 
     # ------------------------------------------------------------------
+    # counter merge
+    # ------------------------------------------------------------------
+    def merge_counter_changes(self, docs_changes: Sequence[Sequence[Change]]) -> List[Dict]:
+        """Batched counter merge: per-doc change lists -> {container:
+        sum} (order-independent segment sums, one launch)."""
+        from ..core.change import CounterIncr
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.lww import counter_merge_batch
+
+        rows_per_doc = []
+        cids_per_doc = []
+        for changes in docs_changes:
+            rows = []
+            cid_of: Dict[ContainerID, int] = {}
+            cids: List[ContainerID] = []
+            for ch in changes:
+                for op in ch.ops:
+                    if not isinstance(op.content, CounterIncr):
+                        continue
+                    if op.container not in cid_of:
+                        cid_of[op.container] = len(cids)
+                        cids.append(op.container)
+                    rows.append((cid_of[op.container], op.content.delta))
+            rows_per_doc.append(rows)
+            cids_per_doc.append(cids)
+        m = pad_bucket(max(1, max(len(r) for r in rows_per_doc)), floor=16)
+        s = max(1, max(len(c) for c in cids_per_doc))
+        d = len(docs_changes)
+        d_pad = _mesh_pad(self.mesh, d)
+        slot = np.zeros((d_pad, m), np.int32)
+        delta = np.zeros((d_pad, m), np.float32)
+        valid = np.zeros((d_pad, m), bool)
+        for di, rows in enumerate(rows_per_doc):
+            for j, (s_, dv) in enumerate(rows):
+                slot[di, j] = s_
+                delta[di, j] = dv
+                valid[di, j] = True
+        sh = doc_sharding(self.mesh)
+        sums = np.asarray(
+            counter_merge_batch(
+                jax.device_put(slot, sh), jax.device_put(delta, sh), jax.device_put(valid, sh), s
+            )
+        )
+        return [
+            {cid: float(sums[di, j]) for j, cid in enumerate(cids_per_doc[di])}
+            for di in range(d)
+        ]
+
+    # ------------------------------------------------------------------
     # LWW map merge
     # ------------------------------------------------------------------
     def merge_map_docs(self, extracts: Sequence[MapExtract]) -> List[Dict[str, object]]:

@@ -68,6 +68,24 @@ def test_fleet_richtext(fleet):
         assert got[i] == d.get_text("t").get_richtext_value(), f"doc {i}"
 
 
+def test_fleet_counter(fleet):
+    docs = []
+    for i in range(5):
+        a, b = LoroDoc(peer=400 + 2 * i), LoroDoc(peer=401 + 2 * i)
+        a.get_counter("c").increment(i + 1)
+        a.get_counter("c2").decrement(2)
+        b.import_(a.export_snapshot())
+        b.get_counter("c").increment(10)
+        a.import_(b.export_updates(a.oplog_vv()))
+        a.commit()
+        docs.append(a)
+    got = fleet.merge_counter_changes([d.oplog.changes_in_causal_order() for d in docs])
+    for i, d in enumerate(docs):
+        by_name = {cid.name: v for cid, v in got[i].items()}
+        assert by_name["c"] == d.get_counter("c").value
+        assert by_name["c2"] == d.get_counter("c2").value
+
+
 def test_fleet_tree(fleet):
     docs = _make_docs(6, 2, "tree")
     cid = docs[0].get_tree("tr").id

@@ -744,8 +744,14 @@ class LoroDoc:
         for cid, st in u_state.states.items():
             if cid.ctype == ContainerType.MovableList:
                 d = st.delta_between(va, vb)
-            elif cid.ctype in (ContainerType.Text, ContainerType.List):
-                d = st.seq.delta_between(va, vb, as_text=cid.ctype == ContainerType.Text)
+            elif cid.ctype == ContainerType.Text:
+                # style-aware when the container ever carried anchors
+                if getattr(st, "n_anchors", 0):
+                    d = st.styled_delta_between(va, vb)
+                else:
+                    d = st.seq.delta_between(va, vb, as_text=True)
+            elif cid.ctype == ContainerType.List:
+                d = st.seq.delta_between(va, vb, as_text=False)
             else:
                 continue
             if not d.is_empty():
@@ -777,12 +783,22 @@ class LoroDoc:
                 pos = 0
                 for it in d.items:
                     if isinstance(it, _Ret):
+                        if it.attributes and hasattr(h, "mark"):
+                            for k, v in it.attributes.items():
+                                if v is None:
+                                    h.unmark(pos, pos + it.n, k)
+                                else:
+                                    h.mark(pos, pos + it.n, k, v)
                         pos += it.n
                     elif isinstance(it, _Ins):
                         if isinstance(it.value, str):
                             h.insert(pos, it.value)  # type: ignore[call-arg]
                         else:
                             h.insert(pos, *it.value)  # type: ignore[call-arg]
+                        if it.attributes and hasattr(h, "mark"):
+                            for k, v in it.attributes.items():
+                                if v is not None:
+                                    h.mark(pos, pos + len(it.value), k, v)
                         pos += len(it.value)
                     else:
                         h.delete(pos, it.n)  # type: ignore[attr-defined]

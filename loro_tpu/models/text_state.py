@@ -153,6 +153,57 @@ class TextState(ContainerState):
             d.insert(seg["insert"], seg.get("attributes"))
         return d
 
+    # -- style-aware version diffs -------------------------------------
+    def _attrs_stream_at(self, v):
+        """Yield (elem, attrs) for every char element VISIBLE at version
+        v, walking once with a v-filtered active-anchor stack."""
+        active: Dict[str, list] = {}
+        for e in self.seq.all_elems():
+            if isinstance(e.content, StyleAnchor):
+                if not v.includes(e.id) or any(v.includes(x) for x in e.deleted_by):
+                    continue
+                a: StyleAnchor = e.content
+                if a.is_start:
+                    active.setdefault(a.key, []).append((e.lamport, e.peer, a.value, e.counter))
+                else:
+                    lst = active.get(a.key)
+                    if lst:
+                        for i, ent in enumerate(lst):
+                            if ent[1] == e.peer and ent[3] == e.counter - 1:
+                                lst.pop(i)
+                                break
+                continue
+            if v.includes(e.id) and not any(v.includes(x) for x in e.deleted_by):
+                yield e, _resolve_attrs(active)
+
+    def styled_delta_between(self, va, vb) -> Delta:
+        """Exact element-identity delta INCLUDING attribute changes:
+        chars kept in both versions whose resolved styles differ emit
+        attribute retains ({key: new-or-None}); inserts carry their
+        vb-side attributes."""
+        a_attrs = {(e.peer, e.counter): attrs for e, attrs in self._attrs_stream_at(va)}
+        b_attrs = {(e.peer, e.counter): attrs for e, attrs in self._attrs_stream_at(vb)}
+        d = Delta()
+        for e in self.seq.all_elems():
+            if isinstance(e.content, StyleAnchor):
+                continue
+            key = (e.peer, e.counter)
+            in_a = key in a_attrs
+            in_b = key in b_attrs
+            if in_a and in_b:
+                aa = a_attrs[key]
+                bb = b_attrs[key]
+                if aa == bb:
+                    d.retain(1)
+                else:
+                    change = {k: bb.get(k) for k in set(aa) | set(bb) if aa.get(k) != bb.get(k)}
+                    d.retain(1, change)
+            elif in_a:
+                d.delete(1)
+            elif in_b:
+                d.insert(e.content, b_attrs[key] or None)
+        return d.chop()
+
 
 def _resolve_attrs(active: Dict[str, List[Tuple]]) -> Dict[str, Any]:
     """Per key: LWW winner among active pairs; None value = unstyled."""

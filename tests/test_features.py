@@ -241,6 +241,58 @@ class TestMovableExactDiff:
         assert delta.apply_to_list(["x", "y"]) == ["Y", "x"]
 
 
+class TestStyledUndoRevert:
+    def test_undo_mark(self):
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc)
+        t = doc.get_text("t")
+        t.insert(0, "hello")
+        doc.commit()
+        t.mark(0, 5, "bold", True)
+        doc.commit()
+        assert um.undo()
+        assert t.get_richtext_value() == [{"insert": "hello"}]
+        assert um.redo()
+        assert t.get_richtext_value() == [{"insert": "hello", "attributes": {"bold": True}}]
+
+    def test_revert_to_with_marks(self):
+        from loro_tpu import Frontiers
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "abcdef")
+        t.mark(0, 3, "bold", True)
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.unmark(1, 3, "bold")
+        t.mark(2, 6, "link", "x")
+        t.delete(0, 1)
+        doc.commit()
+        doc.revert_to(f1)
+        assert t.get_richtext_value() == [
+            {"insert": "abc", "attributes": {"bold": True}},
+            {"insert": "def"},
+        ]
+
+    def test_checkout_event_with_styles(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "xy")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.mark(0, 2, "bold", True)
+        doc.commit()
+        events = []
+        doc.subscribe_root(events.append)
+        doc.checkout(f1)
+        d = events[-1].diffs[0].diff
+        # retreating removes the style: attribute retain with None
+        assert any(
+            getattr(it, "attributes", None) == {"bold": None} for it in d.items
+        )
+        doc.checkout_to_latest()
+
+
 class TestUndoGrouping:
     def test_group(self):
         doc = LoroDoc(peer=1)

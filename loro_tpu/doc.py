@@ -795,10 +795,29 @@ class LoroDoc:
                             h.insert(pos, it.value)  # type: ignore[call-arg]
                         else:
                             h.insert(pos, *it.value)  # type: ignore[call-arg]
-                        if it.attributes and hasattr(h, "mark"):
-                            for k, v in it.attributes.items():
-                                if v is not None:
-                                    h.mark(pos, pos + len(it.value), k, v)
+                        if hasattr(h, "mark"):
+                            # the diff's attributes are authoritative for
+                            # the new text: neutralize styles inherited
+                            # from surrounding live anchor pairs too
+                            st = h._state
+                            elem = st.seq.elem_at(pos)
+                            inherited = (
+                                st._styles_at_elem(elem)
+                                if (st.n_anchors and elem is not None)
+                                else {}
+                            )
+                            target = {
+                                k: v
+                                for k, v in (it.attributes or {}).items()
+                                if v is not None
+                            }
+                            end = pos + len(it.value)
+                            for k in set(inherited) | set(target):
+                                tv = target.get(k)
+                                if tv is None:
+                                    h.unmark(pos, end, k)
+                                elif inherited.get(k) != tv:
+                                    h.mark(pos, end, k, tv)
                         pos += len(it.value)
                     else:
                         h.delete(pos, it.n)  # type: ignore[attr-defined]

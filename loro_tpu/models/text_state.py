@@ -66,31 +66,39 @@ class TextState(ContainerState):
     def __len__(self) -> int:
         return self.seq.visible_len
 
-    def get_richtext_value(self) -> List[dict]:
-        """Quill-style segments [{insert, attributes?}] with resolved
-        styles (reference: richtext_state get_richtext_value)."""
-        segs: List[dict] = []
-        active: Dict[str, List[Tuple[int, int, Any]]] = {}  # key -> [(lamport, peer, value)]
-        anchor_pairs = self._anchor_ends()
+    def _iter_char_attrs(self, anchor_live, char_live):
+        """Single shared anchor walk (pairing via the (peer, counter+1)
+        invariant): yields (elem, attrs) for every char passing
+        `char_live`, with `anchor_live` filtering which anchors count.
+        Backs both the live render and version-filtered diffs."""
+        active: Dict[str, list] = {}
         for e in self.seq.all_elems():
             if isinstance(e.content, StyleAnchor):
-                if e.deleted:
+                if not anchor_live(e):
                     continue
                 a: StyleAnchor = e.content
                 if a.is_start:
                     active.setdefault(a.key, []).append((e.lamport, e.peer, a.value, e.counter))
                 else:
                     lst = active.get(a.key)
-                    if lst is not None:
+                    if lst:
                         # remove the entry whose start anchor is (peer, counter-1)
                         for i, ent in enumerate(lst):
                             if ent[1] == e.peer and ent[3] == e.counter - 1:
                                 lst.pop(i)
                                 break
                 continue
-            if not e.vis_w:
-                continue
-            attrs = _resolve_attrs(active) or None
+            if char_live(e):
+                yield e, _resolve_attrs(active)
+
+    def get_richtext_value(self) -> List[dict]:
+        """Quill-style segments [{insert, attributes?}] with resolved
+        styles (reference: richtext_state get_richtext_value)."""
+        segs: List[dict] = []
+        for e, attrs in self._iter_char_attrs(
+            lambda a: not a.deleted, lambda c: bool(c.vis_w)
+        ):
+            attrs = attrs or None
             if segs and segs[-1].get("attributes") == attrs:
                 segs[-1]["insert"] += e.content
             else:
@@ -98,13 +106,7 @@ class TextState(ContainerState):
                 if attrs:
                     seg["attributes"] = attrs
                 segs.append(seg)
-        for s in segs:
-            if "attributes" in s and not s["attributes"]:
-                del s["attributes"]
         return segs
-
-    def _anchor_ends(self):
-        return None  # pairing is implicit via (peer, counter±1)
 
     def _styles_at_elem(self, elem: SeqElem) -> Dict[str, Any]:
         """Resolved style attributes covering `elem` (scan; fine for host
@@ -155,26 +157,13 @@ class TextState(ContainerState):
 
     # -- style-aware version diffs -------------------------------------
     def _attrs_stream_at(self, v):
-        """Yield (elem, attrs) for every char element VISIBLE at version
-        v, walking once with a v-filtered active-anchor stack."""
-        active: Dict[str, list] = {}
-        for e in self.seq.all_elems():
-            if isinstance(e.content, StyleAnchor):
-                if not v.includes(e.id) or any(v.includes(x) for x in e.deleted_by):
-                    continue
-                a: StyleAnchor = e.content
-                if a.is_start:
-                    active.setdefault(a.key, []).append((e.lamport, e.peer, a.value, e.counter))
-                else:
-                    lst = active.get(a.key)
-                    if lst:
-                        for i, ent in enumerate(lst):
-                            if ent[1] == e.peer and ent[3] == e.counter - 1:
-                                lst.pop(i)
-                                break
-                continue
-            if v.includes(e.id) and not any(v.includes(x) for x in e.deleted_by):
-                yield e, _resolve_attrs(active)
+        """(elem, attrs) for every char VISIBLE at version v — the
+        shared walk with version-filtered liveness predicates."""
+
+        def live(e):
+            return v.includes(e.id) and not any(v.includes(x) for x in e.deleted_by)
+
+        return self._iter_char_attrs(live, live)
 
     def styled_delta_between(self, va, vb) -> Delta:
         """Exact element-identity delta INCLUDING attribute changes:

@@ -274,6 +274,30 @@ class TestStyledUndoRevert:
             {"insert": "def"},
         ]
 
+    def test_reinserted_text_not_styled_by_live_anchors(self):
+        """Regression (review finding): text restored by revert inside a
+        live styled region must come back with its ORIGINAL styles, not
+        inherit the surrounding anchors."""
+        from loro_tpu import Frontiers
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "abc")
+        t.mark(0, 1, "bold", True)
+        t.mark(2, 3, "bold", True)
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        t.delete(1, 1)  # remove plain 'b'
+        t.mark(0, 2, "bold", True)  # whole remaining text bold
+        doc.commit()
+        doc.revert_to(f1)
+        assert t.to_string() == "abc"
+        segs = t.get_richtext_value()
+        # 'b' must be plain again
+        assert {"insert": "b"} in segs or any(
+            s["insert"] == "b" and "attributes" not in s for s in segs
+        ), segs
+
     def test_checkout_event_with_styles(self):
         doc = LoroDoc(peer=1)
         t = doc.get_text("t")

@@ -921,6 +921,34 @@ class LoroDoc:
             "message": ch.message,
         }
 
+    def travel_change_ancestors(self, ids: List[ID], cb) -> None:
+        """Walk the causal ancestors of the given change ids in reverse
+        lamport order, calling cb(change_meta); cb returning False stops
+        the walk (reference: loro.rs travel_change_ancestors)."""
+        import heapq
+
+        seen = set()
+        heap = []
+        for i in ids:
+            ch = self.oplog.change_at(i)
+            if ch is None:
+                raise LoroError(f"change not found: {i}")
+            if ch.id not in seen:
+                seen.add(ch.id)
+                heapq.heappush(heap, (-ch.lamport, ch.peer, ch.id))
+        while heap:
+            _, _, cid = heapq.heappop(heap)
+            ch = self.oplog.change_at(cid)
+            if ch is None:
+                continue
+            if cb(self.get_change(ch.id)) is False:
+                return
+            for dep in ch.deps:
+                dch = self.oplog.change_at(dep)
+                if dch is not None and dch.id not in seen:
+                    seen.add(dch.id)
+                    heapq.heappush(heap, (-dch.lamport, dch.peer, dch.id))
+
     def get_changed_containers_in(self, id: ID, length: int) -> set:
         """Container ids touched by ops in [id, id+len)."""
         out = set()

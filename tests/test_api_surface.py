@@ -113,6 +113,46 @@ class TestVvDecodeErrors:
                 VersionVector.decode(blob[:cut])
 
 
+class TestTravelAncestors:
+    def test_walk(self):
+        from loro_tpu import ID
+
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "x")
+        a.commit(message="root")
+        b.import_(a.export_updates())
+        b.get_text("t").insert(1, "y")
+        b.commit(message="branch")
+        a.import_(b.export_updates(a.oplog_vv()))
+        a.get_text("t").insert(2, "z")
+        a.commit(message="head")
+        head = a.oplog_frontiers().as_ids()[0]
+        msgs = []
+        a.travel_change_ancestors([head], lambda m: msgs.append(m["message"]))
+        assert msgs == ["head", "branch", "root"]
+        # early stop
+        msgs2 = []
+        a.travel_change_ancestors([head], lambda m: (msgs2.append(m["message"]), False)[1])
+        assert msgs2 == ["head"]
+
+
+class TestNestedContainerRevert:
+    def test_revert_restores_child_container(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_list("l")
+        from loro_tpu import ContainerType
+
+        child = l.insert_container(0, ContainerType.Text)
+        child.insert(0, "inner")
+        doc.commit()
+        f1 = doc.oplog_frontiers()
+        l.delete(0, 1)  # drop the child container reference
+        doc.commit()
+        assert doc.get_deep_value()["l"] == []
+        doc.revert_to(f1)
+        assert doc.get_deep_value()["l"] == ["inner"]
+
+
 class TestVersionVectorBytes:
     def test_roundtrip(self):
         vv = VersionVector({1: 5, (1 << 50) + 3: 1000000})

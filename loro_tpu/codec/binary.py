@@ -28,7 +28,7 @@ Values use a compact tagged encoding (VNULL..VCID below).
 from __future__ import annotations
 
 import struct
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List
 
 from ..core.change import (
     Change,

@@ -15,14 +15,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.change import Side, StyleAnchor
 from ..core.ids import ContainerID, ContainerType, ID, TreeID
-from ..core.version import Frontiers, VersionVector
 from ..models.counter_state import CounterState
 from ..models.list_state import ListState
 from ..models.map_state import MapEntry, MapState
 from ..models.movable_list_state import ElemEntry, MovableListState
 from ..models.seq_crdt import FugueSeq, SeqElem
 from ..models.text_state import TextState
-from ..models.tree_state import TreeNode, TreeState
+from ..models.tree_state import TreeState
 from ..models.unknown_state import UnknownState
 from .binary import Reader, Writer, _Dicts, _read_cid, _read_value, _write_cid, _write_value
 

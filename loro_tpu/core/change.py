@@ -19,8 +19,8 @@ Each op consumes a contiguous counter range of the change:
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
-from typing import Any, List, Optional, Sequence, Tuple, Union
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple, Union
 
 from .ids import ID, ContainerID, Counter, IdSpan, Lamport, PeerID, TreeID
 from .version import Frontiers

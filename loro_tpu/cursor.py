@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Optional
 
 from .core.ids import ContainerID, ContainerType, ID
 from .doc import LoroDoc, LoroError

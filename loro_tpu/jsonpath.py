@@ -14,7 +14,7 @@ Results are deep values (container contents resolve recursively).
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, List, Optional, Tuple, Union
+from typing import Any, Callable, List, Tuple
 
 from .doc import LoroDoc, LoroError
 

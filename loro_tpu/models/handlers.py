@@ -7,7 +7,7 @@ handler/text_update.rs (diff-based `update`).
 from __future__ import annotations
 
 import difflib
-from typing import Any, Dict, List, Optional, Sequence, TYPE_CHECKING
+from typing import Any, List, Optional, TYPE_CHECKING
 
 from ..core.change import (
     CounterIncr,
@@ -20,7 +20,7 @@ from ..core.change import (
     StyleAnchor,
     TreeMove,
 )
-from ..core.ids import ContainerID, ContainerType, ID, TreeID
+from ..core.ids import ContainerID, ContainerType, TreeID
 from ..utils.fractional_index import key_between
 from ..core.value import validate_value
 

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
-from ..core.change import Op, SeqDelete, SeqInsert, Side
+from ..core.change import Op, SeqDelete, SeqInsert
 from ..core.ids import ContainerID, ID
 from ..event import Delta, Diff
 from .base import ContainerState

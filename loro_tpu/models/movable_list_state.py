@@ -19,7 +19,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.change import MovableMove, MovableSet, Op, SeqDelete, SeqInsert
-from ..core.ids import ContainerID, ID, IdSpan
+from ..core.ids import ContainerID, ID
 from ..event import Delta, Diff
 from .base import ContainerState
 from .list_state import _resolve_run_cont

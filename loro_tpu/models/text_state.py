@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core.change import Op, SeqDelete, SeqInsert, Side, StyleAnchor
+from ..core.change import Op, SeqDelete, SeqInsert, StyleAnchor
 from ..core.ids import ContainerID, ID
 from ..event import Delta, Diff
 from .base import ContainerState

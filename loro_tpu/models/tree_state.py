@@ -14,7 +14,7 @@ pointer-doubling ancestor check (loro_tpu/ops/tree_batch.py).
 from __future__ import annotations
 
 import bisect
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..core.change import Op, TreeMove
 from ..core.ids import ContainerID, ContainerType, TreeID

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..core.change import Op, UnknownContent
+from ..core.change import Op
 from ..core.ids import ContainerID
 from ..event import Diff, MapDiff
 from .base import ContainerState

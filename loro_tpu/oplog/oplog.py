@@ -11,10 +11,10 @@ import bisect
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from ..core.change import Change, Op, SeqDelete, SeqInsert, StyleAnchor
-from ..core.ids import ID, Counter, IdSpan, Lamport, PeerID
+from ..core.change import Change, Op, SeqInsert
+from ..core.ids import ID, Counter, Lamport, PeerID
 from ..core.version import Frontiers, VersionRange, VersionVector
-from .dag import AppDag, DiffMode
+from .dag import AppDag
 
 
 @dataclass

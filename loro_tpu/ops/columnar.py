@@ -10,12 +10,12 @@ host pipeline stage that overlaps with device compute in the fleet.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.change import Change, MapSet, SeqDelete, SeqInsert, Side, StyleAnchor
-from ..core.ids import ContainerID, ID
+from ..core.change import Change, MapSet, SeqDelete, SeqInsert, StyleAnchor
+from ..core.ids import ContainerID
 from ..oplog.oplog import _RunCont
 from .fugue_batch import SeqColumns
 

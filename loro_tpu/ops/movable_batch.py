@@ -106,7 +106,6 @@ def extract_movable(changes, cid):
     (numpy) + (elems list, values list).  Rows follow the
     (peer, counter) ordering contract of fugue_order."""
     from ..core.change import MovableMove, MovableSet, SeqDelete, SeqInsert
-    from ..core.ids import ID
     from ..oplog.oplog import _RunCont
 
     peers_seen = sorted({ch.peer for ch in changes})

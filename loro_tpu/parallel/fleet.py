@@ -25,7 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.change import Change
 from ..core.ids import ContainerID
 from ..utils import tracing
-from ..ops.columnar import MapExtract, SeqExtract, extract_map_ops, extract_seq_container, pad_rows
+from ..ops.columnar import MapExtract, SeqExtract, extract_seq_container
 from ..ops.fugue_batch import SeqColumns, materialize_content_batch, pad_bucket
 from ..ops.lww import MapOpCols, lww_merge_doc
 from .mesh import DOC_AXIS, doc_sharding, make_mesh, replicated

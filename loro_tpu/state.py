@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 from .core.change import Change, MapSet, MovableSet, Op, SeqInsert
 from .core.ids import ContainerID, ContainerType, ID, PeerID
 from .core.version import Frontiers, VersionVector
-from .event import ContainerDiff, Delta, Diff, MapDiff, TreeDiff
+from .event import Diff
 from .models.base import ContainerState
 from .models.counter_state import CounterState
 from .models.list_state import ListState

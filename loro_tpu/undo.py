@@ -10,7 +10,7 @@ later local edits), then applies it as *new* ops; redo mirrors.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from .core.ids import ContainerID
 from .core.version import Frontiers

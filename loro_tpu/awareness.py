@@ -39,30 +39,53 @@ class Awareness:
         return info.state if info else None
 
     def encode(self, peers: Optional[List[PeerID]] = None) -> bytes:
-        now = time.time()
-        out = []
-        for p, info in self.peers.items():
-            if peers is not None and p not in peers:
-                continue
-            out.append({"peer": str(p), "state": info.state, "counter": info.counter})
-        return json.dumps(out).encode()
+        """Compact binary presence blob: magic 'LTAW' + varint count +
+        per entry (u64 peer, varint counter, len-prefixed json state)."""
+        from .codec.binary import Writer
+
+        w = Writer()
+        w.buf += b"LTAW"
+        entries = [
+            (p, info)
+            for p, info in self.peers.items()
+            if peers is None or p in peers
+        ]
+        w.varint(len(entries))
+        for p, info in entries:
+            w.u64le(p)
+            w.varint(info.counter)
+            w.bytes_(json.dumps(info.state).encode())
+        return bytes(w.buf)
 
     def encode_all(self) -> bytes:
         return self.encode()
 
     def apply(self, data: bytes) -> Tuple[List[PeerID], List[PeerID]]:
-        """Returns (updated peers, added peers)."""
+        """Returns (updated peers, added peers).  Raises ValueError on
+        malformed blobs."""
+        from .codec.binary import Reader
+
+        if data[:4] != b"LTAW":
+            raise ValueError("bad awareness blob")
+        try:
+            r = Reader(data[4:])
+            entries = []
+            for _ in range(r.varint()):
+                p = r.u64le()
+                counter = r.varint()
+                state = json.loads(r.bytes_().decode())
+                entries.append((p, counter, state))
+        except (IndexError, ValueError) as e:
+            raise ValueError(f"malformed awareness blob: {e}") from e
         updated, added = [], []
         now = time.time()
-        for entry in json.loads(data.decode()):
-            p = int(entry["peer"])
-            counter = entry["counter"]
+        for p, counter, state in entries:
             cur = self.peers.get(p)
             if cur is None:
-                self.peers[p] = PeerInfo(entry["state"], counter, now)
+                self.peers[p] = PeerInfo(state, counter, now)
                 added.append(p)
             elif counter > cur.counter:
-                self.peers[p] = PeerInfo(entry["state"], counter, now)
+                self.peers[p] = PeerInfo(state, counter, now)
                 updated.append(p)
         return updated, added
 
@@ -120,19 +143,44 @@ class EphemeralStore:
 
     # -- wire ---------------------------------------------------------
     def encode(self, key: Optional[str] = None) -> bytes:
-        items = []
-        for k, e in self._data.items():
-            if key is not None and k != key:
-                continue
-            items.append({"k": k, "v": e.value, "t": e.timestamp, "d": e.deleted})
-        return json.dumps(items).encode()
+        """Compact binary: magic 'LTEP' + varint count + per entry
+        (len-prefixed key, f64 timestamp, u8 deleted, json value)."""
+        from .codec.binary import Writer
+
+        w = Writer()
+        w.buf += b"LTEP"
+        items = [
+            (k, e) for k, e in self._data.items() if key is None or k == key
+        ]
+        w.varint(len(items))
+        for k, e in items:
+            w.str_(k)
+            w.f64(e.timestamp)
+            w.u8(1 if e.deleted else 0)
+            w.bytes_(json.dumps(e.value).encode())
+        return bytes(w.buf)
 
     def encode_all(self) -> bytes:
         return self.encode()
 
     def apply(self, data: bytes) -> None:
+        from .codec.binary import Reader
+
+        if data[:4] != b"LTEP":
+            raise ValueError("bad ephemeral blob")
+        try:
+            r = Reader(data[4:])
+            decoded = []
+            for _ in range(r.varint()):
+                k = r.str_()
+                t = r.f64()
+                d = bool(r.u8())
+                v = json.loads(r.bytes_().decode())
+                decoded.append({"k": k, "v": v, "t": t, "d": d})
+        except (IndexError, ValueError) as e:
+            raise ValueError(f"malformed ephemeral blob: {e}") from e
         added, updated, removed = [], [], []
-        for it in json.loads(data.decode()):
+        for it in decoded:
             k = it["k"]
             cur = self._data.get(k)
             if cur is None or it["t"] > cur.timestamp:
@@ -171,12 +219,18 @@ class EphemeralStore:
 
     def _emit_local(self, keys: List[str]) -> None:
         if self._local_subs:
-            payload = json.dumps(
-                [
-                    {"k": k, "v": self._data[k].value, "t": self._data[k].timestamp, "d": self._data[k].deleted}
-                    for k in keys
-                ]
-            ).encode()
+            from .codec.binary import Writer
+
+            w = Writer()
+            w.buf += b"LTEP"
+            w.varint(len(keys))
+            for k in keys:
+                e = self._data[k]
+                w.str_(k)
+                w.f64(e.timestamp)
+                w.u8(1 if e.deleted else 0)
+                w.bytes_(json.dumps(e.value).encode())
+            payload = bytes(w.buf)
             for cb in self._local_subs:
                 cb(payload)
 

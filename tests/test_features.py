@@ -509,6 +509,23 @@ class TestEphemeralStore:
         assert ("local", ("k",)) in kinds
         assert ("import", ("k",)) in kinds
 
+    def test_binary_blob_robustness(self):
+        import pytest as _pytest
+
+        s = EphemeralStore()
+        s.set("k", {"deep": [1, 2]})
+        blob = s.encode_all()
+        assert blob[:4] == b"LTEP"
+        with _pytest.raises(ValueError):
+            EphemeralStore().apply(b"nope")
+        with _pytest.raises(ValueError):
+            EphemeralStore().apply(blob[: len(blob) // 2])
+        aw = Awareness(peer=1)
+        aw.set_local_state("x")
+        assert aw.encode_all()[:4] == b"LTAW"
+        with _pytest.raises(ValueError):
+            Awareness(peer=2).apply(b"junk")
+
     def test_timeout_expiry(self):
         s = EphemeralStore(timeout_ms=0)
         s.set("k", 1)

@@ -334,11 +334,12 @@ class Fleet:
         cols = TreeOpCols(
             *[jax.device_put(np.stack([getattr(c, f) for c in padded]), sh) for f in TreeOpCols._fields]
         )
-        parents, _eff = tree_merge_batch(cols, n)
+        parents, eff = tree_merge_batch(cols, n)
         deleted = np.asarray(is_deleted_batch(parents))
         parents = np.asarray(parents)
+        eff = np.asarray(eff)
         out = []
-        for i, (_, nodes, _) in enumerate(extracted):
+        for i, (c, nodes, row_pos) in enumerate(extracted):
             res = {}
             for j, tid in enumerate(nodes):
                 p = int(parents[i, j])
@@ -346,6 +347,65 @@ class Fleet:
                     continue
                 res[tid] = None if p == ROOT else nodes[p]
             out.append(res)
+        return out
+
+    def merge_tree_children(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[dict]:
+        """Like merge_tree_changes but returns ordered children maps
+        {parent|None: [child TreeIDs in (fractional-index, move-key)
+        order]} — the full materialized tree shape."""
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.tree_batch import (
+            ABSENT,
+            ROOT,
+            TreeOpCols,
+            extract_tree_ops,
+            is_deleted_batch,
+            pad_tree_cols,
+            positions_of,
+            tree_merge_batch,
+        )
+
+        extracted = [extract_tree_ops(chs, cid) for chs in docs_changes]
+        m = pad_bucket(max(1, max(c.target.shape[0] for c, _, _ in extracted)), floor=16)
+        n = max(1, max(len(nodes) for _, nodes, _ in extracted))
+        d = len(extracted)
+        d_pad = _mesh_pad(self.mesh, d)
+        padded = [pad_tree_cols(c, m) for c, _, _ in extracted]
+        empty = TreeOpCols(
+            target=np.zeros(m, np.int32), parent=np.full(m, ROOT, np.int32), valid=np.zeros(m, bool)
+        )
+        padded += [empty] * (d_pad - d)
+        sh = doc_sharding(self.mesh)
+        cols = TreeOpCols(
+            *[jax.device_put(np.stack([getattr(c, f) for c in padded]), sh) for f in TreeOpCols._fields]
+        )
+        parents, eff = tree_merge_batch(cols, n)
+        deleted = np.asarray(is_deleted_batch(parents))
+        parents = np.asarray(parents)
+        eff = np.asarray(eff)
+        out = []
+        for i, (c, nodes, row_pos) in enumerate(extracted):
+            n_rows = c.target.shape[0]
+            e_i = eff[i, :n_rows]
+            pos = positions_of(c, row_pos, e_i)
+            # sibling tiebreak = the winning move's key; rows are sorted
+            # by (lamport, peer, counter) so the row index is that order
+            last_eff_row: Dict[int, int] = {}
+            for j in range(n_rows):
+                if e_i[j]:
+                    last_eff_row[int(c.target[j])] = j
+            kids: Dict = {}
+            for j, tid in enumerate(nodes):
+                p = int(parents[i, j])
+                if p == ABSENT or deleted[i, j]:
+                    continue
+                parent_t = None if p == ROOT else nodes[p]
+                kids.setdefault(parent_t, []).append(
+                    (pos.get(j) or b"", last_eff_row.get(j, 0), tid)
+                )
+            out.append(
+                {k: [t for _, _, t in sorted(v, key=lambda x: (x[0], x[1]))] for k, v in kids.items()}
+            )
         return out
 
     # ------------------------------------------------------------------

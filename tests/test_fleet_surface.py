@@ -94,3 +94,30 @@ def test_fleet_tree(fleet):
         tr = d.get_tree("tr")
         host = {t: tr.parent(t) for t in tr.nodes()}
         assert got[i] == host, f"doc {i}"
+
+
+def test_fleet_tree_children_order(fleet):
+    rng = random.Random(9)
+    docs = []
+    for i in range(4):
+        a, b = LoroDoc(peer=600 + 2 * i), LoroDoc(peer=601 + 2 * i)
+        tr = a.get_tree("tr")
+        root = tr.create()
+        kids = [tr.create(root) for _ in range(3)]
+        b.import_(a.export_snapshot())
+        a.get_tree("tr").move(kids[2], root, 0)  # reorder
+        b.get_tree("tr").create(root, index=1)  # concurrent sibling
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        a.commit()
+        docs.append(a)
+    cid = docs[0].get_tree("tr").id
+    got = fleet.merge_tree_children([d.oplog.changes_in_causal_order() for d in docs], cid)
+    for i, d in enumerate(docs):
+        tr = d.get_tree("tr")
+        host = {}
+        for t in [None] + tr.nodes():
+            ch = tr.children(t)
+            if ch:
+                host[t] = ch
+        assert got[i] == host, f"doc {i}"

@@ -9,6 +9,7 @@ wire format + local/remote subscriptions.
 from __future__ import annotations
 
 import json
+import struct
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -75,7 +76,7 @@ class Awareness:
                 counter = r.varint()
                 state = json.loads(r.bytes_().decode())
                 entries.append((p, counter, state))
-        except (IndexError, ValueError) as e:
+        except (IndexError, ValueError, struct.error) as e:
             raise ValueError(f"malformed awareness blob: {e}") from e
         updated, added = [], []
         now = time.time()
@@ -177,7 +178,7 @@ class EphemeralStore:
                 d = bool(r.u8())
                 v = json.loads(r.bytes_().decode())
                 decoded.append({"k": k, "v": v, "t": t, "d": d})
-        except (IndexError, ValueError) as e:
+        except (IndexError, ValueError, struct.error) as e:
             raise ValueError(f"malformed ephemeral blob: {e}") from e
         added, updated, removed = [], [], []
         for it in decoded:

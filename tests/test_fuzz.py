@@ -155,6 +155,44 @@ def test_multi_site_with_undo(seed):
     assert_converged(actors)
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_styled_undo_concurrency(seed):
+    """Marks + undo/redo + concurrent sync must converge on richtext
+    values (covers the style-aware diff path under concurrency)."""
+    from loro_tpu.undo import UndoManager
+
+    rng = random.Random(9000 + seed)
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    um = UndoManager(a)
+    for _ in range(60):
+        r = rng.random()
+        d = a if rng.random() < 0.6 else b
+        t = d.get_text("t")
+        if r < 0.4 or len(t) == 0:
+            t.insert(rng.randint(0, len(t)), rng.choice(["ab", "x", "ZZ"]))
+        elif r < 0.55:
+            pos = rng.randint(0, len(t) - 1)
+            t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+        elif len(t) >= 2:
+            s = rng.randint(0, len(t) - 2)
+            e = rng.randint(s + 1, len(t))
+            if rng.random() < 0.3:
+                t.unmark(s, e, rng.choice(["bold", "em"]))
+            else:
+                t.mark(s, e, rng.choice(["bold", "em"]), rng.choice([True, "v"]))
+        d.commit()
+        if rng.random() < 0.3:
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+        if rng.random() < 0.15:
+            a.commit()
+            (um.undo if rng.random() < 0.7 else um.redo)()
+    a.import_(b.export_updates(a.oplog_vv()))
+    b.import_(a.export_updates(b.oplog_vv()))
+    assert a.get_text("t").get_richtext_value() == b.get_text("t").get_richtext_value()
+    assert a.get_deep_value() == b.get_deep_value()
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_device_differential_after_fuzz(seed):
     """After a fuzz run, the device text merge must equal host state."""

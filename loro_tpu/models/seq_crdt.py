@@ -310,6 +310,33 @@ class FugueSeq:
             e = Treap.successor(e)  # type: ignore[assignment]
         return spans
 
+    def check_invariants(self) -> None:
+        """Slow structural self-check (fuzzer oracle; reference:
+        check_state_correctness_slow).  Raises AssertionError on any
+        violated invariant."""
+        n_total = 0
+        n_vis = 0
+        for e in self.all_elems():
+            n_total += 1
+            if e.vis_w:
+                n_vis += 1
+            assert self.by_id.get((e.peer, e.counter)) is e, "by_id out of sync"
+            for side_list, side in ((e.l_children, Side.Left), (e.r_children, Side.Right)):
+                keys = [c.sib_key for c in side_list]
+                assert keys == sorted(keys), "children unsorted"
+                for c in side_list:
+                    assert c.fparent is e and c.fside == side, "child link broken"
+            if e.deleted or e.is_anchor:
+                assert e.vis_w == 0, "tombstone/anchor with visible width"
+        assert n_total == self.treap.total_len, "treap count out of sync"
+        assert n_vis == self.treap.visible_len, "treap visible count out of sync"
+        rk = [c.sib_key for c in self.root_children]
+        assert rk == sorted(rk), "root children unsorted"
+        # rank/select agreement on a few positions
+        for k in range(0, n_vis, max(1, n_vis // 7)):
+            e = self.treap.find_visible(k)
+            assert e is not None and self.treap.visible_rank(e) == k, "rank/select mismatch"
+
     def visible_index_of(self, elem_id: ID) -> Optional[int]:
         e = self.by_id.get((elem_id.peer, elem_id.counter))
         if e is None or not e.vis_w:

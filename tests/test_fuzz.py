@@ -105,6 +105,12 @@ def assert_converged(actors) -> None:
     vals = [a.doc.get_deep_value() for a in actors]
     for i, v in enumerate(vals[1:], 1):
         assert v == vals[0], f"site {i} diverged"
+    # slow structural self-checks (reference check_state_correctness_slow)
+    for a in actors:
+        for st in a.doc.state.states.values():
+            seq = getattr(st, "seq", None)
+            if seq is not None:
+                seq.check_invariants()
 
 
 @pytest.mark.parametrize("seed", range(10))

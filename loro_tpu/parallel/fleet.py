@@ -507,17 +507,22 @@ class DeviceDocBatch:
     appended rows land in the buffer tail, not in (peer, counter) order.
     """
 
-    def __init__(self, n_docs: int, capacity: int, mesh=None):
+    def __init__(self, n_docs: int, capacity: int, mesh=None, as_text: bool = True):
+        """as_text=False holds List containers: contents become per-doc
+        value ordinals (host keeps the value stores) and values() is the
+        materializer instead of texts()."""
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_docs = n_docs
         d_mesh = self.mesh.shape[DOC_AXIS]
         self.d = ((n_docs + d_mesh - 1) // d_mesh) * d_mesh  # mesh-padded
         n_docs = self.d
         self.cap = capacity
+        self.as_text = as_text
         self._c_pad = 256  # chain budget (doubles on overflow)
         self.counts = np.zeros(n_docs, np.int64)  # used rows per doc
         # host-side id -> row resolution per doc
         self.id2row: List[Dict[Tuple[int, int], int]] = [dict() for _ in range(n_docs)]
+        self.value_store: List[List] = [[] for _ in range(n_docs)]
         from ..ops.fugue_batch import SeqColumnsU
 
         sh = doc_sharding(self.mesh)
@@ -585,7 +590,13 @@ class DeviceDocBatch:
                                 prow = base + len(rows) - 1
                                 side = 1
                             overlay[(ch.peer, op.counter + j)] = base + len(rows)
-                            content = -1 if isinstance(body[j], StyleAnchor) else ord(body[j])
+                            if isinstance(body[j], StyleAnchor):
+                                content = -1
+                            elif self.as_text:
+                                content = ord(body[j])
+                            else:
+                                content = len(self.value_store[di])
+                                self.value_store[di].append(body[j])
                             rows.append((prow, side, op.counter + j, content, ch.peer))
                     elif isinstance(c, SeqDelete):
                         for sp in c.spans:
@@ -682,6 +693,24 @@ class DeviceDocBatch:
         codes = np.asarray(codes)
         counts = np.asarray(counts)
         return ["".join(map(chr, codes[i, : counts[i]])) for i in range(self.n_docs)]
+
+    def values(self) -> List[list]:
+        """Materialize value lists (as_text=False batches)."""
+        from ..ops.fugue_batch import chain_merge_docs_u
+
+        assert not self.as_text, "values() is for as_text=False batches"
+        while True:
+            codes, counts, n_chains = chain_merge_docs_u(self.cols, self._c_pad)
+            max_chains = int(np.asarray(n_chains).max()) if self.d else 0
+            if max_chains <= self._c_pad:
+                break
+            while self._c_pad < max_chains:
+                self._c_pad *= 2
+        codes = np.asarray(codes)
+        counts = np.asarray(counts)
+        return [
+            [self.value_store[i][j] for j in codes[i, : counts[i]]] for i in range(self.n_docs)
+        ]
 
 
 class DeviceMapBatch:

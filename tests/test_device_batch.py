@@ -190,6 +190,34 @@ class TestDeviceDocBatch:
         np.testing.assert_array_equal(np.asarray(full_counts), np.asarray(chain_counts))
         np.testing.assert_array_equal(np.asarray(full_codes), np.asarray(chain_codes))
 
+    @pytest.mark.parametrize("seed", range(3))
+    def test_list_value_batch(self, seed):
+        """as_text=False batches hold List containers (value payloads
+        incl. nested structures)."""
+        rng = random.Random(seed)
+        docs = [LoroDoc(peer=i + 1) for i in range(2)]
+        cid = docs[0].get_list("l").id
+        batch = DeviceDocBatch(n_docs=2, capacity=512, as_text=False)
+        marks = [d.oplog_vv() for d in docs]
+        for epoch in range(3):
+            for d in docs:
+                l = d.get_list("l")
+                for _ in range(rng.randint(1, 8)):
+                    if len(l) and rng.random() < 0.3:
+                        l.delete(rng.randint(0, len(l) - 1), 1)
+                    else:
+                        l.insert(
+                            rng.randint(0, len(l)),
+                            rng.choice([1, "s", None, 2.5, {"n": [1]}]),
+                        )
+                d.commit()
+            ups = []
+            for i, d in enumerate(docs):
+                ups.append(d.oplog.changes_between(marks[i], d.oplog_vv()))
+                marks[i] = d.oplog_vv()
+            batch.append_changes(ups, cid)
+            assert batch.values() == [d.get_list("l").get_value() for d in docs]
+
     def test_capacity_guard(self):
         doc = LoroDoc(peer=1)
         cid = doc.get_text("t").id

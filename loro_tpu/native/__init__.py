@@ -72,6 +72,21 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong,
             ctypes.c_int,
         ] + [ctypes.c_void_p] * 6 + [ctypes.c_longlong]
+        lib.loro_count_seq_deletes.restype = ctypes.c_longlong
+        lib.loro_count_seq_deletes.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ]
+        lib.loro_explode_seq_delta.restype = ctypes.c_longlong
+        lib.loro_explode_seq_delta.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ] + [ctypes.c_void_p] * 7 + [ctypes.c_longlong] + [ctypes.c_void_p] * 3 + [
+            ctypes.c_longlong,
+            ctypes.c_void_p,
+        ]
         lib.loro_count_map_ops.restype = ctypes.c_longlong
         lib.loro_count_map_ops.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
         lib.loro_explode_map.restype = ctypes.c_longlong
@@ -121,6 +136,63 @@ def explode_seq_payload(payload: bytes, target_cid_index: int):
     if wrote != n:
         raise ValueError("native decode failed (unresolvable refs or count mismatch)")
     return parent, side, peer, counter, deleted.astype(bool), content
+
+
+def explode_seq_delta_payload(payload: bytes, target_cid_index: int):
+    """Incremental decode: element rows whose cross-payload parents come
+    back as (peer_idx, counter) for host resolution (out_parent == -2),
+    plus raw delete spans.  Returns a dict of numpy arrays or None if
+    the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.loro_count_seq_elements(payload, len(payload), target_cid_index)
+    nd = lib.loro_count_seq_deletes(payload, len(payload), target_cid_index)
+    if n < 0 or nd < 0:
+        raise ValueError("native decode failed (malformed payload?)")
+    parent = np.empty(n, np.int32)
+    side = np.empty(n, np.int32)
+    peer = np.empty(n, np.int32)
+    counter = np.empty(n, np.int32)
+    content = np.empty(n, np.int32)
+    ext_peer = np.empty(n, np.int32)
+    ext_ctr = np.empty(n, np.int64)
+    del_peer = np.empty(nd, np.int32)
+    del_start = np.empty(nd, np.int64)
+    del_end = np.empty(nd, np.int64)
+    n_del_out = ctypes.c_longlong(0)
+    wrote = lib.loro_explode_seq_delta(
+        payload,
+        len(payload),
+        target_cid_index,
+        parent.ctypes.data_as(ctypes.c_void_p),
+        side.ctypes.data_as(ctypes.c_void_p),
+        peer.ctypes.data_as(ctypes.c_void_p),
+        counter.ctypes.data_as(ctypes.c_void_p),
+        content.ctypes.data_as(ctypes.c_void_p),
+        ext_peer.ctypes.data_as(ctypes.c_void_p),
+        ext_ctr.ctypes.data_as(ctypes.c_void_p),
+        n,
+        del_peer.ctypes.data_as(ctypes.c_void_p),
+        del_start.ctypes.data_as(ctypes.c_void_p),
+        del_end.ctypes.data_as(ctypes.c_void_p),
+        nd,
+        ctypes.byref(n_del_out),
+    )
+    if wrote != n:
+        raise ValueError("native delta decode failed")
+    return {
+        "parent": parent,
+        "side": side,
+        "peer_idx": peer,
+        "counter": counter,
+        "content": content,
+        "ext_peer_idx": ext_peer,
+        "ext_counter": ext_ctr,
+        "del_peer_idx": del_peer[: n_del_out.value],
+        "del_start": del_start[: n_del_out.value],
+        "del_end": del_end[: n_del_out.value],
+    }
 
 
 def explode_map_payload(payload: bytes):

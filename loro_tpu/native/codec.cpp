@@ -384,6 +384,144 @@ long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
   return row;
 }
 
+// Pass 2 (incremental variant): like loro_explode_seq but parents that
+// don't resolve inside this payload are reported as (peer_idx, counter)
+// pairs with out_parent = -2, for host-side resolution against the
+// resident batch's id map; deletes are returned as spans instead of
+// folded, for the same reason.  out_del_* must hold n_del_max entries
+// (from loro_count_seq_deletes).  Returns rows written or -1.
+long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_cid,
+                                 int32_t* out_parent, int32_t* out_side,
+                                 int32_t* out_peer, int32_t* out_counter,
+                                 int32_t* out_content,
+                                 int32_t* out_ext_peer, int64_t* out_ext_ctr,
+                                 long long n_elems,
+                                 int32_t* out_del_peer, int64_t* out_del_start,
+                                 int64_t* out_del_end, long long n_del_max,
+                                 long long* n_del_out) {
+  Reader r{buf, buf + len};
+  uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
+  if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
+  IdMap map((size_t)(n_elems > 16 ? n_elems : 16));
+  long long row = 0, n_del = 0;
+  int32_t value_base = 0;
+  for (auto& m : metas) {
+    int64_t ctr = m.ctr;
+    for (uint64_t k = 0; k < m.n_ops; k++) {
+      uint64_t cidx = r.varint();
+      uint8_t kind = r.u8();
+      if (!r.ok) return -1;
+      if ((long long)cidx != target_cid) {
+        int64_t atoms;
+        if (!skip_op(r, kind, &atoms)) return -1;
+        ctr += atoms;
+        continue;
+      }
+      if (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES) {
+        uint8_t ptag = r.u8();
+        uint32_t p_peer = 0; int64_t p_ctr = 0;
+        if (ptag == PT_ID) { p_peer = (uint32_t)r.varint(); p_ctr = r.zigzag(); }
+        uint8_t side = r.u8();
+        int32_t parent_row;
+        uint32_t ext_peer = 0; int64_t ext_ctr = -1;
+        if (ptag == PT_NONE) parent_row = -1;
+        else if (ptag == PT_RUNCONT) {
+          parent_row = map.get(idkey(m.peer_idx, ctr - 1));
+          if (parent_row < 0) { parent_row = -2; ext_peer = m.peer_idx; ext_ctr = ctr - 1; }
+        } else {
+          parent_row = map.get(idkey(p_peer, p_ctr));
+          if (parent_row < 0) { parent_row = -2; ext_peer = p_peer; ext_ctr = p_ctr; }
+        }
+        auto emit = [&](int64_t j, uint32_t cp) -> bool {
+          if (row >= n_elems) return false;
+          out_parent[row] = (j == 0) ? parent_row : (int32_t)(row - 1);
+          out_side[row] = (j == 0) ? side : 1;
+          out_peer[row] = (int32_t)m.peer_idx;
+          out_counter[row] = (int32_t)(ctr + j);
+          out_content[row] = (int32_t)cp;
+          out_ext_peer[row] = (j == 0 && parent_row == -2) ? (int32_t)ext_peer : -1;
+          out_ext_ctr[row] = (j == 0 && parent_row == -2) ? ext_ctr : -1;
+          map.put(idkey(m.peer_idx, ctr + j), (int32_t)row);
+          row++;
+          return true;
+        };
+        if (kind == K_INSERT_TEXT) {
+          uint64_t nb; const uint8_t* s = r.bytes(&nb);
+          if (!r.ok) return -1;
+          uint64_t i = 0; int64_t j = 0;
+          while (i < nb) {
+            uint32_t cp; uint8_t b0 = s[i]; int extra;
+            if (b0 < 0x80) { cp = b0; extra = 0; }
+            else if ((b0 & 0xe0) == 0xc0) { cp = b0 & 0x1f; extra = 1; }
+            else if ((b0 & 0xf0) == 0xe0) { cp = b0 & 0x0f; extra = 2; }
+            else if ((b0 & 0xf8) == 0xf0) { cp = b0 & 0x07; extra = 3; }
+            else return -1;
+            if (extra > 0 && i + (uint64_t)extra >= nb) return -1;
+            for (int e = 1; e <= extra; e++) cp = (cp << 6) | (s[i + e] & 0x3f);
+            i += extra + 1;
+            if (!emit(j, cp)) return -1;
+            j++;
+          }
+          ctr += j;
+        } else {
+          uint64_t n = r.varint();
+          for (uint64_t j = 0; j < n; j++) {
+            if (!skip_value(r)) return -1;
+            if (!emit((int64_t)j, (uint32_t)value_base++)) return -1;
+          }
+          ctr += (int64_t)n;
+        }
+      } else if (kind == K_DELETE) {
+        uint64_t n = r.varint();
+        for (uint64_t i = 0; i < n && r.ok; i++) {
+          uint32_t dp = (uint32_t)r.varint();
+          int64_t ds = r.zigzag();
+          int64_t dl = (int64_t)r.varint();
+          if (n_del >= n_del_max) return -1;
+          out_del_peer[n_del] = (int32_t)dp;
+          out_del_start[n_del] = ds;
+          out_del_end[n_del] = ds + dl;
+          n_del++;
+        }
+        if (!r.ok) return -1;
+        ctr += 1;
+      } else {
+        int64_t atoms;
+        if (!skip_op(r, kind, &atoms)) return -1;
+        ctr += atoms;
+      }
+    }
+  }
+  *n_del_out = n_del;
+  return row;
+}
+
+// Count delete spans for a target container (sizing for the delta API).
+long long loro_count_seq_deletes(const uint8_t* buf, long long len, int target_cid) {
+  Reader r{buf, buf + len};
+  uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
+  if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
+  long long total = 0;
+  for (auto& m : metas) {
+    for (uint64_t k = 0; k < m.n_ops; k++) {
+      uint64_t cidx = r.varint();
+      uint8_t kind = r.u8();
+      if (!r.ok) return -1;
+      if ((long long)cidx == target_cid && kind == K_DELETE) {
+        // peek span count without consuming twice: parse spans
+        uint64_t n = r.varint();
+        for (uint64_t i = 0; i < n && r.ok; i++) { r.varint(); r.zigzag(); r.varint(); }
+        if (!r.ok) return -1;
+        total += (long long)n;
+      } else {
+        int64_t atoms;
+        if (!skip_op(r, kind, &atoms)) return -1;
+      }
+    }
+  }
+  return total;
+}
+
 // Pass 1: count MapSet/MapDel rows in the payload.
 long long loro_count_map_ops(const uint8_t* buf, long long len) {
   Reader r{buf, buf + len};

@@ -548,63 +548,74 @@ class DeviceDocBatch:
         rows; deletes tombstone rows from any epoch.  All validation and
         id-map staging happens before any state mutates, so a capacity
         error leaves the batch untouched.  One device scatter per call."""
-        from ..core.change import SeqDelete, SeqInsert, StyleAnchor
-        from ..ops.fugue_batch import pad_bucket
-        from ..oplog.oplog import _RunCont
-
         per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
         rows_per_doc: List[List[Tuple[int, int, int, int, int]]] = []
         overlays: List[Dict[Tuple[int, int], int]] = []
         del_pairs: List[Tuple[int, int]] = []
         for di, changes in enumerate(per_doc_changes):
-            rows: List[Tuple[int, int, int, int, int]] = []  # parent,side,counter,content,peer
-            overlay: Dict[Tuple[int, int], int] = {}  # staged id -> row
+            rows: List[Tuple[int, int, int, int, int]] = []
+            overlay: Dict[Tuple[int, int], int] = {}
             rows_per_doc.append(rows)
             overlays.append(overlay)
-            if not changes:
-                continue
-            base = int(self.counts[di])
-            idmap = self.id2row[di]
+            if changes:
+                self._python_rows(di, changes, cid, rows, overlay, del_pairs)
+        self._commit_rows(rows_per_doc, overlays, del_pairs)
 
-            def resolve(key, idmap=idmap, overlay=overlay):
-                r = overlay.get(key)
-                return idmap[key] if r is None else r
+    def _python_rows(self, di, changes, cid, rows, overlay, del_pairs) -> None:
+        """Pure-Python op walk producing (parent,side,counter,content,
+        peer) rows + delete pairs for one doc (also the fallback for the
+        native delta path)."""
+        from ..core.change import SeqDelete, SeqInsert, StyleAnchor
+        from ..oplog.oplog import _RunCont
 
-            for ch in changes:
-                for op in ch.ops:
-                    if op.container != cid:
-                        continue
-                    c = op.content
-                    if isinstance(c, SeqInsert):
-                        body = [c.content] if isinstance(c.content, StyleAnchor) else c.content
-                        for j in range(len(body)):
-                            if j == 0:
-                                if isinstance(c.parent, _RunCont):
-                                    prow = resolve((ch.peer, op.counter - 1))
-                                elif c.parent is None:
-                                    prow = -1
-                                else:
-                                    prow = resolve((c.parent.peer, c.parent.counter))
-                                side = int(c.side)
+        base = int(self.counts[di])
+        idmap = self.id2row[di]
+
+        def resolve(key):
+            r = overlay.get(key)
+            return idmap[key] if r is None else r
+
+        for ch in changes:
+            for op in ch.ops:
+                if op.container != cid:
+                    continue
+                c = op.content
+                if isinstance(c, SeqInsert):
+                    body = [c.content] if isinstance(c.content, StyleAnchor) else c.content
+                    for j in range(len(body)):
+                        if j == 0:
+                            if isinstance(c.parent, _RunCont):
+                                prow = resolve((ch.peer, op.counter - 1))
+                            elif c.parent is None:
+                                prow = -1
                             else:
-                                prow = base + len(rows) - 1
-                                side = 1
-                            overlay[(ch.peer, op.counter + j)] = base + len(rows)
-                            if isinstance(body[j], StyleAnchor):
-                                content = -1
-                            elif self.as_text:
-                                content = ord(body[j])
-                            else:
-                                content = len(self.value_store[di])
-                                self.value_store[di].append(body[j])
-                            rows.append((prow, side, op.counter + j, content, ch.peer))
-                    elif isinstance(c, SeqDelete):
-                        for sp in c.spans:
-                            for ctr in range(sp.start, sp.end):
-                                try:
-                                    del_pairs.append((di, resolve((sp.peer, ctr))))
-                                except KeyError:
-                                    pass  # target outside this batch's history
+                                prow = resolve((c.parent.peer, c.parent.counter))
+                            side = int(c.side)
+                        else:
+                            prow = base + len(rows) - 1
+                            side = 1
+                        overlay[(ch.peer, op.counter + j)] = base + len(rows)
+                        if isinstance(body[j], StyleAnchor):
+                            content = -1
+                        elif self.as_text:
+                            content = ord(body[j])
+                        else:
+                            content = len(self.value_store[di])
+                            self.value_store[di].append(body[j])
+                        rows.append((prow, side, op.counter + j, content, ch.peer))
+                elif isinstance(c, SeqDelete):
+                    for sp in c.spans:
+                        for ctr in range(sp.start, sp.end):
+                            try:
+                                del_pairs.append((di, resolve((sp.peer, ctr))))
+                            except KeyError:
+                                pass  # target outside this batch's history
+
+    def _commit_rows(self, rows_per_doc, overlays, del_pairs) -> None:
+        """Shared tail: validate capacity, commit staged id maps, block-
+        scatter new rows, tombstone deletes (append_changes and
+        append_payloads both end here)."""
+        from ..ops.fugue_batch import pad_bucket
 
         max_new = pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16) if any(
             rows_per_doc
@@ -657,6 +668,80 @@ class DeviceDocBatch:
                 self.cols, blk_dev, jax.device_put(offsets, replicated(self.mesh))
             )
         self.mark_deleted(del_pairs)
+
+    def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]], cid) -> None:
+        """Incremental NATIVE ingest: envelope-stripped binary payloads
+        -> C++ delta explode (cross-epoch parents/deletes resolved
+        through the per-doc id maps) -> one block scatter.  Falls back
+        to append_changes via the Python decoder per payload when the
+        native library is unavailable."""
+        from ..codec.binary import Reader, _read_cid, decode_changes
+        from ..native import available, explode_seq_delta_payload
+
+        if not available():
+            self.append_changes(
+                [decode_changes(p) if p else None for p in per_doc_payloads], cid
+            )
+            return
+        per_doc_payloads = list(per_doc_payloads) + [None] * (self.d - len(per_doc_payloads))
+        rows_per_doc: List[list] = []
+        overlays: List[Dict[Tuple[int, int], int]] = []
+        del_pairs: List[Tuple[int, int]] = []
+        for di, payload in enumerate(per_doc_payloads):
+            rows: list = []
+            overlay: Dict[Tuple[int, int], int] = {}
+            rows_per_doc.append(rows)
+            overlays.append(overlay)
+            if not payload:
+                continue
+            assert self.as_text, "append_payloads supports text batches"
+            n_dels_start = len(del_pairs)
+            try:
+                r = Reader(payload)
+                peers_wire = [r.u64le() for _ in range(r.varint())]
+                for _ in range(r.varint()):
+                    r.bytes_()
+                cids = [_read_cid(r, peers_wire) for _ in range(r.varint())]
+                try:
+                    target = cids.index(cid)
+                except ValueError:
+                    continue  # no ops for this container
+                out = explode_seq_delta_payload(payload, target)
+                base = int(self.counts[di])
+                idmap = self.id2row[di]
+                n = len(out["parent"])
+                for j in range(n):
+                    p = int(out["parent"][j])
+                    if p == -2:  # cross-epoch parent: host id-map resolution
+                        key = (peers_wire[out["ext_peer_idx"][j]], int(out["ext_counter"][j]))
+                        prow = overlay.get(key)
+                        if prow is None:
+                            prow = idmap[key]
+                    elif p >= 0:
+                        prow = base + p
+                    else:
+                        prow = -1
+                    peer = peers_wire[out["peer_idx"][j]]
+                    overlay[(peer, int(out["counter"][j]))] = base + j
+                    rows.append(
+                        (prow, int(out["side"][j]), int(out["counter"][j]), int(out["content"][j]), peer)
+                    )
+                for k in range(len(out["del_peer_idx"])):
+                    dp = peers_wire[out["del_peer_idx"][k]]
+                    for ctr in range(int(out["del_start"][k]), int(out["del_end"][k])):
+                        row = overlay.get((dp, ctr))
+                        if row is None:
+                            row = idmap.get((dp, ctr))
+                        if row is not None:
+                            del_pairs.append((di, row))
+            except (KeyError, ValueError):
+                # style anchors (not in the native explode) or other
+                # unresolvables: python fallback for this payload only
+                rows.clear()
+                overlay.clear()
+                del del_pairs[n_dels_start:]
+                self._python_rows(di, decode_changes(payload), cid, rows, overlay, del_pairs)
+        self._commit_rows(rows_per_doc, overlays, del_pairs)
 
     def mark_deleted(self, pairs: Sequence[Tuple[int, int]]) -> None:
         """Tombstone (doc, device_row) pairs (delete ops referencing

@@ -190,6 +190,45 @@ class TestDeviceDocBatch:
         np.testing.assert_array_equal(np.asarray(full_counts), np.asarray(chain_counts))
         np.testing.assert_array_equal(np.asarray(full_codes), np.asarray(chain_codes))
 
+    @pytest.mark.parametrize("seed", range(4))
+    def test_native_payload_appends(self, seed):
+        """Incremental ingest straight from binary payloads (native C++
+        delta decode; cross-epoch parents and deletes resolved through
+        the id maps; anchor payloads fall back per-payload)."""
+        from loro_tpu.native import available
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        rng = random.Random(seed)
+        docs = [LoroDoc(peer=i + 1) for i in range(3)]
+        cid = docs[0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=3, capacity=2048)
+        marks = [d.oplog_vv() for d in docs]
+        for epoch in range(4):
+            payloads = []
+            for i, d in enumerate(docs):
+                t = d.get_text("t")
+                for _ in range(rng.randint(1, 10)):
+                    r = rng.random()
+                    if len(t) and r < 0.3:
+                        pos = rng.randint(0, len(t) - 1)
+                        t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+                    elif len(t) >= 2 and r < 0.4 and seed % 2:
+                        s = rng.randint(0, len(t) - 2)
+                        t.mark(s, rng.randint(s + 1, len(t)), "bold", True)
+                    else:
+                        t.insert(rng.randint(0, len(t)), rng.choice(["ab", "z", "qrs"]))
+                d.commit()
+                blob = d.export(
+                    __import__("loro_tpu").ExportMode.UpdatesInRange(marks[i], d.oplog_vv())
+                )
+                marks[i] = d.oplog_vv()
+                payloads.append(blob[10:])  # strip envelope
+            batch.append_payloads(payloads, cid)
+            assert batch.texts() == [
+                d.get_text("t").to_string() for d in docs
+            ], f"seed {seed} epoch {epoch}"
+
     @pytest.mark.parametrize("seed", range(3))
     def test_list_value_batch(self, seed):
         """as_text=False batches hold List containers (value payloads

@@ -450,11 +450,19 @@ def _read_parent(r: Reader, peers: List[int]):
     return ID(peers[r.varint()], r.zigzag())
 
 
-def decode_changes(buf: bytes) -> List[Change]:
+def read_tables(buf: bytes):
+    """Parse just the payload prelude dictionaries.  Returns
+    (peers, keys, cids, reader-positioned-after-tables) — the single
+    place that knows the header layout besides encode_changes."""
     r = Reader(buf)
     peers = [r.u64le() for _ in range(r.varint())]
     keys = [r.str_() for _ in range(r.varint())]
     cids = [_read_cid(r, peers) for _ in range(r.varint())]
+    return peers, keys, cids, r
+
+
+def decode_changes(buf: bytes) -> List[Change]:
+    peers, keys, cids, r = read_tables(buf)
     n_changes = r.varint()
     metas = []
     prev_ts = 0

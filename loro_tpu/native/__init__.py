@@ -78,6 +78,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong,
             ctypes.c_int,
         ]
+        lib.loro_count_seq_delta_rows.restype = ctypes.c_longlong
+        lib.loro_count_seq_delta_rows.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ]
         lib.loro_explode_seq_delta.restype = ctypes.c_longlong
         lib.loro_explode_seq_delta.argtypes = [
             ctypes.c_char_p,
@@ -146,7 +152,7 @@ def explode_seq_delta_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
-    n = lib.loro_count_seq_elements(payload, len(payload), target_cid_index)
+    n = lib.loro_count_seq_delta_rows(payload, len(payload), target_cid_index)
     nd = lib.loro_count_seq_deletes(payload, len(payload), target_cid_index)
     if n < 0 or nd < 0:
         raise ValueError("native decode failed (malformed payload?)")
@@ -227,12 +233,9 @@ def explode_map_payload(payload: bytes):
         raise ValueError("native decode failed (count mismatch)")
     # wire peer table is registration-ordered; remap to sorted ranks
     # (same contract handling as extract_seq_from_payload)
-    from ..codec.binary import Reader, _read_cid
+    from ..codec.binary import read_tables
 
-    r = Reader(payload)
-    peers_wire = [r.u64le() for _ in range(r.varint())]
-    keys = [r.str_() for _ in range(r.varint())]
-    cids = [_read_cid(r, peers_wire) for _ in range(r.varint())]
+    peers_wire, keys, cids, _r = read_tables(payload)
     order = np.argsort(np.asarray(peers_wire, np.uint64), kind="stable")
     rank_of = np.empty(len(peers_wire), np.int32)
     rank_of[order] = np.arange(len(peers_wire), dtype=np.int32)

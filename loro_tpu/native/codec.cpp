@@ -384,12 +384,38 @@ long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
   return row;
 }
 
+// Count rows the DELTA explode will emit (chars/values AND style
+// anchors — anchors are parentable Fugue nodes and must enter the
+// resident id map).
+long long loro_count_seq_delta_rows(const uint8_t* buf, long long len,
+                                    int target_cid) {
+  Reader r{buf, buf + len};
+  uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
+  if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
+  long long total = 0;
+  for (auto& m : metas) {
+    for (uint64_t k = 0; k < m.n_ops; k++) {
+      uint64_t cidx = r.varint();
+      uint8_t kind = r.u8();
+      if (!r.ok) return -1;
+      int64_t atoms = 1;
+      if (!skip_op(r, kind, &atoms)) return -1;
+      if ((long long)cidx == target_cid &&
+          (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES || kind == K_INSERT_ANCHOR)) {
+        total += atoms;
+      }
+    }
+  }
+  return total;
+}
+
 // Pass 2 (incremental variant): like loro_explode_seq but parents that
 // don't resolve inside this payload are reported as (peer_idx, counter)
 // pairs with out_parent = -2, for host-side resolution against the
 // resident batch's id map; deletes are returned as spans instead of
-// folded, for the same reason.  out_del_* must hold n_del_max entries
-// (from loro_count_seq_deletes).  Returns rows written or -1.
+// folded, for the same reason; style anchors emit rows with
+// out_content = -1.  out_del_* must hold n_del_max entries (from
+// loro_count_seq_deletes).  Returns rows written or -1.
 long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_cid,
                                  int32_t* out_parent, int32_t* out_side,
                                  int32_t* out_peer, int32_t* out_counter,
@@ -417,7 +443,7 @@ long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_c
         ctr += atoms;
         continue;
       }
-      if (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES) {
+      if (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES || kind == K_INSERT_ANCHOR) {
         uint8_t ptag = r.u8();
         uint32_t p_peer = 0; int64_t p_ctr = 0;
         if (ptag == PT_ID) { p_peer = (uint32_t)r.varint(); p_ctr = r.zigzag(); }
@@ -445,7 +471,17 @@ long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_c
           row++;
           return true;
         };
-        if (kind == K_INSERT_TEXT) {
+        if (kind == K_INSERT_ANCHOR) {
+          // key-idx, value, is_start, info — anchors are zero-width but
+          // parentable: emit a content=-1 row (the order solve ignores
+          // it; the id map needs it)
+          r.varint();
+          if (!skip_value(r)) return -1;
+          r.u8(); r.varint();
+          if (!r.ok) return -1;
+          if (!emit(0, (uint32_t)-1)) return -1;
+          ctr += 1;
+        } else if (kind == K_INSERT_TEXT) {
           uint64_t nb; const uint8_t* s = r.bytes(&nb);
           if (!r.ok) return -1;
           uint64_t i = 0; int64_t j = 0;

@@ -227,16 +227,12 @@ def extract_seq_from_payload(payload: bytes, cid: ContainerID) -> Optional[SeqEx
     without materializing Python Change objects (the fleet ingest path;
     ~1000x the Python explode loop).  Returns None when the native
     library is unavailable; raises ValueError on malformed payloads."""
-    from ..codec.binary import Reader, _read_cid
+    from ..codec.binary import read_tables
     from ..native import available, explode_seq_payload
 
     if not available():
         return None
-    r = Reader(payload)
-    peers = [r.u64le() for _ in range(r.varint())]
-    for _ in range(r.varint()):
-        r.bytes_()  # keys
-    cids = [_read_cid(r, peers) for _ in range(r.varint())]
+    peers, _keys, cids, _r = read_tables(payload)
     try:
         target = cids.index(cid)
     except ValueError:

@@ -675,10 +675,12 @@ class DeviceDocBatch:
         through the per-doc id maps) -> one block scatter.  Falls back
         to append_changes via the Python decoder per payload when the
         native library is unavailable."""
-        from ..codec.binary import Reader, _read_cid, decode_changes
+        from ..codec.binary import decode_changes, read_tables
         from ..native import available, explode_seq_delta_payload
 
-        if not available():
+        if not available() or not self.as_text:
+            # no native lib, or a value batch (the native explode only
+            # understands text payloads): python decode per payload
             self.append_changes(
                 [decode_changes(p) if p else None for p in per_doc_payloads], cid
             )
@@ -694,14 +696,9 @@ class DeviceDocBatch:
             overlays.append(overlay)
             if not payload:
                 continue
-            assert self.as_text, "append_payloads supports text batches"
             n_dels_start = len(del_pairs)
             try:
-                r = Reader(payload)
-                peers_wire = [r.u64le() for _ in range(r.varint())]
-                for _ in range(r.varint()):
-                    r.bytes_()
-                cids = [_read_cid(r, peers_wire) for _ in range(r.varint())]
+                peers_wire, _keys, cids, _r = read_tables(payload)
                 try:
                     target = cids.index(cid)
                 except ValueError:
@@ -710,22 +707,29 @@ class DeviceDocBatch:
                 base = int(self.counts[di])
                 idmap = self.id2row[di]
                 n = len(out["parent"])
-                for j in range(n):
-                    p = int(out["parent"][j])
-                    if p == -2:  # cross-epoch parent: host id-map resolution
-                        key = (peers_wire[out["ext_peer_idx"][j]], int(out["ext_counter"][j]))
-                        prow = overlay.get(key)
-                        if prow is None:
-                            prow = idmap[key]
-                    elif p >= 0:
-                        prow = base + p
-                    else:
-                        prow = -1
-                    peer = peers_wire[out["peer_idx"][j]]
-                    overlay[(peer, int(out["counter"][j]))] = base + j
-                    rows.append(
-                        (prow, int(out["side"][j]), int(out["counter"][j]), int(out["content"][j]), peer)
+                # vectorized common case; only ext rows loop in python
+                prow_arr = np.where(out["parent"] >= 0, base + out["parent"], out["parent"])
+                ext_rows = np.flatnonzero(out["parent"] == -2)
+                peer_arr = np.asarray([peers_wire[i] for i in out["peer_idx"]], dtype=object)
+                ctr_list = out["counter"].tolist()
+                overlay.update(
+                    zip(zip(peer_arr.tolist(), ctr_list), range(base, base + n))
+                )
+                for j in ext_rows.tolist():
+                    key = (peers_wire[out["ext_peer_idx"][j]], int(out["ext_counter"][j]))
+                    prow = overlay.get(key)
+                    if prow is None:
+                        prow = idmap[key]
+                    prow_arr[j] = prow
+                rows.extend(
+                    zip(
+                        prow_arr.tolist(),
+                        out["side"].tolist(),
+                        ctr_list,
+                        out["content"].tolist(),
+                        peer_arr.tolist(),
                     )
+                )
                 for k in range(len(out["del_peer_idx"])):
                     dp = peers_wire[out["del_peer_idx"][k]]
                     for ctr in range(int(out["del_start"][k]), int(out["del_end"][k])):

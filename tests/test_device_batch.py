@@ -229,6 +229,43 @@ class TestDeviceDocBatch:
                 d.get_text("t").to_string() for d in docs
             ], f"seed {seed} epoch {epoch}"
 
+    def test_native_cross_epoch_anchor_parent(self):
+        """Regression (review repro): epoch-2 insert parenting on an
+        epoch-1 mark anchor must resolve natively (anchors enter the id
+        map)."""
+        from loro_tpu import ExportMode
+        from loro_tpu.native import available
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        doc = LoroDoc(peer=1)
+        cid = doc.get_text("t").id
+        t = doc.get_text("t")
+        t.insert(0, "abcd")
+        t.mark(1, 3, "bold", True)
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=256)
+        batch.append_payloads([doc.export_updates()[10:]], cid)
+        mark = doc.oplog_vv()
+        t.insert(1, "X")  # parents near the start anchor
+        t.insert(4, "Y")
+        doc.commit()
+        batch.append_payloads(
+            [doc.export(ExportMode.UpdatesInRange(mark, doc.oplog_vv()))[10:]], cid
+        )
+        assert batch.texts() == [t.to_string()]
+
+    def test_payloads_on_value_batch_falls_back(self):
+        """as_text=False + payloads routes through the python decoder
+        (review finding: used to assert)."""
+        doc = LoroDoc(peer=1)
+        cid = doc.get_list("l").id
+        doc.get_list("l").push(1, {"k": 2})
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=64, as_text=False)
+        batch.append_payloads([doc.export_updates()[10:]], cid)
+        assert batch.values() == [doc.get_list("l").get_value()]
+
     @pytest.mark.parametrize("seed", range(3))
     def test_list_value_batch(self, seed):
         """as_text=False batches hold List containers (value payloads

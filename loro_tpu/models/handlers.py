@@ -219,6 +219,19 @@ class TextHandler(Handler):
     def unmark(self, start: int, end: int, key: str) -> None:
         self.mark(start, end, key, None)
 
+    def splice(self, pos: int, length: int, replacement: str = "") -> str:
+        """Delete [pos, pos+length) and insert `replacement` there;
+        returns the removed text (reference: Text::splice)."""
+        removed = self.to_string()[pos : pos + length]
+        if length:
+            self.delete(pos, length)
+        if replacement:
+            self.insert(pos, replacement)
+        return removed
+
+    def is_empty(self) -> bool:
+        return len(self._state) == 0
+
     def update(self, new_text: str) -> None:
         """Minimal-diff update (reference: handler/text_update.rs Myers)."""
         old = self.to_string()
@@ -283,6 +296,22 @@ class ListHandler(Handler):
     def push_container(self, ctype: ContainerType) -> Handler:
         return self.insert_container(len(self._state), ctype)
 
+    def pop(self):
+        """Remove and return the last value (reference: List::pop)."""
+        n = len(self._state)
+        if n == 0:
+            return None
+        v = self._state.get(n - 1)
+        self.delete(n - 1, 1)
+        return v
+
+    def clear(self) -> None:
+        if len(self._state):
+            self.delete(0, len(self._state))
+
+    def is_empty(self) -> bool:
+        return len(self._state) == 0
+
 
 class _ChildMarker:
     """Placeholder replaced by the real child ContainerID at txn apply
@@ -331,6 +360,21 @@ class MapHandler(Handler):
         self._apply(MapSet(key, marker))
         assert marker.cid is not None
         return self._child_handler(marker.cid)
+
+    def clear(self) -> None:
+        for k in self.keys():
+            self.delete(k)
+
+    def is_empty(self) -> bool:
+        return len(self._state.get_value()) == 0
+
+    def get_or_create_container(self, key: str, ctype: ContainerType) -> Handler:
+        """Existing child or a fresh one (reference: get_or_create)."""
+        entry = self._state.get_entry(key)
+        if entry is not None and isinstance(entry.value, ContainerID):
+            if entry.value.ctype == ctype:
+                return self._child_handler(entry.value)
+        return self.set_container(key, ctype)
 
 
 class MovableListHandler(Handler):
@@ -421,6 +465,21 @@ class MovableListHandler(Handler):
         self._apply(SeqInsert(parent, side, (marker,)))
         assert marker.cid is not None
         return self._child_handler(marker.cid)
+
+    def pop(self):
+        n = len(self._state)
+        if n == 0:
+            return None
+        v = self._state.get(n - 1)
+        self.delete(n - 1, 1)
+        return v
+
+    def clear(self) -> None:
+        if len(self._state):
+            self.delete(0, len(self._state))
+
+    def is_empty(self) -> bool:
+        return len(self._state) == 0
 
 
 class TreeHandler(Handler):

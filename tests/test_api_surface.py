@@ -113,6 +113,43 @@ class TestVvDecodeErrors:
                 VersionVector.decode(blob[:cut])
 
 
+class TestHandlerSugar:
+    def test_text_splice(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        removed = t.splice(5, 6, "!")
+        assert removed == " world" and t.to_string() == "hello!"
+        assert not t.is_empty()
+
+    def test_list_pop_clear(self):
+        doc = LoroDoc(peer=1)
+        l = doc.get_list("l")
+        l.push(1, 2, 3)
+        assert l.pop() == 3
+        l.clear()
+        assert l.is_empty() and l.pop() is None
+        ml = doc.get_movable_list("ml")
+        ml.push("a", "b")
+        assert ml.pop() == "b"
+        ml.clear()
+        assert ml.is_empty()
+
+    def test_map_clear_get_or_create(self):
+        from loro_tpu import ContainerType
+
+        doc = LoroDoc(peer=1)
+        m = doc.get_map("m")
+        m.set("a", 1)
+        m.set("b", 2)
+        m.clear()
+        assert m.is_empty()
+        sub1 = m.get_or_create_container("sub", ContainerType.Text)
+        sub1.insert(0, "x")
+        sub2 = m.get_or_create_container("sub", ContainerType.Text)
+        assert sub2.to_string() == "x"  # same container, not recreated
+
+
 class TestTravelAncestors:
     def test_walk(self):
         from loro_tpu import ID

@@ -218,6 +218,36 @@ class Frontiers:
     def from_json(v: List[str]) -> "Frontiers":
         return Frontiers(ID.parse(s) for s in v)
 
+    def encode(self) -> bytes:
+        """Compact binary form: varint count + (u64 peer, varint ctr)."""
+        import struct
+
+        out = bytearray()
+        _uvarint(out, len(self._ids))
+        for i in self._ids:
+            out += struct.pack("<Q", i.peer)
+            _uvarint(out, i.counter)
+        return bytes(out)
+
+    @staticmethod
+    def decode(data: bytes) -> "Frontiers":
+        """Raises ValueError on malformed input."""
+        import struct
+
+        try:
+            pos = [0]
+            n = _read_uvarint(data, pos)
+            if n > len(data):
+                raise ValueError("frontier count exceeds payload")
+            ids = []
+            for _ in range(n):
+                (p,) = struct.unpack_from("<Q", data, pos[0])
+                pos[0] += 8
+                ids.append(ID(p, _read_uvarint(data, pos)))
+            return Frontiers(ids)
+        except (IndexError, struct.error) as e:
+            raise ValueError(f"malformed frontiers: {e}") from e
+
 
 class VersionRange:
     """peer -> (start, end) counter ranges (reference: version.rs:33).

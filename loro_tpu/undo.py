@@ -10,7 +10,7 @@ later local edits), then applies it as *new* ops; redo mirrors.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from .core.ids import ContainerID
 from .core.version import Frontiers
@@ -66,13 +66,23 @@ def _transform_batch(
 
 
 class UndoManager:
-    def __init__(self, doc: LoroDoc, max_stack: int = 100, merge_interval_ms: int = 0):
+    def __init__(
+        self,
+        doc: LoroDoc,
+        max_stack: int = 100,
+        merge_interval_ms: int = 0,
+        exclude_origin_prefixes: Optional[List[str]] = None,
+    ):
         """merge_interval_ms: consecutive local commits closer than this
         merge into one undo step (reference: UndoManager merge
-        interval); group_start()/group_end() group explicitly."""
+        interval); group_start()/group_end() group explicitly;
+        exclude_origin_prefixes: local commits whose origin starts with
+        any prefix are not recorded as undo steps (reference:
+        excludeOriginPrefixes)."""
         self.doc = doc
         self.max_stack = max_stack
         self.merge_interval_ms = merge_interval_ms
+        self.exclude_origin_prefixes = list(exclude_origin_prefixes or [])
         self.undo_stack: List[UndoItem] = []
         self.redo_stack: List[UndoItem] = []
         self._unsub = doc.subscribe_root(self._on_event)
@@ -107,6 +117,10 @@ class UndoManager:
                 self.redo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
             elif ev.origin == REDO_ORIGIN:
                 self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
+            elif any(ev.origin.startswith(p) for p in self.exclude_origin_prefixes):
+                # excluded local work behaves like remote concurrency:
+                # it must transform the stacks, not become a step
+                self._fold_post({cd.id: cd.diff for cd in ev.diffs})
             else:
                 import time as _time
 

@@ -104,6 +104,33 @@ class TestImportBatchStatus:
         assert spans[1][0] == 0 and spans[1][1] >= 6  # full range reported
 
 
+class TestUndoExcludeOrigins:
+    def test_excluded_origin_not_undoable_but_transforms(self):
+        from loro_tpu import UndoManager
+
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc, exclude_origin_prefixes=["sys:"])
+        t = doc.get_text("t")
+        t.insert(0, "user")
+        doc.commit()
+        t.insert(0, "[auto] ")
+        doc.commit(origin="sys:autoformat")
+        # only the user commit is undoable; the auto text stays
+        assert um.undo()
+        assert t.to_string() == "[auto] "
+        assert not um.can_undo()
+
+
+class TestFrontiersBytes:
+    def test_roundtrip_and_errors(self):
+        from loro_tpu import Frontiers, ID
+
+        f = Frontiers([ID(1, 5), ID((1 << 60) + 3, 0)])
+        assert Frontiers.decode(f.encode()) == f
+        with pytest.raises(ValueError):
+            Frontiers.decode(f.encode()[:-2])
+
+
 class TestVvDecodeErrors:
     def test_truncated(self):
         vv = VersionVector({1: 5, 2: 9})

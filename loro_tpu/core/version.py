@@ -119,36 +119,49 @@ class VersionVector:
         return VersionVector({int(p): c for p, c in d.items()})
 
     def encode(self) -> bytes:
-        """Compact binary form (reference: VersionVector::encode) —
-        varint count, then per entry u64-LE peer + varint counter."""
-        import struct
-
-        out = bytearray()
-        entries = sorted((p, c) for p, c in self._m.items() if c > 0)
-        _uvarint(out, len(entries))
-        for p, c in entries:
-            out += struct.pack("<Q", p)
-            _uvarint(out, c)
-        return bytes(out)
+        """Compact binary form (reference: VersionVector::encode)."""
+        return _encode_u64_varint_pairs(
+            sorted((p, c) for p, c in self._m.items() if c > 0)
+        )
 
     @staticmethod
     def decode(data: bytes) -> "VersionVector":
         """Raises ValueError on malformed/truncated input (wire API)."""
-        import struct
+        return VersionVector(dict(_decode_u64_varint_pairs(data)))
 
-        try:
-            pos = [0]
-            n = _read_uvarint(data, pos)
-            if n > len(data):  # cheap sanity bound before allocating
-                raise ValueError("version vector count exceeds payload")
-            m = {}
-            for _ in range(n):
-                (p,) = struct.unpack_from("<Q", data, pos[0])
-                pos[0] += 8
-                m[p] = _read_uvarint(data, pos)
-            return VersionVector(m)
-        except (IndexError, struct.error) as e:
-            raise ValueError(f"malformed version vector: {e}") from e
+
+def _encode_u64_varint_pairs(pairs) -> bytes:
+    """Shared wire shape for VersionVector and Frontiers: varint count,
+    then per entry u64-LE + varint."""
+    import struct
+
+    out = bytearray()
+    pairs = list(pairs)
+    _uvarint(out, len(pairs))
+    for a, b in pairs:
+        out += struct.pack("<Q", a)
+        _uvarint(out, b)
+    return bytes(out)
+
+
+def _decode_u64_varint_pairs(data: bytes):
+    """Inverse of _encode_u64_varint_pairs; raises ValueError on
+    malformed/truncated input."""
+    import struct
+
+    try:
+        pos = [0]
+        n = _read_uvarint(data, pos)
+        if n > len(data):
+            raise ValueError("count exceeds payload")
+        out = []
+        for _ in range(n):
+            (a,) = struct.unpack_from("<Q", data, pos[0])
+            pos[0] += 8
+            out.append((a, _read_uvarint(data, pos)))
+        return out
+    except (IndexError, struct.error) as e:
+        raise ValueError(f"malformed pair blob: {e}") from e
 
 
 def _uvarint(out: bytearray, v: int) -> None:
@@ -220,33 +233,12 @@ class Frontiers:
 
     def encode(self) -> bytes:
         """Compact binary form: varint count + (u64 peer, varint ctr)."""
-        import struct
-
-        out = bytearray()
-        _uvarint(out, len(self._ids))
-        for i in self._ids:
-            out += struct.pack("<Q", i.peer)
-            _uvarint(out, i.counter)
-        return bytes(out)
+        return _encode_u64_varint_pairs((i.peer, i.counter) for i in self._ids)
 
     @staticmethod
     def decode(data: bytes) -> "Frontiers":
         """Raises ValueError on malformed input."""
-        import struct
-
-        try:
-            pos = [0]
-            n = _read_uvarint(data, pos)
-            if n > len(data):
-                raise ValueError("frontier count exceeds payload")
-            ids = []
-            for _ in range(n):
-                (p,) = struct.unpack_from("<Q", data, pos[0])
-                pos[0] += 8
-                ids.append(ID(p, _read_uvarint(data, pos)))
-            return Frontiers(ids)
-        except (IndexError, struct.error) as e:
-            raise ValueError(f"malformed frontiers: {e}") from e
+        return Frontiers(ID(p, c) for p, c in _decode_u64_varint_pairs(data))
 
 
 class VersionRange:

@@ -78,7 +78,10 @@ class UndoManager:
         interval); group_start()/group_end() group explicitly;
         exclude_origin_prefixes: local commits whose origin starts with
         any prefix are not recorded as undo steps (reference:
-        excludeOriginPrefixes)."""
+        excludeOriginPrefixes).  Exclusion takes precedence over
+        grouping: an excluded commit inside a group splits it (an undo
+        span must never extend across work that must not be undone —
+        the inverse diff would revert it)."""
         self.doc = doc
         self.max_stack = max_stack
         self.merge_interval_ms = merge_interval_ms
@@ -86,7 +89,6 @@ class UndoManager:
         self.undo_stack: List[UndoItem] = []
         self.redo_stack: List[UndoItem] = []
         self._unsub = doc.subscribe_root(self._on_event)
-        self._exclude_origins = {UNDO_ORIGIN, REDO_ORIGIN}
         self._grouping = False
         self._group_fresh = False
         self._last_push_ms = 0.0

@@ -120,6 +120,27 @@ class TestUndoExcludeOrigins:
         assert t.to_string() == "[auto] "
         assert not um.can_undo()
 
+    def test_excluded_commit_splits_group(self):
+        """Documented precedence: exclusion beats grouping — a span must
+        never extend across work that must not be undone."""
+        from loro_tpu import UndoManager
+
+        doc = LoroDoc(peer=1)
+        um = UndoManager(doc, exclude_origin_prefixes=["sys:"])
+        t = doc.get_text("t")
+        um.group_start()
+        t.insert(0, "A")
+        doc.commit()
+        t.insert(1, "x")
+        doc.commit(origin="sys:auto")
+        t.insert(2, "B")
+        doc.commit()
+        um.group_end()
+        assert len(um.undo_stack) == 2  # group split around the exclusion
+        um.undo()
+        um.undo()
+        assert t.to_string() == "x"  # excluded text survives both undos
+
 
 class TestFrontiersBytes:
     def test_roundtrip_and_errors(self):

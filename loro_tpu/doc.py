@@ -863,10 +863,16 @@ class LoroDoc:
     # values
     # ------------------------------------------------------------------
     def get_value(self) -> Dict[str, Any]:
-        return self.state.get_value()
+        v = self.state.get_value()
+        if self.config.hide_empty_root_containers:
+            v = {k: x for k, x in v.items() if x not in ("", [], {}, None)}
+        return v
 
     def get_deep_value(self) -> Dict[str, Any]:
-        return self.state.get_deep_value()
+        v = self.state.get_deep_value()
+        if self.config.hide_empty_root_containers:
+            v = {k: x for k, x in v.items() if x not in ("", [], {}, None)}
+        return v
 
     def get_by_str_path(self, path: str):
         """Navigate "container/key/index/..." to a handler or value

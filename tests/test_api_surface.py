@@ -161,6 +161,20 @@ class TestVvDecodeErrors:
                 VersionVector.decode(blob[:cut])
 
 
+class TestHideEmptyRoots:
+    def test_flag(self):
+        doc = LoroDoc(peer=1)
+        doc.get_text("full").insert(0, "x")
+        t = doc.get_text("emptied")
+        t.insert(0, "y")
+        t.delete(0, 1)
+        doc.commit()
+        assert set(doc.get_value()) == {"full", "emptied"}
+        doc.config.hide_empty_root_containers = True
+        assert set(doc.get_value()) == {"full"}
+        assert set(doc.get_deep_value()) == {"full"}
+
+
 class TestHandlerSugar:
     def test_text_splice(self):
         doc = LoroDoc(peer=1)

@@ -99,7 +99,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.loro_explode_map.argtypes = [
             ctypes.c_char_p,
             ctypes.c_longlong,
-        ] + [ctypes.c_void_p] * 5 + [ctypes.c_longlong]
+        ] + [ctypes.c_void_p] * 6 + [ctypes.c_longlong]
         _lib = lib
         return lib
 
@@ -219,6 +219,7 @@ def explode_map_payload(payload: bytes):
     lamport = np.empty(n, np.int32)
     peer = np.empty(n, np.int32)
     value = np.empty(n, np.int32)
+    voffset = np.empty(n, np.int64)
     wrote = lib.loro_explode_map(
         payload,
         len(payload),
@@ -227,6 +228,7 @@ def explode_map_payload(payload: bytes):
         lamport.ctypes.data_as(ctypes.c_void_p),
         peer.ctypes.data_as(ctypes.c_void_p),
         value.ctypes.data_as(ctypes.c_void_p),
+        voffset.ctypes.data_as(ctypes.c_void_p),
         n,
     )
     if wrote != n:
@@ -245,8 +247,20 @@ def explode_map_payload(payload: bytes):
         "key_idx": key,
         "lamport": lamport,
         "peer_rank": peer_rank.astype(np.int32),
+        "peer_u64": np.asarray([peers_wire[i] for i in peer], dtype=object),
         "value_ordinal": value,
+        "value_offset": voffset,  # byte offset into the payload (-1 = delete)
         "peers": sorted(peers_wire),
         "keys": keys,
         "cids": cids,
     }
+
+
+def decode_value_at(payload: bytes, offset: int, cids):
+    """Decode one tagged value at a native-reported byte offset (lazy
+    winner-only decoding for DeviceMapBatch)."""
+    from ..codec.binary import Reader, _read_value
+
+    r = Reader(payload)
+    r.i = offset
+    return _read_value(r, cids)

@@ -578,13 +578,15 @@ long long loro_count_map_ops(const uint8_t* buf, long long len) {
 }
 
 // Pass 2: fill map-op rows across ALL map containers:
-// (cid_idx, key_idx, lamport, peer_idx, value ordinal or -1 for delete).
-// Value payloads are not decoded natively; `out_value` is the ordinal of
-// the K_MAP_SET row in wire order so Python can decode values lazily.
+// (cid_idx, key_idx, lamport, peer_idx, value ordinal or -1 for delete,
+// value BYTE OFFSET into the payload or -1).  Values are not decoded
+// natively — the offsets let Python decode only the LWW winners lazily
+// (DeviceMapBatch ingests payloads without touching loser values).
 long long loro_explode_map(const uint8_t* buf, long long len,
                            int32_t* out_cid, int32_t* out_key,
                            int32_t* out_lamport, int32_t* out_peer,
-                           int32_t* out_value, long long n_rows) {
+                           int32_t* out_value, int64_t* out_voffset,
+                           long long n_rows) {
   Reader r{buf, buf + len};
   uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
   if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
@@ -599,7 +601,9 @@ long long loro_explode_map(const uint8_t* buf, long long len,
       if (kind == K_MAP_SET || kind == K_MAP_DEL) {
         uint64_t key = r.varint();
         int32_t val = -1;
+        int64_t voff = -1;
         if (kind == K_MAP_SET) {
+          voff = (int64_t)(r.p - buf);
           if (!skip_value(r)) return -1;
           val = ordinal++;
         }
@@ -609,6 +613,7 @@ long long loro_explode_map(const uint8_t* buf, long long len,
         out_lamport[row] = (int32_t)(m.lamport + (ctr - m.ctr));
         out_peer[row] = (int32_t)m.peer_idx;
         out_value[row] = val;
+        out_voffset[row] = voff;
         row++;
         ctr += 1;
       } else {

@@ -802,6 +802,23 @@ class DeviceDocBatch:
         ]
 
 
+class _LazyValue:
+    """Undecoded map value: payload bytes + native-reported offset.
+    Decoded only if it wins the LWW (value_maps)."""
+
+    __slots__ = ("payload", "offset", "cids")
+
+    def __init__(self, payload: bytes, offset: int, cids):
+        self.payload = payload
+        self.offset = offset
+        self.cids = cids
+
+    def decode(self):
+        from ..native import decode_value_at
+
+        return decode_value_at(self.payload, self.offset, self.cids)
+
+
 class DeviceMapBatch:
     """Device-resident LWW-map winners for a doc batch (the map analog
     of DeviceDocBatch).  Appends fold into per-(doc, slot) winners in
@@ -856,6 +873,55 @@ class DeviceMapBatch:
                         vi = len(vals)
                         vals.append(c.value)
                     rows.append((slot_of[key], lam, ch.peer, vi))
+        self._fold_rows(rows_per_doc)
+
+    def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]]) -> None:
+        """Native ingest: binary payloads -> C++ map explode -> one
+        donated fold.  Values are NOT decoded here — the native decoder
+        reports byte offsets and value_maps() decodes only the LWW
+        winners (loser values never touch Python)."""
+        from ..codec.binary import decode_changes
+        from ..native import available, explode_map_payload
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.lww import lww_update_resident
+
+        if not available():
+            self.append_changes(
+                [decode_changes(p) if p else None for p in per_doc_payloads]
+            )
+            return
+        per_doc_payloads = list(per_doc_payloads) + [None] * (self.d - len(per_doc_payloads))
+        rows_per_doc = []
+        for di, payload in enumerate(per_doc_payloads):
+            rows = []
+            rows_per_doc.append(rows)
+            if not payload:
+                continue
+            out = explode_map_payload(payload)
+            slot_of = self.slot_of[di]
+            vals = self.values[di]
+            n = len(out["cid_idx"])
+            for j in range(n):
+                key = (out["cids"][out["cid_idx"][j]], out["keys"][out["key_idx"][j]])
+                if key not in slot_of:
+                    assert len(slot_of) < self.s, "DeviceMapBatch slot capacity exceeded"
+                    slot_of[key] = len(slot_of)
+                off = int(out["value_offset"][j])
+                if off < 0:
+                    vi = -1
+                else:
+                    vi = len(vals)
+                    # lazy cell: decoded on demand in value_maps()
+                    vals.append(_LazyValue(payload, off, out["cids"]))
+                rows.append(
+                    (slot_of[key], int(out["lamport"][j]), out["peer_u64"][j], vi)
+                )
+        self._fold_rows(rows_per_doc)
+
+    def _fold_rows(self, rows_per_doc) -> None:
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.lww import lww_update_resident
+
         m = pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16)
         if not any(rows_per_doc):
             return
@@ -881,7 +947,8 @@ class DeviceMapBatch:
 
     def value_maps(self) -> List[Dict[str, object]]:
         """Materialize {key: value} per doc (root-map keys flattened by
-        container)."""
+        container).  Lazy cells (native ingest) decode here — winners
+        only."""
         win = np.asarray(self.res.value)
         out = []
         for di in range(self.n_docs):
@@ -889,7 +956,11 @@ class DeviceMapBatch:
             for (cid, key), s_ in self.slot_of[di].items():
                 vi = int(win[di, s_])
                 if vi >= 0:
-                    m[key] = self.values[di][vi]
+                    v = self.values[di][vi]
+                    if isinstance(v, _LazyValue):
+                        v = v.decode()
+                        self.values[di][vi] = v
+                    m[key] = v
             out.append(m)
         return out
 

@@ -45,6 +45,45 @@ class TestDeviceMapBatch:
         batch.append_changes([None, None])
         assert batch.value_maps() == [{}, {}]
 
+    @pytest.mark.parametrize("seed", range(3))
+    def test_native_payload_ingest_lazy_values(self, seed):
+        """Payload ingest: native columns fold; only LWW winners decode
+        (lazy value cells)."""
+        from loro_tpu import ExportMode
+        from loro_tpu.native import available
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        rng = random.Random(seed)
+        pairs = []
+        for i in range(2):
+            a = LoroDoc(peer=i + 1)
+            b = LoroDoc(peer=(1 << 35) + i)
+            pairs.append((a, b))
+        batch = DeviceMapBatch(n_docs=2, slot_capacity=32)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        for epoch in range(3):
+            payloads = []
+            for i, (a, b) in enumerate(pairs):
+                for d in (a, b):
+                    m = d.get_map("m")
+                    for _ in range(rng.randint(1, 6)):
+                        if rng.random() < 0.2:
+                            m.delete(rng.choice("ab"))
+                        else:
+                            m.set(rng.choice("ab"), {"v": rng.randint(0, 99)})
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+                payloads.append(
+                    a.export(ExportMode.UpdatesInRange(marks[i], a.oplog_vv()))[10:]
+                )
+                marks[i] = a.oplog_vv()
+            batch.append_payloads(payloads)
+            got = batch.value_maps()
+            for i, (a, _) in enumerate(pairs):
+                assert got[i] == a.get_map("m").get_value(), f"seed {seed} epoch {epoch}"
+
     def test_high_bit_peer_tiebreak(self):
         """u32 halves must compare unsigned: peer 2^63-ish beats a small
         peer at equal lamport (would flip under int32 truncation)."""

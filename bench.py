@@ -276,19 +276,38 @@ def main_guarded() -> None:
     on the virtual CPU backend with an honest 'cpu_fallback' label."""
     import subprocess
 
+    def run_graceful(cmd, env, timeout_s):
+        # Never SIGKILL a JAX child mid-TPU-launch (CLAUDE.md: it can
+        # wedge the axon tunnel for the whole session).  SIGTERM and
+        # give the runtime a long grace window to unwind the launch.
+        proc = subprocess.Popen(cmd, env=env)
+        try:
+            proc.wait(timeout=timeout_s)
+            return proc.returncode
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                print(
+                    "bench: child ignored SIGTERM; leaving it to finish "
+                    "rather than SIGKILL a mid-flight TPU launch",
+                    file=sys.stderr,
+                )
+                proc.wait()
+            return None  # distinct from any real returncode (incl. signal -N)
+
     timeout_s = int(os.environ.get("BENCH_TIMEOUT", "900"))
     env = dict(os.environ, BENCH_INNER="1")
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env, timeout=timeout_s
-        )
-        if r.returncode == 0:
-            return
-        print(f"bench: device run failed rc={r.returncode}; cpu fallback", file=sys.stderr)
-    except subprocess.TimeoutExpired:
+    rc = run_graceful([sys.executable, os.path.abspath(__file__)], env, timeout_s)
+    if rc == 0:
+        return
+    if rc is None:
         print(f"bench: device run exceeded {timeout_s}s (wedged tunnel?); cpu fallback", file=sys.stderr)
+    else:
+        print(f"bench: device run failed rc={rc}; cpu fallback", file=sys.stderr)
     env_cpu = dict(env, JAX_PLATFORMS="cpu", BENCH_LABEL="cpu_fallback")
-    subprocess.run([sys.executable, os.path.abspath(__file__)], env=env_cpu, timeout=timeout_s)
+    run_graceful([sys.executable, os.path.abspath(__file__)], env_cpu, timeout_s)
 
 
 if __name__ == "__main__":

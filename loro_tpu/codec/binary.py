@@ -271,7 +271,10 @@ def _read_cid(r: Reader, peers: List[int]) -> ContainerID:
     ctype = ContainerType(b & 0x7F)
     if b & 0x80:
         return ContainerID.root(r.str_(), ctype)
-    return ContainerID.normal(peers[r.varint()], r.zigzag(), ctype)
+    pi = r.varint()
+    if pi >= len(peers):
+        raise ValueError(f"cid peer index {pi} out of table ({len(peers)} peers)")
+    return ContainerID.normal(peers[pi], r.zigzag(), ctype)
 
 
 def encode_changes(changes: List[Change]) -> bytes:

@@ -862,16 +862,40 @@ class LoroDoc:
     # ------------------------------------------------------------------
     # values
     # ------------------------------------------------------------------
+    def _hide_empty_filter(self, v: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-container-type emptiness, matching the reference
+        (state.rs visible_container_value_is_empty): hide only empty
+        Text/Map/List/MovableList/Tree roots; Counter and Unknown roots
+        are never hidden regardless of value."""
+        from loro_tpu.core.ids import ContainerType
+
+        hideable = {
+            ContainerType.Text: "",
+            ContainerType.Map: {},
+            ContainerType.List: [],
+            ContainerType.MovableList: [],
+            ContainerType.Tree: [],
+        }
+        empty_by_name: Dict[str, Any] = {}
+        for cid in self.state.states:
+            if cid.is_root and cid.ctype in hideable:
+                empty_by_name[cid.name] = hideable[cid.ctype]
+        return {
+            k: x
+            for k, x in v.items()
+            if not (k in empty_by_name and x == empty_by_name[k])
+        }
+
     def get_value(self) -> Dict[str, Any]:
         v = self.state.get_value()
         if self.config.hide_empty_root_containers:
-            v = {k: x for k, x in v.items() if x not in ("", [], {}, None)}
+            v = self._hide_empty_filter(v)
         return v
 
     def get_deep_value(self) -> Dict[str, Any]:
         v = self.state.get_deep_value()
         if self.config.hide_empty_root_containers:
-            v = {k: x for k, x in v.items() if x not in ("", [], {}, None)}
+            v = self._hide_empty_filter(v)
         return v
 
     def get_by_str_path(self, path: str):

@@ -128,6 +128,31 @@ inline uint64_t idkey(uint32_t peer_idx, int64_t counter) {
   return ((uint64_t)peer_idx << 40) | (uint64_t)(counter & 0xffffffffffLL);
 }
 
+// Strict UTF-8: validates continuation prefixes, rejects overlong
+// encodings, surrogates, and > U+10FFFF (a corrupted-but-CRC-valid
+// payload must fail decode, not produce wrong codepoints).  Returns
+// bytes consumed, or -1 on malformed input.
+inline int decode_utf8_cp(const uint8_t* s, uint64_t nb, uint64_t i, uint32_t* out) {
+  uint8_t b0 = s[i];
+  uint32_t cp; int extra;
+  if (b0 < 0x80) { cp = b0; extra = 0; }
+  else if ((b0 & 0xe0) == 0xc0) { cp = b0 & 0x1f; extra = 1; }
+  else if ((b0 & 0xf0) == 0xe0) { cp = b0 & 0x0f; extra = 2; }
+  else if ((b0 & 0xf8) == 0xf0) { cp = b0 & 0x07; extra = 3; }
+  else return -1;
+  if (i + (uint64_t)extra >= nb && extra > 0) return -1;
+  for (int e = 1; e <= extra; e++) {
+    if ((s[i + e] & 0xc0) != 0x80) return -1;
+    cp = (cp << 6) | (s[i + e] & 0x3f);
+  }
+  static const uint32_t min_cp[4] = {0, 0x80, 0x800, 0x10000};
+  if (extra > 0 && cp < min_cp[extra]) return -1;          // overlong
+  if (cp >= 0xd800 && cp <= 0xdfff) return -1;             // surrogate
+  if (cp > 0x10ffff) return -1;
+  *out = cp;
+  return extra + 1;
+}
+
 struct ChangeMeta {
   uint32_t peer_idx;
   int64_t ctr;
@@ -137,12 +162,13 @@ struct ChangeMeta {
 
 // Parse header tables + change meta.  Returns false on malformed input.
 bool parse_prelude(Reader& r, uint64_t* n_peers, std::vector<int32_t>& cid_types,
-                   std::vector<ChangeMeta>& metas) {
+                   std::vector<ChangeMeta>& metas, uint64_t* n_keys_out = nullptr) {
   *n_peers = r.varint();
   if (!r.ok || *n_peers > 1u << 24) return false;
   for (uint64_t i = 0; i < *n_peers; i++) r.u64le();
   uint64_t n_keys = r.varint();
   if (!r.ok || n_keys > 1u << 26) return false;
+  if (n_keys_out) *n_keys_out = n_keys;
   for (uint64_t i = 0; i < n_keys; i++)
     if (!r.skip_bytes()) return false;
   uint64_t n_cids = r.varint();
@@ -161,7 +187,9 @@ bool parse_prelude(Reader& r, uint64_t* n_peers, std::vector<int32_t>& cid_types
   if (!r.ok || n_changes > 1u << 28) return false;
   metas.resize(n_changes);
   for (uint64_t i = 0; i < n_changes; i++) {
-    metas[i].peer_idx = (uint32_t)r.varint();
+    uint64_t pidx = r.varint();
+    if (!r.ok || pidx >= *n_peers) return false;  // wire index must hit the peer table
+    metas[i].peer_idx = (uint32_t)pidx;
     metas[i].ctr = r.zigzag();
     metas[i].lamport = r.zigzag();
     r.zigzag();  // timestamp delta
@@ -302,7 +330,11 @@ long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
       if (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES) {
         uint8_t ptag = r.u8();
         uint32_t p_peer = 0; int64_t p_ctr = 0;
-        if (ptag == PT_ID) { p_peer = (uint32_t)r.varint(); p_ctr = r.zigzag(); }
+        if (ptag == PT_ID) {
+          uint64_t pi = r.varint();
+          if (!r.ok || pi >= n_peers) return -1;
+          p_peer = (uint32_t)pi; p_ctr = r.zigzag();
+        }
         uint8_t side = r.u8();
         // resolve first element's parent
         int32_t parent_row;
@@ -320,16 +352,10 @@ long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
           // utf8 -> codepoints, one element per codepoint
           uint64_t i = 0; int64_t j = 0;
           while (i < nb) {
-            uint32_t cp; uint8_t b0 = s[i];
-            int extra;
-            if (b0 < 0x80) { cp = b0; extra = 0; }
-            else if ((b0 & 0xe0) == 0xc0) { cp = b0 & 0x1f; extra = 1; }
-            else if ((b0 & 0xf0) == 0xe0) { cp = b0 & 0x0f; extra = 2; }
-            else if ((b0 & 0xf8) == 0xf0) { cp = b0 & 0x07; extra = 3; }
-            else return -1;
-            if (extra > 0 && i + (uint64_t)extra >= nb) return -1;
-            for (int e = 1; e <= extra; e++) cp = (cp << 6) | (s[i + e] & 0x3f);
-            i += extra + 1;
+            uint32_t cp;
+            int used = decode_utf8_cp(s, nb, i, &cp);
+            if (used < 0) return -1;
+            i += used;
             if (row >= n_elems) return -1;
             out_parent[row] = (j == 0) ? parent_row : (int32_t)(row - 1);
             out_side[row] = (j == 0) ? side : 1;
@@ -361,7 +387,9 @@ long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
         uint64_t n = r.varint();
         for (uint64_t i = 0; i < n && r.ok; i++) {
           DelSpan d;
-          d.peer_idx = (uint32_t)r.varint();
+          uint64_t dpi = r.varint();
+          if (!r.ok || dpi >= n_peers) return -1;
+          d.peer_idx = (uint32_t)dpi;
           d.start = r.zigzag();
           d.end = d.start + (int64_t)r.varint();
           dels.push_back(d);
@@ -446,7 +474,11 @@ long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_c
       if (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES || kind == K_INSERT_ANCHOR) {
         uint8_t ptag = r.u8();
         uint32_t p_peer = 0; int64_t p_ctr = 0;
-        if (ptag == PT_ID) { p_peer = (uint32_t)r.varint(); p_ctr = r.zigzag(); }
+        if (ptag == PT_ID) {
+          uint64_t pi = r.varint();
+          if (!r.ok || pi >= n_peers) return -1;
+          p_peer = (uint32_t)pi; p_ctr = r.zigzag();
+        }
         uint8_t side = r.u8();
         int32_t parent_row;
         uint32_t ext_peer = 0; int64_t ext_ctr = -1;
@@ -486,15 +518,10 @@ long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_c
           if (!r.ok) return -1;
           uint64_t i = 0; int64_t j = 0;
           while (i < nb) {
-            uint32_t cp; uint8_t b0 = s[i]; int extra;
-            if (b0 < 0x80) { cp = b0; extra = 0; }
-            else if ((b0 & 0xe0) == 0xc0) { cp = b0 & 0x1f; extra = 1; }
-            else if ((b0 & 0xf0) == 0xe0) { cp = b0 & 0x0f; extra = 2; }
-            else if ((b0 & 0xf8) == 0xf0) { cp = b0 & 0x07; extra = 3; }
-            else return -1;
-            if (extra > 0 && i + (uint64_t)extra >= nb) return -1;
-            for (int e = 1; e <= extra; e++) cp = (cp << 6) | (s[i + e] & 0x3f);
-            i += extra + 1;
+            uint32_t cp;
+            int used = decode_utf8_cp(s, nb, i, &cp);
+            if (used < 0) return -1;
+            i += used;
             if (!emit(j, cp)) return -1;
             j++;
           }
@@ -510,7 +537,9 @@ long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_c
       } else if (kind == K_DELETE) {
         uint64_t n = r.varint();
         for (uint64_t i = 0; i < n && r.ok; i++) {
-          uint32_t dp = (uint32_t)r.varint();
+          uint64_t dpi = r.varint();
+          if (!r.ok || dpi >= n_peers) return -1;
+          uint32_t dp = (uint32_t)dpi;
           int64_t ds = r.zigzag();
           int64_t dl = (int64_t)r.varint();
           if (n_del >= n_del_max) return -1;
@@ -588,8 +617,8 @@ long long loro_explode_map(const uint8_t* buf, long long len,
                            int32_t* out_value, int64_t* out_voffset,
                            long long n_rows) {
   Reader r{buf, buf + len};
-  uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
-  if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
+  uint64_t n_peers, n_keys; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
+  if (!parse_prelude(r, &n_peers, cid_types, metas, &n_keys)) return -1;
   long long row = 0;
   int32_t ordinal = 0;
   for (auto& m : metas) {
@@ -600,6 +629,7 @@ long long loro_explode_map(const uint8_t* buf, long long len,
       if (!r.ok) return -1;
       if (kind == K_MAP_SET || kind == K_MAP_DEL) {
         uint64_t key = r.varint();
+        if (!r.ok || cidx >= cid_types.size() || key >= n_keys) return -1;
         int32_t val = -1;
         int64_t voff = -1;
         if (kind == K_MAP_SET) {

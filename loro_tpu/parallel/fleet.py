@@ -849,30 +849,47 @@ class DeviceMapBatch:
         from ..ops.lww import lww_update_resident
 
         per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
-        rows_per_doc = []
+        # stage all mutations; commit only after every doc ingests clean
+        # (a capacity error must leave the batch state untouched)
+        rows_per_doc, new_slots, new_vals = [], [], []
         for di, changes in enumerate(per_doc_changes):
             rows = []
             rows_per_doc.append(rows)
+            staged_slots: Dict = {}
+            staged_vals: List = []
+            new_slots.append(staged_slots)
+            new_vals.append(staged_vals)
             if not changes:
                 continue
             slot_of = self.slot_of[di]
-            vals = self.values[di]
+            n_vals0 = len(self.values[di])
             for ch in changes:
                 for op in ch.ops:
                     c = op.content
                     if not isinstance(c, MapSet):
                         continue
                     key = (op.container, c.key)
-                    if key not in slot_of:
-                        assert len(slot_of) < self.s, "DeviceMapBatch slot capacity exceeded"
-                        slot_of[key] = len(slot_of)
+                    slot = slot_of.get(key)
+                    if slot is None:
+                        slot = staged_slots.get(key)
+                    if slot is None:
+                        slot = len(slot_of) + len(staged_slots)
+                        if slot >= self.s:
+                            raise ValueError(
+                                f"DeviceMapBatch slot capacity exceeded ({self.s}); "
+                                "grow slot_capacity"
+                            )
+                        staged_slots[key] = slot
                     lam = ch.lamport + (op.counter - ch.ctr_start)
                     if c.deleted:
                         vi = -1
                     else:
-                        vi = len(vals)
-                        vals.append(c.value)
-                    rows.append((slot_of[key], lam, ch.peer, vi))
+                        vi = n_vals0 + len(staged_vals)
+                        staged_vals.append(c.value)
+                    rows.append((slot, lam, ch.peer, vi))
+        for di in range(self.d):
+            self.slot_of[di].update(new_slots[di])
+            self.values[di].extend(new_vals[di])
         self._fold_rows(rows_per_doc)
 
     def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]]) -> None:
@@ -891,31 +908,48 @@ class DeviceMapBatch:
             )
             return
         per_doc_payloads = list(per_doc_payloads) + [None] * (self.d - len(per_doc_payloads))
-        rows_per_doc = []
+        # staged exactly like append_changes: no state mutation until
+        # every payload decodes and fits capacity
+        rows_per_doc, new_slots, new_vals = [], [], []
         for di, payload in enumerate(per_doc_payloads):
             rows = []
             rows_per_doc.append(rows)
+            staged_slots: Dict = {}
+            staged_vals: List = []
+            new_slots.append(staged_slots)
+            new_vals.append(staged_vals)
             if not payload:
                 continue
             out = explode_map_payload(payload)
             slot_of = self.slot_of[di]
-            vals = self.values[di]
+            n_vals0 = len(self.values[di])
             n = len(out["cid_idx"])
             for j in range(n):
                 key = (out["cids"][out["cid_idx"][j]], out["keys"][out["key_idx"][j]])
-                if key not in slot_of:
-                    assert len(slot_of) < self.s, "DeviceMapBatch slot capacity exceeded"
-                    slot_of[key] = len(slot_of)
+                slot = slot_of.get(key)
+                if slot is None:
+                    slot = staged_slots.get(key)
+                if slot is None:
+                    slot = len(slot_of) + len(staged_slots)
+                    if slot >= self.s:
+                        raise ValueError(
+                            f"DeviceMapBatch slot capacity exceeded ({self.s}); "
+                            "grow slot_capacity"
+                        )
+                    staged_slots[key] = slot
                 off = int(out["value_offset"][j])
                 if off < 0:
                     vi = -1
                 else:
-                    vi = len(vals)
+                    vi = n_vals0 + len(staged_vals)
                     # lazy cell: decoded on demand in value_maps()
-                    vals.append(_LazyValue(payload, off, out["cids"]))
+                    staged_vals.append(_LazyValue(payload, off, out["cids"]))
                 rows.append(
-                    (slot_of[key], int(out["lamport"][j]), out["peer_u64"][j], vi)
+                    (slot, int(out["lamport"][j]), out["peer_u64"][j], vi)
                 )
+        for di in range(self.d):
+            self.slot_of[di].update(new_slots[di])
+            self.values[di].extend(new_vals[di])
         self._fold_rows(rows_per_doc)
 
     def _fold_rows(self, rows_per_doc) -> None:
@@ -945,14 +979,15 @@ class DeviceMapBatch:
             self.res, put(slot), put(lam), put(hi), put(lo), put(valid), self.s, value=put(val)
         )
 
-    def value_maps(self) -> List[Dict[str, object]]:
-        """Materialize {key: value} per doc (root-map keys flattened by
-        container).  Lazy cells (native ingest) decode here — winners
-        only."""
+    def value_maps(self) -> List[Dict[Tuple[ContainerID, str], object]]:
+        """Materialize {(container, key): value} per doc.  Keys carry
+        the container id so the same key name in two map containers of
+        one doc cannot collide.  Lazy cells (native ingest) decode here
+        — winners only."""
         win = np.asarray(self.res.value)
         out = []
         for di in range(self.n_docs):
-            m: Dict[str, object] = {}
+            m: Dict[Tuple[ContainerID, str], object] = {}
             for (cid, key), s_ in self.slot_of[di].items():
                 vi = int(win[di, s_])
                 if vi >= 0:
@@ -960,8 +995,21 @@ class DeviceMapBatch:
                     if isinstance(v, _LazyValue):
                         v = v.decode()
                         self.values[di][vi] = v
-                    m[key] = v
+                    m[(cid, key)] = v
             out.append(m)
+        return out
+
+    def root_value_maps(self, name: str) -> List[Dict[str, object]]:
+        """Flat {key: value} per doc for one root map container."""
+        out = []
+        for full in self.value_maps():
+            out.append(
+                {
+                    key: v
+                    for (cid, key), v in full.items()
+                    if cid.is_root and cid.name == name
+                }
+            )
         return out
 
 

@@ -174,6 +174,22 @@ class TestHideEmptyRoots:
         assert set(doc.get_value()) == {"full"}
         assert set(doc.get_deep_value()) == {"full"}
 
+    def test_counter_root_never_hidden(self):
+        """Counter roots are never hidden, even at value 0 (reference:
+        state.rs visible_container_value_is_empty excludes Counter)."""
+        doc = LoroDoc(peer=1)
+        c = doc.get_counter("c")
+        c.increment(5)
+        c.decrement(5)  # back to 0 — still must show
+        m = doc.get_map("m")
+        m.set("k", 1)
+        m.delete("k")  # empty map: hideable
+        doc.commit()
+        doc.config.hide_empty_root_containers = True
+        assert set(doc.get_value()) == {"c"}
+        assert doc.get_value()["c"] == 0
+        assert set(doc.get_deep_value()) == {"c"}
+
 
 class TestHandlerSugar:
     def test_text_splice(self):

@@ -36,7 +36,7 @@ class TestDeviceMapBatch:
                 ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
                 marks[i] = a.oplog_vv()
             batch.append_changes(ups)
-            got = batch.value_maps()
+            got = batch.root_value_maps("m")
             for i, (a, _) in enumerate(pairs):
                 assert got[i] == a.get_map("m").get_value(), f"seed {seed} epoch {epoch} doc {i}"
 
@@ -80,9 +80,44 @@ class TestDeviceMapBatch:
                 )
                 marks[i] = a.oplog_vv()
             batch.append_payloads(payloads)
-            got = batch.value_maps()
+            got = batch.root_value_maps("m")
             for i, (a, _) in enumerate(pairs):
                 assert got[i] == a.get_map("m").get_value(), f"seed {seed} epoch {epoch}"
+
+    def test_same_key_two_containers_no_collision(self):
+        """Advisor finding: the same key name in two map containers of
+        one doc must not collide in value_maps()."""
+        a = LoroDoc(peer=1)
+        a.get_map("m1").set("k", "v1")
+        a.get_map("m2").set("k", "v2")
+        a.commit()
+        batch = DeviceMapBatch(n_docs=1, slot_capacity=8)
+        batch.append_changes([a.oplog.changes_in_causal_order()])
+        full = batch.value_maps()[0]
+        assert len(full) == 2
+        assert {v for v in full.values()} == {"v1", "v2"}
+        assert batch.root_value_maps("m1")[0] == {"k": "v1"}
+        assert batch.root_value_maps("m2")[0] == {"k": "v2"}
+
+    def test_capacity_overflow_raises(self):
+        """Advisor finding: capacity overflow must raise (not a bare
+        assert that vanishes under python -O)."""
+        a = LoroDoc(peer=1)
+        m = a.get_map("m")
+        for i in range(5):
+            m.set(f"k{i}", i)
+        a.commit()
+        batch = DeviceMapBatch(n_docs=1, slot_capacity=2)
+        with pytest.raises(ValueError, match="slot capacity"):
+            batch.append_changes([a.oplog.changes_in_causal_order()])
+        # failed append must not poison the batch: state unchanged,
+        # and a fitting append still works
+        assert batch.slot_of[0] == {} and batch.values[0] == []
+        b = LoroDoc(peer=2)
+        b.get_map("m").set("k0", "fits")
+        b.commit()
+        batch.append_changes([b.oplog.changes_in_causal_order()])
+        assert batch.root_value_maps("m")[0] == {"k0": "fits"}
 
     def test_high_bit_peer_tiebreak(self):
         """u32 halves must compare unsigned: peer 2^63-ish beats a small
@@ -96,7 +131,7 @@ class TestDeviceMapBatch:
         a.import_(b.export_updates(a.oplog_vv()))
         batch = DeviceMapBatch(n_docs=1, slot_capacity=8)
         batch.append_changes([a.oplog.changes_in_causal_order()])
-        assert batch.value_maps()[0] == a.get_map("m").get_value() == {"k": "from_big"}
+        assert batch.root_value_maps("m")[0] == a.get_map("m").get_value() == {"k": "from_big"}
 
 
 def _changes_between(doc, from_vv):

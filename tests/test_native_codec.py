@@ -154,6 +154,92 @@ class TestNativeDecoder:
             with pytest.raises(ValueError):
                 extract_seq_from_payload(bytes(payload[:cut]), cid)
 
+    def test_bad_peer_index_rejected(self):
+        """A CRC-valid payload whose change header references a peer
+        index beyond the peer table must fail native decode (advisor
+        finding: it used to wrap negative and mis-attribute ops)."""
+        from loro_tpu.native import explode_map_payload
+
+        doc = LoroDoc(peer=1)
+        doc.get_map("m").set("k", 1)
+        payload = bytearray(_payload(doc))
+        # Mutate every byte position in turn: the native decoder must
+        # either decode, raise ValueError, or fall back (None) — never
+        # crash, and (checked below for the explicit case) never accept
+        # an out-of-table peer index.
+        for pos in range(len(payload)):
+            mut = bytearray(payload)
+            mut[pos] = (mut[pos] + 0x81) & 0xFF
+            try:
+                explode_map_payload(bytes(mut))
+            except ValueError:
+                pass
+        # Explicit case: bump the change-meta peer_idx varint past the
+        # peer table (layout: binary.py module docstring).  Walk the
+        # prelude to find it.
+        buf = bytes(payload)
+
+        def rvarint(b, i):
+            sh = v = 0
+            while True:
+                v |= (b[i] & 0x7F) << sh
+                sh += 7
+                i += 1
+                if not b[i - 1] & 0x80:
+                    return v, i
+
+        n_peers, i = rvarint(buf, 0)
+        assert n_peers == 1
+        i += 8 * n_peers
+        n_keys, i = rvarint(buf, i)
+        for _ in range(n_keys):
+            ln, i = rvarint(buf, i)
+            i += ln
+        n_cids, i = rvarint(buf, i)
+        for _ in range(n_cids):
+            b0 = buf[i]
+            i += 1
+            if b0 & 0x80:
+                ln, i = rvarint(buf, i)
+                i += ln
+            else:
+                _, i = rvarint(buf, i)  # peer idx
+                _, i = rvarint(buf, i)  # zigzag counter
+        n_changes, i = rvarint(buf, i)
+        assert n_changes >= 1
+        assert buf[i] == 0  # peer_idx 0: the only peer
+        mut = bytearray(buf)
+        mut[i] = 1  # index 1 >= n_peers(1): must be rejected
+        with pytest.raises(ValueError):
+            explode_map_payload(bytes(mut))
+
+    def test_overlong_utf8_rejected(self):
+        """Overlong/invalid UTF-8 in an insert-text op must fail decode,
+        not silently produce wrong codepoints."""
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "ABCDEF")
+        payload = bytearray(_payload(doc))
+        cid = t.id
+        idx = bytes(payload).find(b"ABCDEF")
+        assert idx >= 0
+        # overlong encoding of 'A' (0xC1 0x81 is always invalid UTF-8)
+        payload[idx] = 0xC1
+        payload[idx + 1] = 0x81
+        with pytest.raises(ValueError):
+            extract_seq_from_payload(bytes(payload), cid)
+        # bare continuation byte
+        payload2 = bytearray(_payload(doc))
+        payload2[idx] = 0x80
+        with pytest.raises(ValueError):
+            extract_seq_from_payload(bytes(payload2), cid)
+        # truncated 2-byte sequence: lead byte followed by ASCII
+        payload3 = bytearray(_payload(doc))
+        payload3[idx] = 0xC3
+        # next byte 'B' (0x42) lacks the 0x80 continuation prefix
+        with pytest.raises(ValueError):
+            extract_seq_from_payload(bytes(payload3), cid)
+
     def test_speed_vs_python(self):
         import time
 

@@ -88,3 +88,98 @@ def automerge_final_text(limit: Optional[int] = None) -> str:
     for pos, dels, ins in patches:
         s = s[:pos] + ins + s[pos + dels :]
     return s
+
+
+VARIANT_CACHE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", ".bench_cache_variants"
+)
+
+
+def concurrent_trace_variants(
+    n_variants: int = 8,
+    n_peers: int = 4,
+    sync_every: int = 4000,
+    limit: Optional[int] = None,
+    use_cache: bool = True,
+):
+    """Genuinely-concurrent multi-peer variants of the automerge trace.
+
+    Each variant routes the patch stream across `n_peers` replicas in
+    randomized windows (editing sessions interleave at window
+    granularity — this preserves the trace's typing runs while creating
+    real concurrency), syncing all replicas every `sync_every` patches
+    and fully at the end.  Every variant is a distinct document: the
+    concurrency windows, peer ids, and resulting Fugue trees differ per
+    variant seed.
+
+    Returns a list of dicts per variant:
+      payload: envelope-stripped update bytes (full history, all peers)
+      extract: SeqExtract ((peer, counter)-sorted element table)
+      text:    the converged document text (host-engine oracle)
+
+    Results cache to disk — generation replays the trace through the
+    host engine n_variants times (the one-time "source replica" cost).
+    """
+    import pickle
+    import random
+
+    from .doc import LoroDoc
+    from .ops.columnar import SeqExtract, extract_seq_container
+
+    tag = f"v{n_variants}_p{n_peers}_s{sync_every}_l{limit or 'full'}"
+    cache = os.path.join(VARIANT_CACHE_DIR, tag + ".pkl") if use_cache else None
+    if cache and os.path.exists(cache):
+        with open(cache, "rb") as f:
+            return pickle.load(f)
+
+    patches, _ = load_automerge_patches(limit=limit)
+    out = []
+    for v in range(n_variants):
+        rng = random.Random(0xBE5C + v)
+        docs = [LoroDoc(peer=((v + 1) << 8) + i + 1) for i in range(n_peers)]
+        texts = [d.get_text("text") for d in docs]
+
+        def sync_all():
+            for d in docs[1:]:
+                docs[0].import_(d.export_updates(docs[0].oplog_vv()))
+            for d in docs[1:]:
+                d.import_(docs[0].export_updates(d.oplog_vv()))
+
+        cur = 0
+        window_left = 0
+        for i, (pos, dels, ins) in enumerate(patches):
+            if window_left == 0:
+                cur = rng.randrange(n_peers)
+                window_left = rng.randint(32, 256)
+            window_left -= 1
+            t = texts[cur]
+            L = len(t)
+            p = min(pos, L)
+            if dels:
+                d = min(dels, L - p)
+                if d:
+                    t.delete(p, d)
+            if ins:
+                t.insert(p, ins)
+            if (i + 1) % sync_every == 0:
+                sync_all()
+        sync_all()
+        sync_all()  # second round so every replica converges
+        ref = docs[0]
+        text = texts[0].to_string()
+        for d, t in zip(docs[1:], texts[1:]):
+            assert t.to_string() == text, "variant replicas failed to converge"
+        from .doc import strip_envelope
+
+        payload = strip_envelope(ref.export_updates())
+        ex = extract_seq_container(ref.oplog.changes_in_causal_order(), texts[0].id)
+        out.append({"payload": payload, "extract": ex, "text": text})
+        del docs, texts
+
+    if cache:
+        os.makedirs(VARIANT_CACHE_DIR, exist_ok=True)
+        tmp = cache + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(out, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, cache)
+    return out

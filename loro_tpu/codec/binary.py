@@ -93,6 +93,9 @@ class Writer:
     def u64le(self, v: int) -> None:
         self.buf += struct.pack("<Q", v)
 
+    def u32le(self, v: int) -> None:
+        self.buf += struct.pack("<I", v)
+
     def f64(self, v: float) -> None:
         self.buf += struct.pack("<d", v)
 
@@ -136,6 +139,11 @@ class Reader:
     def u64le(self) -> int:
         v = struct.unpack_from("<Q", self.buf, self.i)[0]
         self.i += 8
+        return v
+
+    def u32le(self) -> int:
+        v = struct.unpack_from("<I", self.buf, self.i)[0]
+        self.i += 4
         return v
 
     def f64(self) -> float:

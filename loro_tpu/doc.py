@@ -43,7 +43,10 @@ from .txn import Transaction
 from .utils import tracing
 
 MAGIC = b"LTPU"
-FORMAT_VERSION = 1
+# v2: snapshot history section became BlockStore blocks; snapshot state
+# sections zlib-compressed (change_store.py)
+FORMAT_VERSION = 2
+ENVELOPE_LEN = 10  # MAGIC + version + mode + crc32
 
 
 class EncodeMode(Enum):
@@ -91,12 +94,7 @@ class ImportStatus:
     pending: Optional[VersionRange]
 
 
-class LoroError(Exception):
-    pass
-
-
-class DecodeError(LoroError):
-    pass
+from .errors import DecodeError, LoroError  # noqa: E402  (re-export; defined in errors.py to avoid import cycles)
 
 
 class LoroDoc:
@@ -302,8 +300,12 @@ class LoroDoc:
         else:
             head_state = self._state_at(self.oplog.frontiers)
         w = Writer()
-        oplog_bytes = bcodec.encode_changes(self.oplog.changes_in_causal_order())
-        state_bytes = scodec.encode_doc_state(head_state, head_state.parents)
+        # history ships as compressed change blocks (clean cold peers
+        # pass through without decode or re-encode — change_store.py)
+        oplog_bytes = self.oplog.export_block_store().encode()
+        state_bytes = zlib.compress(
+            scodec.encode_doc_state(head_state, head_state.parents), 6
+        )
         w.bytes_(oplog_bytes)
         w.bytes_(state_bytes)
         # shallow-root carry-over so a fast snapshot of a shallow doc
@@ -339,7 +341,9 @@ class LoroDoc:
             base_state = self.state  # export() committed; live state reusable
         else:
             base_state = self._state_at(frontiers)
-        state_bytes = scodec.encode_doc_state(base_state, base_state.parents)
+        state_bytes = zlib.compress(
+            scodec.encode_doc_state(base_state, base_state.parents), 6
+        )
         w = Writer()
         w.bytes_(state_bytes)
         w.str_(_json.dumps(base_vv.to_json()))
@@ -432,19 +436,32 @@ class LoroDoc:
         return ImportStatus(success, pending)
 
     def _parse_envelope(self, data: bytes) -> Tuple[EncodeMode, bytes]:
-        if len(data) < 10 or data[:4] != MAGIC:
+        if len(data) < ENVELOPE_LEN or data[:4] != MAGIC:
             raise DecodeError("bad magic")
         version, mode_b = data[4], data[5]
         if version > FORMAT_VERSION:
             raise DecodeError(f"unsupported format version {version}")
         crc = int.from_bytes(data[6:10], "little")
-        payload = data[10:]
+        payload = data[ENVELOPE_LEN:]
         if zlib.crc32(payload) != crc:
             raise DecodeError("checksum mismatch")
         try:
-            return EncodeMode(mode_b), payload
+            mode = EncodeMode(mode_b)
         except ValueError as e:
             raise DecodeError(f"unknown encode mode {mode_b}") from e
+        # v1 snapshot layouts (pre-BlockStore, uncompressed state) are
+        # not decodable by this version — fail with a version error,
+        # not a confusing zlib/malformed one.  Update payloads are
+        # layout-identical across v1/v2.
+        if version < 2 and mode in (
+            EncodeMode.FastSnapshot,
+            EncodeMode.ShallowSnapshot,
+            EncodeMode.StateOnly,
+        ):
+            raise DecodeError(
+                f"snapshot was written by format v{version}; this build reads v2+"
+            )
+        return mode, payload
 
     def _decode_changes(self, mode: EncodeMode, payload: bytes) -> List[Change]:
         if mode in (EncodeMode.JsonUpdates, EncodeMode.JsonSnapshot):
@@ -471,10 +488,12 @@ class LoroDoc:
 
         import json as _json
 
+        from .oplog.change_store import BlockStore
+
         try:
             r = Reader(payload)
             oplog_bytes = r.bytes_()
-            state_bytes = r.bytes_()
+            state_bytes = zlib.decompress(r.bytes_())
             has_base = bool(r.u8())
             base = None
             if has_base:
@@ -482,7 +501,7 @@ class LoroDoc:
                 bvv = VersionVector.from_json(_json.loads(r.str_()))
                 bf = Frontiers.from_json(_json.loads(r.str_()))
                 base = (bb, bvv, bf)
-            changes = bcodec.decode_changes(oplog_bytes)
+            store = BlockStore.decode(oplog_bytes)
         except DecodeError:
             raise
         except Exception as e:
@@ -493,23 +512,33 @@ class LoroDoc:
                 raise LoroError(
                     "snapshot carries a shallow base; import it into an empty doc"
                 )
+            changes = [
+                ch for p in store.peers() for ch in store.changes_for_peer(p)
+            ]
             return self._import_changes(changes, origin)
         if base is not None:
             self._install_shallow_base(*base)
-        applied, pending = self.oplog.import_changes(changes)
         try:
             states, parents = scodec.decode_doc_state(state_bytes)
         except Exception as e:
             raise DecodeError(f"malformed snapshot state: {e}") from e
+        # lazy attach: dag/vv come from block metas; op payloads decode
+        # per peer only when replay/diff/export actually needs them
+        self.oplog.attach_cold_store(store)
         self.state.states = states
         self.state.parents.update(parents)
         self.state.vv = self.oplog.vv.copy()
         self.state.frontiers = self.oplog.frontiers
         self._emit_state_install_event(origin)
         status = VersionRange()
-        for ch in applied:
-            status.extend_to_include(ch.id_span())
-        return ImportStatus(status, pending if not pending.is_empty() else None)
+        for peer in self.oplog.vv:
+            lo = 0
+            if base is not None:
+                lo = base[1].get(peer)
+            hi = self.oplog.vv.get(peer)
+            if hi > lo:
+                status.extend_to_include(IdSpan(peer, lo, hi))
+        return ImportStatus(status, None)
 
     def _emit_state_install_event(self, origin: str) -> None:
         """Subscribers registered before a snapshot import still need to
@@ -546,7 +575,7 @@ class LoroDoc:
             raise DecodeError(f"malformed shallow snapshot: {e}") from e
         self._install_shallow_base(state_bytes, base_vv, base_f)
         try:
-            states, parents = scodec.decode_doc_state(state_bytes)
+            states, parents = _decode_state_z(state_bytes)
         except Exception as e:
             raise DecodeError(f"malformed snapshot state: {e}") from e
         self.state.states = states
@@ -705,7 +734,7 @@ class LoroDoc:
             base_bytes, base_vv, _ = self._shallow_base
             if not (base_vv <= vv):
                 raise LoroError("cannot materialize a version below the shallow root")
-            states, parents = scodec.decode_doc_state(base_bytes)
+            states, parents = _decode_state_z(base_bytes)
             st.states = states
             st.parents.update(parents)
             from_vv = base_vv
@@ -1133,3 +1162,25 @@ def _tree_value_diff(old_nodes: List[dict], new_nodes: List[dict]) -> TreeDiff:
         if nid not in new_by:
             d.items.append(TreeDiffItem(TreeID.parse(nid), TreeDiffAction.Delete))
     return d
+
+
+def strip_envelope(blob: bytes) -> bytes:
+    """Validate the LTPU envelope and return the bare payload (the form
+    the native SoA decoder and device-batch ingest paths consume)."""
+    if len(blob) < ENVELOPE_LEN or blob[:4] != MAGIC:
+        raise DecodeError("bad magic")
+    payload = blob[ENVELOPE_LEN:]
+    if zlib.crc32(payload) != int.from_bytes(blob[6:10], "little"):
+        raise DecodeError("checksum mismatch")
+    return payload
+
+
+def _decode_state_z(state_bytes: bytes):
+    """Decode a (zlib-compressed) doc-state section.  All shallow-base
+    and snapshot state sections ship compressed (reference compresses
+    change blocks with LZ4; we extend the same treatment to state)."""
+    import zlib as _z
+
+    from .codec import snapshot as scodec
+
+    return scodec.decode_doc_state(_z.decompress(state_bytes))

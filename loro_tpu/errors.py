@@ -1,0 +1,9 @@
+"""Framework exception types (importable without doc.py's import graph)."""
+
+
+class LoroError(Exception):
+    pass
+
+
+class DecodeError(LoroError):
+    pass

@@ -58,6 +58,47 @@ class OpLog:
         # governs the local-commit RLE-merge window (reference:
         # configure.rs merge_interval)
         self.config = None
+        # block-chunked cold history (attached on fast-snapshot import;
+        # reference: change_store.rs lazy blocks).  Peers hydrate into
+        # self.changes on first op access; dag/vv come from block metas.
+        self.cold = None  # Optional[BlockStore]
+        self._cold_peers: set = set()
+        # peers whose in-memory history diverges from the cold blocks
+        # (snapshot export re-encodes these; clean peers reuse raw)
+        self._dirty_peers: set = set()
+
+    # -- cold store (lazy blocks) --------------------------------------
+    def attach_cold_store(self, store) -> None:
+        """Adopt a decoded BlockStore as this (empty) oplog's history:
+        register dag spans from block metas WITHOUT decoding any op
+        payload.  reference: fast_snapshot.rs installs oplog bytes
+        directly; change blocks parse lazily."""
+        assert not self.changes and self.cold is None, "attach requires empty oplog"
+        metas = sorted(store.iter_metas(), key=lambda m: (m[3], m[0], m[1]))
+        for peer, cs, ce, lam, deps in metas:
+            self.dag.add_node(peer, cs, ce, lam, tuple(deps))
+            lam_end = lam + (ce - cs)
+            if lam_end > self.next_lamport:
+                self.next_lamport = lam_end
+        self.cold = store
+        self._cold_peers = set(store.peers())
+
+    def _hydrate_peer(self, peer: PeerID) -> None:
+        if peer not in self._cold_peers:
+            return
+        self._cold_peers.discard(peer)
+        decoded = self.cold.changes_for_peer(peer)
+        hot = self.changes.get(peer, [])
+        assert not hot, "hot changes appeared before hydration"
+        self.changes[peer] = decoded
+        self._starts[peer] = [ch.ctr_start for ch in decoded]
+
+    def _hydrate_all(self) -> None:
+        for peer in list(self._cold_peers):
+            self._hydrate_peer(peer)
+
+    def _history_peers(self):
+        return set(self.changes) | self._cold_peers
 
     # -- queries ------------------------------------------------------
     @property
@@ -69,9 +110,10 @@ class OpLog:
         return self.dag.frontiers
 
     def is_empty(self) -> bool:
-        return not self.changes and len(self.pending) == 0
+        return not self.changes and not self._cold_peers and len(self.pending) == 0
 
     def change_at(self, id: ID) -> Optional[Change]:
+        self._hydrate_peer(id.peer)
         starts = self._starts.get(id.peer)
         if not starts:
             return None
@@ -85,7 +127,13 @@ class OpLog:
         return self.vv.total_ops()
 
     def total_changes(self) -> int:
-        return sum(len(v) for v in self.changes.values())
+        hot = sum(len(v) for v in self.changes.values())
+        cold = sum(
+            len(b.metas)
+            for p in self._cold_peers
+            for b in self.cold.blocks.get(p, [])
+        )
+        return hot + cold
 
     # -- local commit -------------------------------------------------
     def next_counter(self, peer: PeerID) -> Counter:
@@ -100,6 +148,8 @@ class OpLog:
         for d in change.deps:
             assert self.dag.contains(d), f"local change dep missing: {d}"
         interval = self.config.merge_interval_s if self.config is not None else 1000
+        self._hydrate_peer(change.peer)
+        self._dirty_peers.add(change.peer)
         lst = self.changes.get(change.peer)
         if lst and lst[-1].can_merge_right(change, interval):
             lst[-1].ops.extend(change.ops)
@@ -169,6 +219,8 @@ class OpLog:
         )
 
     def _insert_change(self, ch: Change) -> None:
+        self._hydrate_peer(ch.peer)
+        self._dirty_peers.add(ch.peer)
         self.changes.setdefault(ch.peer, []).append(ch)
         self._starts.setdefault(ch.peer, []).append(ch.ctr_start)
         self._register_span(ch)
@@ -178,8 +230,14 @@ class OpLog:
         """All changes (sliced) not included in `vv`, in causal order.
         reference: ChangeStore.export_blocks_from."""
         out: List[Change] = []
-        for peer, lst in self.changes.items():
+        for peer in list(self._history_peers()):
             start = vv.get(peer)
+            if start >= self.vv.get(peer):
+                continue  # fully known: no need to hydrate
+            self._hydrate_peer(peer)
+            lst = self.changes.get(peer, [])
+            if not lst:
+                continue
             i = bisect.bisect_right(self._starts[peer], start) - 1
             i = max(i, 0)
             for ch in lst[i:]:
@@ -196,10 +254,14 @@ class OpLog:
         """Changes (sliced) with counters in [from_vv, to_vv) per peer, in
         causal order.  `to_vv` must be causally closed (a valid version)."""
         out: List[Change] = []
-        for peer, lst in self.changes.items():
+        for peer in list(self._history_peers()):
             lo = from_vv.get(peer)
             hi = to_vv.get(peer)
             if hi <= lo:
+                continue  # cold peers outside the range stay cold
+            self._hydrate_peer(peer)
+            lst = self.changes.get(peer, [])
+            if not lst:
                 continue
             i = bisect.bisect_right(self._starts[peer], lo) - 1
             i = max(i, 0)
@@ -217,6 +279,7 @@ class OpLog:
         return out
 
     def changes_in_causal_order(self) -> List[Change]:
+        self._hydrate_all()
         out = [ch for lst in self.changes.values() for ch in lst]
         out.sort(key=lambda c: (c.lamport, c.peer, c.ctr_start))
         return out
@@ -228,8 +291,30 @@ class OpLog:
             for op in ch.ops:
                 yield ch, op
 
+    def export_block_store(self):
+        """Sealed blocks covering the full history.  Peers untouched
+        since cold-attach reuse their raw compressed blocks (no decode,
+        no re-encode); dirty/hot peers seal fresh blocks."""
+        from .change_store import BlockStore, blocks_from_changes
+
+        st = BlockStore()
+        for peer in self._history_peers():
+            if (
+                self.cold is not None
+                and peer in self.cold.blocks
+                and peer not in self._dirty_peers
+            ):
+                st.blocks[peer] = self.cold.blocks[peer]
+            else:
+                self._hydrate_peer(peer)
+                chs = self.changes.get(peer, [])
+                if chs:
+                    st.blocks[peer] = blocks_from_changes(chs)
+        return st
+
     def diagnose_size(self) -> Dict[str, int]:
         """reference: oplog.rs:675 diagnose_size."""
+        self._hydrate_all()
         return {
             "changes": self.total_changes(),
             "ops": sum(len(c.ops) for lst in self.changes.values() for c in lst),

@@ -245,6 +245,7 @@ def main() -> None:
     # would need 10k host-engine replays; cycling distinct traces keeps
     # every launch heterogeneous while setup stays bounded.
     extracts = [ex0] + [v["extract"] for v in variants]
+    per_doc_ops = [n_ops] + [v["n_ops"] for v in variants]
     pad_n = pad_bucket(max(e.n for e in extracts))
     pad_c = pad_bucket(max(contract_chains(e).n_chains for e in extracts))
     per_doc_cols = [chain_columns(e, pad_n=pad_n, pad_c=pad_c) for e in extracts]
@@ -254,8 +255,11 @@ def main() -> None:
     n_distinct = len(per_doc_cols)
     n_batches = max(1, -(-n_distinct // chunk))
     batches = []
+    batch_ops = []
     for b in range(n_batches):
-        docs = [per_doc_cols[(b * chunk + j) % n_distinct] for j in range(chunk)]
+        idxs = [(b * chunk + j) % n_distinct for j in range(chunk)]
+        docs = [per_doc_cols[i] for i in idxs]
+        batch_ops.append(sum(per_doc_ops[i] for i in idxs))
         batched = ChainColumns(
             *[np.stack([getattr(c, f) for c in docs]) for f in ChainColumns._fields]
         )
@@ -299,12 +303,14 @@ def main() -> None:
         )
     t0 = time.perf_counter()
     out = None
+    ops_done = 0
     for i in range(n_chunks):
         out = chain_merge_docs_checksum(batches[i % n_batches])
+        ops_done += batch_ops[i % n_batches]
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     docs_done = n_chunks * chunk
-    kernel_ops_s = docs_done * n_ops / dt
+    kernel_ops_s = ops_done / dt
 
     # ---- (b) end-to-end number: payload bytes -> native decode ->
     # chain-contract -> upload -> merge, per chunk (the full server-side
@@ -321,7 +327,7 @@ def main() -> None:
         from loro_tpu.core.ids import ContainerID, ContainerType
 
         cid = ContainerID.root("text", ContainerType.Text)
-        payloads = [v["payload"] for v in variants]
+        payloads = [(v["payload"], v["n_ops"]) for v in variants]
         e2e_done = 0
         e2e_ops = 0
         t0 = time.perf_counter()
@@ -329,10 +335,10 @@ def main() -> None:
         while e2e_done < e2e_docs_req and (time.perf_counter() - t0) < e2e_budget_s:
             docs = []
             for j in range(chunk):
-                p = payloads[(e2e_done + j) % len(payloads)]
+                p, p_ops = payloads[(e2e_done + j) % len(payloads)]
                 exd = extract_seq_from_payload(p, cid)
                 docs.append(chain_columns(exd, pad_n=pad_n, pad_c=pad_c))
-                e2e_ops += exd.n
+                e2e_ops += p_ops
             batched = ChainColumns(
                 *[np.stack([getattr(c, f) for c in docs]) for f in ChainColumns._fields]
             )

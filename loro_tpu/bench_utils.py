@@ -126,7 +126,7 @@ def concurrent_trace_variants(
     from .doc import LoroDoc
     from .ops.columnar import SeqExtract, extract_seq_container
 
-    tag = f"v{n_variants}_p{n_peers}_s{sync_every}_l{limit or 'full'}"
+    tag = f"v{n_variants}_p{n_peers}_s{sync_every}_l{limit or 'full'}_n2"
     cache = os.path.join(VARIANT_CACHE_DIR, tag + ".pkl") if use_cache else None
     if cache and os.path.exists(cache):
         with open(cache, "rb") as f:
@@ -147,6 +147,7 @@ def concurrent_trace_variants(
 
         cur = 0
         window_left = 0
+        n_applied = 0  # trace events actually applied (clamped deletes drop)
         for i, (pos, dels, ins) in enumerate(patches):
             if window_left == 0:
                 cur = rng.randrange(n_peers)
@@ -155,12 +156,17 @@ def concurrent_trace_variants(
             t = texts[cur]
             L = len(t)
             p = min(pos, L)
+            applied = False
             if dels:
                 d = min(dels, L - p)
                 if d:
                     t.delete(p, d)
+                    applied = True
             if ins:
                 t.insert(p, ins)
+                applied = True
+            if applied:  # same unit as the pristine n_ops: patch events
+                n_applied += 1
             if (i + 1) % sync_every == 0:
                 sync_all()
         sync_all()
@@ -173,7 +179,7 @@ def concurrent_trace_variants(
 
         payload = strip_envelope(ref.export_updates())
         ex = extract_seq_container(ref.oplog.changes_in_causal_order(), texts[0].id)
-        out.append({"payload": payload, "extract": ex, "text": text})
+        out.append({"payload": payload, "extract": ex, "text": text, "n_ops": n_applied})
         del docs, texts
 
     if cache:

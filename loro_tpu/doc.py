@@ -44,9 +44,21 @@ from .utils import tracing
 
 MAGIC = b"LTPU"
 # v2: snapshot history section became BlockStore blocks; snapshot state
-# sections zlib-compressed (change_store.py)
+# sections zlib-compressed (change_store.py).  Update payloads are
+# layout-identical across v1/v2, so update blobs are stamped with the
+# lowest version that can read them (mixed-version interop).
 FORMAT_VERSION = 2
 ENVELOPE_LEN = 10  # MAGIC + version + mode + crc32
+
+
+def _min_version_for_mode(mode: "EncodeMode") -> int:
+    if mode in (
+        EncodeMode.FastSnapshot,
+        EncodeMode.ShallowSnapshot,
+        EncodeMode.StateOnly,
+    ):
+        return 2
+    return 1
 
 
 class EncodeMode(Enum):
@@ -111,6 +123,9 @@ class LoroDoc:
         # (reference: GcStore, container_store.rs:58) — replay floor for
         # checkout/diff on shallow docs
         self._shallow_base: Optional[Tuple[bytes, VersionVector, Frontiers]] = None
+        from .history_cache import StateCheckpointCache
+
+        self._state_cache = StateCheckpointCache()
         self._local_update_subs: List[Callable[[bytes], None]] = []
         self._peer_id_change_subs: List[Callable[[PeerID], None]] = []
         self._pre_commit_subs: List[Callable[["Transaction"], None]] = []
@@ -320,7 +335,12 @@ class LoroDoc:
             w.u8(0)
         payload = bytes(w.buf)
         crc = zlib.crc32(payload)
-        return MAGIC + bytes([FORMAT_VERSION, EncodeMode.FastSnapshot.value]) + crc.to_bytes(4, "little") + payload
+        return (
+            MAGIC
+            + bytes([_min_version_for_mode(EncodeMode.FastSnapshot), EncodeMode.FastSnapshot.value])
+            + crc.to_bytes(4, "little")
+            + payload
+        )
 
     def _export_shallow(
         self, frontiers: Frontiers, with_updates: bool, to_f: Optional[Frontiers] = None
@@ -357,7 +377,7 @@ class LoroDoc:
         payload = bytes(w.buf)
         crc = zlib.crc32(payload)
         mode = EncodeMode.ShallowSnapshot if with_updates else EncodeMode.StateOnly
-        return MAGIC + bytes([FORMAT_VERSION, mode.value]) + crc.to_bytes(4, "little") + payload
+        return MAGIC + bytes([_min_version_for_mode(mode), mode.value]) + crc.to_bytes(4, "little") + payload
 
     def export_snapshot(self) -> bytes:
         return self.export(ExportMode.Snapshot)
@@ -379,7 +399,7 @@ class LoroDoc:
                 )
             )
         crc = zlib.crc32(payload)
-        header = MAGIC + bytes([FORMAT_VERSION, mode.value]) + crc.to_bytes(4, "little")
+        header = MAGIC + bytes([_min_version_for_mode(mode), mode.value]) + crc.to_bytes(4, "little")
         return header + payload
 
     def import_(self, data: bytes, origin: str = "import") -> ImportStatus:
@@ -436,31 +456,7 @@ class LoroDoc:
         return ImportStatus(success, pending)
 
     def _parse_envelope(self, data: bytes) -> Tuple[EncodeMode, bytes]:
-        if len(data) < ENVELOPE_LEN or data[:4] != MAGIC:
-            raise DecodeError("bad magic")
-        version, mode_b = data[4], data[5]
-        if version > FORMAT_VERSION:
-            raise DecodeError(f"unsupported format version {version}")
-        crc = int.from_bytes(data[6:10], "little")
-        payload = data[ENVELOPE_LEN:]
-        if zlib.crc32(payload) != crc:
-            raise DecodeError("checksum mismatch")
-        try:
-            mode = EncodeMode(mode_b)
-        except ValueError as e:
-            raise DecodeError(f"unknown encode mode {mode_b}") from e
-        # v1 snapshot layouts (pre-BlockStore, uncompressed state) are
-        # not decodable by this version — fail with a version error,
-        # not a confusing zlib/malformed one.  Update payloads are
-        # layout-identical across v1/v2.
-        if version < 2 and mode in (
-            EncodeMode.FastSnapshot,
-            EncodeMode.ShallowSnapshot,
-            EncodeMode.StateOnly,
-        ):
-            raise DecodeError(
-                f"snapshot was written by format v{version}; this build reads v2+"
-            )
+        _version, mode, payload = parse_envelope_header(data)
         return mode, payload
 
     def _decode_changes(self, mode: EncodeMode, payload: bytes) -> List[Change]:
@@ -723,24 +719,51 @@ class LoroDoc:
 
     def _state_at_vv(self, vv: VersionVector, frontiers: Optional[Frontiers] = None) -> DocState:
         """Materialize a throwaway DocState at an arbitrary version by
-        causal replay (the reference reaches the same states via its
-        persistent Checkout DiffCalculator).  Shallow docs replay from
-        the frozen base state, never below it."""
-        st = DocState()
-        from_vv = VersionVector()
+        causal replay from the nearest floor: a cached checkpoint
+        (history_cache.py — the reference's history_cache.rs analog),
+        the frozen shallow base, or empty.  Shallow docs never replay
+        below the base."""
         if self._shallow_base is not None:
-            from .codec import snapshot as scodec
-
-            base_bytes, base_vv, _ = self._shallow_base
+            base_vv = self._shallow_base[1]
             if not (base_vv <= vv):
                 raise LoroError("cannot materialize a version below the shallow root")
-            states, parents = _decode_state_z(base_bytes)
-            st.states = states
-            st.parents.update(parents)
-            from_vv = base_vv
-        st.apply_changes(self.oplog.changes_between(from_vv, vv), record=False)
-        st.vv = vv
+        cached = self._state_cache.best_floor(vv)
+        if cached is not None:
+            st, from_vv, _f = cached
+        else:
+            st = DocState()
+            from_vv = VersionVector()
+            if self._shallow_base is not None:
+                base_bytes, base_vv, _ = self._shallow_base
+                states, parents = _decode_state_z(base_bytes)
+                st.states = states
+                st.parents.update(parents)
+                from_vv = base_vv
+        chs = self.oplog.changes_between(from_vv, vv)
+        m = len(chs)
+        if m > 32:
+            # long cold replay: drop a checkpoint ladder at halving gaps
+            # approaching the target, so *receding* time travel (undo's
+            # access pattern walks backwards step by step) always finds
+            # a nearby floor on the next call
+            marks = sorted({m - (m >> i) for i in range(1, 6) if (m >> i) >= 8})
+            cur_vv = from_vv.copy()
+            done = 0
+            for mk in marks:
+                st.apply_changes(chs[done:mk], record=False)
+                for ch in chs[done:mk]:
+                    if ch.ctr_end > cur_vv.get(ch.peer):
+                        cur_vv.set_end(ch.peer, ch.ctr_end)
+                done = mk
+                self._state_cache.put(
+                    cur_vv, self.oplog.dag.vv_to_frontiers(cur_vv), st
+                )
+            st.apply_changes(chs[done:], record=False)
+        else:
+            st.apply_changes(chs, record=False)
+        st.vv = vv.copy()
         st.frontiers = frontiers if frontiers is not None else self.oplog.dag.vv_to_frontiers(vv)
+        self._state_cache.put(st.vv, st.frontiers, st)
         return st
 
     def diff(self, a: Frontiers, b: Frontiers) -> Dict[ContainerID, Any]:
@@ -1164,15 +1187,37 @@ def _tree_value_diff(old_nodes: List[dict], new_nodes: List[dict]) -> TreeDiff:
     return d
 
 
+def parse_envelope_header(data: bytes) -> Tuple[int, "EncodeMode", bytes]:
+    """The single LTPU envelope validator: magic, version gate, mode,
+    crc.  Returns (version, mode, payload)."""
+    if len(data) < ENVELOPE_LEN or data[:4] != MAGIC:
+        raise DecodeError("bad magic")
+    version, mode_b = data[4], data[5]
+    if version > FORMAT_VERSION:
+        raise DecodeError(f"unsupported format version {version}")
+    crc = int.from_bytes(data[6:10], "little")
+    payload = data[ENVELOPE_LEN:]
+    if zlib.crc32(payload) != crc:
+        raise DecodeError("checksum mismatch")
+    try:
+        mode = EncodeMode(mode_b)
+    except ValueError as e:
+        raise DecodeError(f"unknown encode mode {mode_b}") from e
+    # v1 snapshot layouts (pre-BlockStore, uncompressed state) are not
+    # decodable by this version — fail with a version error, not a
+    # confusing zlib/malformed one.
+    if version < _min_version_for_mode(mode):
+        raise DecodeError(
+            f"{mode.name} blob written by format v{version}; this build reads "
+            f"v{_min_version_for_mode(mode)}+"
+        )
+    return version, mode, payload
+
+
 def strip_envelope(blob: bytes) -> bytes:
     """Validate the LTPU envelope and return the bare payload (the form
     the native SoA decoder and device-batch ingest paths consume)."""
-    if len(blob) < ENVELOPE_LEN or blob[:4] != MAGIC:
-        raise DecodeError("bad magic")
-    payload = blob[ENVELOPE_LEN:]
-    if zlib.crc32(payload) != int.from_bytes(blob[6:10], "little"):
-        raise DecodeError("checksum mismatch")
-    return payload
+    return parse_envelope_header(blob)[2]
 
 
 def _decode_state_z(state_bytes: bytes):

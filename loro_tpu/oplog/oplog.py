@@ -73,8 +73,32 @@ class OpLog:
         register dag spans from block metas WITHOUT decoding any op
         payload.  reference: fast_snapshot.rs installs oplog bytes
         directly; change blocks parse lazily."""
+        from ..errors import DecodeError
+
         assert not self.changes and self.cold is None, "attach requires empty oplog"
         metas = sorted(store.iter_metas(), key=lambda m: (m[3], m[0], m[1]))
+        # dep-closure check before touching the dag: every dep must be
+        # covered by the blocks themselves or the shallow floor (the
+        # replaced import_changes path parked dep-missing changes; a
+        # snapshot with dangling deps is malformed, not pending)
+        full_vv = self.dag.shallow_since_vv.copy()
+        for peer, cs, ce, _lam, _deps in metas:
+            if ce > full_vv.get(peer):
+                full_vv.set_end(peer, ce)
+        for peer, cs, ce, _lam, deps in metas:
+            for d in deps:
+                if d.counter >= full_vv.get(d.peer):
+                    raise DecodeError(
+                        f"snapshot change (peer={peer}, ctr={cs}) depends on "
+                        f"{d} which no block covers"
+                    )
+        for peer in store.peers():
+            first = store.blocks[peer][0].ctr_start
+            floor = self.dag.shallow_since_vv.get(peer)
+            if first != floor:
+                raise DecodeError(
+                    f"peer {peer} history starts at {first}, expected {floor}"
+                )
         for peer, cs, ce, lam, deps in metas:
             self.dag.add_node(peer, cs, ce, lam, tuple(deps))
             lam_end = lam + (ce - cs)

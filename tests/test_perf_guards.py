@@ -69,3 +69,70 @@ def test_checkout_bounded():
     doc.checkout_to_latest()
     dt = time.perf_counter() - t0
     assert dt < 2.0, f"checkout round-trip took {dt:.2f}s"
+
+
+def _count_replayed(doc):
+    """Wrap oplog.changes_between to record how many changes each
+    state materialization replays (deterministic, not timing-based)."""
+    counts = []
+    orig = doc.oplog.changes_between
+
+    def wrapper(a, b):
+        out = orig(a, b)
+        counts.append(len(out))
+        return out
+
+    doc.oplog.changes_between = wrapper
+    return counts
+
+
+def test_recheckout_sublinear():
+    """History cache (history_cache.py): after one retreat, further
+    checkouts in the same region replay only the delta between
+    versions, not history-from-floor (reference: history_cache.rs)."""
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("t")
+    fs = []
+    n = 400
+    for i in range(n):
+        t.insert(len(t), "word ")
+        doc.commit(message=f"c{i}")  # distinct messages: no RLE merge
+        fs.append(doc.oplog_frontiers())
+    counts = _count_replayed(doc)
+    doc.checkout(fs[200])  # cold retreat: replays ~200 changes
+    cold = sum(counts)
+    assert cold >= 150, f"expected a full replay on first retreat, got {cold}"
+    counts.clear()
+    doc.checkout(fs[210])  # warm: nearest checkpoint is fs[200]
+    warm = sum(counts)
+    assert warm <= 15, f"re-checkout replayed {warm} changes (want O(delta))"
+    counts.clear()
+    doc.checkout(fs[205])  # retreat within the cached region
+    warm2 = sum(counts)
+    assert warm2 <= 15, f"retreat near checkpoint replayed {warm2} changes"
+    doc.checkout_to_latest()
+    assert t.to_string().count("word") == n
+
+
+def test_undo_deep_history_soak():
+    """Undo on a doc with deep history must not replay from the floor
+    on every step (each inverse diff uses the checkpoint cache)."""
+    from loro_tpu.undo import UndoManager
+
+    doc = LoroDoc(peer=1)
+    um = UndoManager(doc)
+    t = doc.get_text("t")
+    n = 300
+    for i in range(n):
+        t.insert(len(t), f"w{i} ")
+        doc.commit(message=f"c{i}")
+    counts = _count_replayed(doc)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        assert um.undo()
+    dt = time.perf_counter() - t0
+    # one cold replay (~n) plus small ladder-gap replays per undo —
+    # far below the 20 undos x n changes the floor-replay design cost
+    assert sum(counts) < 3 * n, f"undo soak replayed {sum(counts)} changes"
+    assert dt < 5.0, f"20 undos on deep history took {dt:.2f}s"
+    assert t.to_string().count("w") == n - 20

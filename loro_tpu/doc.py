@@ -692,7 +692,7 @@ class LoroDoc:
             # detached mode
             self.set_peer_id(random.getrandbits(63))
         if record:
-            diffs = self._value_level_diffs(old_values, skip_seq=True)
+            diffs = self._value_level_diffs(old_values)
             for cid, d in self._seq_diff_batch(cur_vv, target_vv, (self.state, pre_state)).items():
                 diffs[cid] = [d]
             if diffs:
@@ -702,12 +702,12 @@ class LoroDoc:
         return {cid: st.get_value() for cid, st in self.state.states.items()}
 
     def _value_level_diffs(
-        self, old_values: Dict[ContainerID, Any], skip_seq: bool = False
+        self, old_values: Dict[ContainerID, Any]
     ) -> Dict[ContainerID, List]:
-        """Value-level diffs (exact for map/counter/tree; sequences are
-        handled by _seq_diff_batch when skip_seq)."""
+        """Value-level diffs for map/counter; identity-bearing
+        containers (sequences + tree) are handled by _seq_diff_batch."""
         new_values = self._container_values()
-        batch = _diff_values(old_values, new_values, self.state, skip_seq=skip_seq)
+        batch = _diff_values(old_values, new_values, self.state)
         return {cid: [d] for cid, d in batch.items()}
 
     # ------------------------------------------------------------------
@@ -777,7 +777,7 @@ class LoroDoc:
         vb = self.oplog.dag.frontiers_to_vv(b)
         sa = self.state if a == self.state.frontiers else self._state_at(a)
         sb = self.state if b == self.state.frontiers else self._state_at(b)
-        batch = _state_diff(sa, sb, skip_seq=True)
+        batch = _state_diff(sa, sb)
         batch.update(self._seq_diff_batch(va, vb, (self.state, sb, sa)))
         return batch
 
@@ -794,7 +794,9 @@ class LoroDoc:
             u_state = self._state_at_vv(union)
         out: Dict[ContainerID, Any] = {}
         for cid, st in u_state.states.items():
-            if cid.ctype == ContainerType.MovableList:
+            if cid.ctype == ContainerType.Tree:
+                d = st.delta_between(va, vb)
+            elif cid.ctype == ContainerType.MovableList:
                 d = st.delta_between(va, vb)
             elif cid.ctype == ContainerType.Text:
                 # style-aware when the container ever carried anchors
@@ -1080,57 +1082,30 @@ class LoroDoc:
         return len(self.state.states)
 
 
-def _state_diff(sa: DocState, sb: DocState, skip_seq: bool = False) -> Dict[ContainerID, Any]:
-    """Value-level DiffBatch turning sa's values into sb's."""
+def _state_diff(sa: DocState, sb: DocState) -> Dict[ContainerID, Any]:
+    """Value-level DiffBatch turning sa's values into sb's (map/counter
+    only — identity containers come from _seq_diff_batch)."""
     va = {cid: st.get_value() for cid, st in sa.states.items()}
     vb = {cid: st.get_value() for cid, st in sb.states.items()}
-    return _diff_values(va, vb, sb, skip_seq=skip_seq)
-
-
-def _seq_delta(old, new, keys_a=None, keys_b=None, as_tuple=False) -> Delta:
-    """Minimal retain/insert/delete delta via difflib (shared by the
-    text and list branches of _diff_values)."""
-    import difflib
-
-    delta = Delta()
-    sm = difflib.SequenceMatcher(
-        a=keys_a if keys_a is not None else old,
-        b=keys_b if keys_b is not None else new,
-        autojunk=False,
-    )
-    for tag, i1, i2, j1, j2 in sm.get_opcodes():
-        if tag == "equal":
-            delta.retain(i2 - i1)
-        else:
-            if tag in ("replace", "delete"):
-                delta.delete(i2 - i1)
-            if tag in ("replace", "insert"):
-                delta.insert(tuple(new[j1:j2]) if as_tuple else new[j1:j2])
-    return delta.chop()
-
-
-def _list_delta(old_l: List[Any], new_l: List[Any]) -> Delta:
-    return _seq_delta(
-        old_l, new_l, keys_a=[repr(x) for x in old_l], keys_b=[repr(x) for x in new_l], as_tuple=True
-    )
+    return _diff_values(va, vb, sb)
 
 
 def _diff_values(
     va: Dict[ContainerID, Any],
     vb: Dict[ContainerID, Any],
     target_state: DocState,
-    skip_seq: bool = False,
 ) -> Dict[ContainerID, Any]:
     from .event import CounterDiff
 
     out: Dict[ContainerID, Any] = {}
     for cid in set(va) | set(vb):
-        if skip_seq and cid.ctype in (
+        if cid.ctype in (
             ContainerType.Text,
             ContainerType.List,
             ContainerType.MovableList,
+            ContainerType.Tree,
         ):
-            continue  # exact deltas computed separately (no difflib cost)
+            continue  # exact identity deltas computed separately
         old_v = va.get(cid)
         new_v = vb.get(cid)
         if old_v == new_v:
@@ -1149,42 +1124,7 @@ def _diff_values(
                 out[cid] = d
         elif cid.ctype == ContainerType.Counter:
             out[cid] = CounterDiff((new_v or 0.0) - (old_v or 0.0))
-        elif cid.ctype == ContainerType.Text:
-            delta = _seq_delta(old_v or "", new_v or "")
-            if not delta.is_empty():
-                out[cid] = delta
-        elif cid.ctype in (ContainerType.List, ContainerType.MovableList):
-            delta = _list_delta(old_v or [], new_v or [])
-            if not delta.is_empty():
-                out[cid] = delta
-        elif cid.ctype == ContainerType.Tree:
-            out[cid] = _tree_value_diff(old_v or [], new_v or [])
     return out
-
-
-def _tree_value_diff(old_nodes: List[dict], new_nodes: List[dict]) -> TreeDiff:
-    """Diff two tree value snapshots (flat node lists) into TreeDiff items
-    ordered parents-first."""
-    from .core.ids import TreeID
-    from .event import TreeDiffAction, TreeDiffItem
-
-    old_by = {n["id"]: n for n in old_nodes}
-    new_by = {n["id"]: n for n in new_nodes}
-    d = TreeDiff()
-    for nid, n in new_by.items():
-        t = TreeID.parse(nid)
-        parent = TreeID.parse(n["parent"]) if n["parent"] else None
-        pos = bytes.fromhex(n["fractional_index"]) if n.get("fractional_index") else None
-        if nid not in old_by:
-            d.items.append(TreeDiffItem(t, TreeDiffAction.Create, parent, n["index"], pos))
-        else:
-            o = old_by[nid]
-            if (o["parent"], o["fractional_index"]) != (n["parent"], n["fractional_index"]):
-                d.items.append(TreeDiffItem(t, TreeDiffAction.Move, parent, n["index"], pos))
-    for nid in old_by:
-        if nid not in new_by:
-            d.items.append(TreeDiffItem(TreeID.parse(nid), TreeDiffAction.Delete))
-    return d
 
 
 def parse_envelope_header(data: bytes) -> Tuple[int, "EncodeMode", bytes]:

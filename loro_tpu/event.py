@@ -337,6 +337,10 @@ class TreeDiffItem:
     parent: Optional[TreeID] = None  # None = root (for Create/Move)
     index: int = 0
     position: Optional[bytes] = None  # fractional index
+    # where the node came from, for Move/Delete consumers (reference:
+    # TreeExternalDiff::Move { old_parent, old_index })
+    old_parent: Optional[TreeID] = None
+    old_index: Optional[int] = None
 
 
 @dataclass

@@ -24,6 +24,74 @@ from .base import ContainerState
 TRASH = TreeID(0xFFFF_FFFF_FFFF_FFFF, -1)  # deleted-subtree sentinel parent
 
 
+# -- helpers over a bare node table (shared by live state + version
+#    reconstructions in delta_between) ---------------------------------
+def _deleted_in(nodes: Dict[TreeID, "TreeNode"], t: TreeID) -> bool:
+    cur: Optional[TreeID] = t
+    while cur is not None:
+        if cur == TRASH:
+            return True
+        node = nodes.get(cur)
+        if node is None:
+            return False
+        cur = node.parent
+    return False
+
+
+def _cycle_in(nodes: Dict[TreeID, "TreeNode"], target: TreeID, new_parent: TreeID) -> bool:
+    cur: Optional[TreeID] = new_parent
+    seen = 0
+    while cur is not None and cur != TRASH:
+        if cur == target:
+            return True
+        node = nodes.get(cur)
+        cur = node.parent if node else None
+        seen += 1
+        if seen > len(nodes) + 1:
+            return True
+    return False
+
+
+def _depth_in(nodes: Dict[TreeID, "TreeNode"], t: TreeID) -> int:
+    d = 0
+    node = nodes.get(t)
+    while node is not None and node.parent is not None and node.parent != TRASH:
+        d += 1
+        node = nodes.get(node.parent)
+    return d
+
+
+def _children_in(nodes: Dict[TreeID, "TreeNode"], parent: Optional[TreeID]) -> List[TreeID]:
+    kids = [
+        (n.position or b"", n.move_key, t)
+        for t, n in nodes.items()
+        if n.parent == parent and not _deleted_in(nodes, t)
+    ]
+    kids.sort(key=lambda x: (x[0], x[1]))
+    return [t for _, _, t in kids]
+
+
+def _index_in(nodes: Dict[TreeID, "TreeNode"], t: TreeID) -> int:
+    n = nodes.get(t)
+    if n is None or _deleted_in(nodes, t):
+        return -1
+    sibs = _children_in(nodes, n.parent)
+    return sibs.index(t)
+
+
+def _table_views(nodes: Dict[TreeID, "TreeNode"]):
+    """One-pass (alive set, children-by-parent, index-by-node) views so
+    version diffs don't pay per-item sibling sorts."""
+    alive = {t for t in nodes if not _deleted_in(nodes, t)}
+    kids: Dict[Optional[TreeID], List[TreeID]] = {}
+    for t in alive:
+        kids.setdefault(nodes[t].parent, []).append(t)
+    for lst in kids.values():
+        lst.sort(key=lambda t: (nodes[t].position or b"", nodes[t].move_key))
+    index = {t: i for lst in kids.values() for i, t in enumerate(lst)}
+    return alive, kids, index
+
+
 class TreeNode:
     __slots__ = ("parent", "position", "move_key")
 
@@ -64,20 +132,71 @@ class TreeState(ContainerState):
             return None  # not effected
         was = self.nodes.get(target)
         was_alive = record and was is not None and not self._is_deleted(target)
+        old_spot = (
+            (was.parent, _index_in(self.nodes, target)) if was_alive else (None, None)
+        )
+        # the target is about to die if its new parent chain is trashed;
+        # only then collect the live subtree (delete events are emitted
+        # per node, children first — see the Delete branch below)
+        will_die = parent == TRASH or (parent is not None and self._is_deleted(parent))
+        doomed: List[TreeID] = []
+        old_spots = {}
+        if was_alive and will_die:
+            queue = [target]
+            while queue:
+                p = queue.pop(0)
+                doomed.append(p)
+                queue.extend(self.children_of(p))
+            old_spots = {
+                t: (self.nodes[t].parent, _index_in(self.nodes, t)) for t in doomed
+            }
         self.nodes[target] = TreeNode(parent, c.position, key)
         if not record:
             return None
         now_alive = not self._is_deleted(target)
         d = TreeDiff()
         if was_alive and not now_alive:
-            d.items.append(TreeDiffItem(target, TreeDiffAction.Delete))
+            # per-node deletes, children first: the event contract is
+            # by-id (every consumer removal is explicit; no implicit
+            # subtree semantics), matching delta_between
+            for t in reversed(doomed):
+                op, oi = old_spots[t]
+                d.items.append(
+                    TreeDiffItem(t, TreeDiffAction.Delete, old_parent=op, old_index=oi)
+                )
         elif now_alive and not was_alive:
             d.items.append(
                 TreeDiffItem(target, TreeDiffAction.Create, parent, self.index_of(target), c.position)
             )
+            # recursive revival: undeleting target (e.g. moving it out
+            # of a trashed subtree) brings its whole live subtree back;
+            # consumers saw those nodes deleted with the subtree root,
+            # so they must be re-created parents-first (reference:
+            # diff_calc/tree.rs subtree revival)
+            queue = [target]
+            while queue:
+                p = queue.pop(0)
+                for ch in self.children_of(p):
+                    if ch == target:
+                        continue
+                    n = self.nodes[ch]
+                    d.items.append(
+                        TreeDiffItem(
+                            ch, TreeDiffAction.Create, n.parent, self.index_of(ch), n.position
+                        )
+                    )
+                    queue.append(ch)
         elif was_alive and now_alive:
             d.items.append(
-                TreeDiffItem(target, TreeDiffAction.Move, parent, self.index_of(target), c.position)
+                TreeDiffItem(
+                    target,
+                    TreeDiffAction.Move,
+                    parent,
+                    self.index_of(target),
+                    c.position,
+                    old_parent=old_spot[0],
+                    old_index=old_spot[1],
+                )
             )
         else:
             return None  # dead -> dead: invisible
@@ -86,8 +205,9 @@ class TreeState(ContainerState):
     def _replay_all(self, record: bool = True) -> Optional[Diff]:
         """Rebuild node table by replaying the sorted move log, then diff
         old vs new tables (reference retreat/forward, tree.rs:230-396)."""
+        old_nodes = dict(self.nodes) if record else {}
         old = (
-            {t: (n.parent, n.position) for t, n in self.nodes.items() if not self._is_deleted(t)}
+            {t: (n.parent, n.position) for t, n in old_nodes.items() if not _deleted_in(old_nodes, t)}
             if record
             else {}
         )
@@ -102,9 +222,16 @@ class TreeState(ContainerState):
             return None
         d = TreeDiff()
         new_alive = {t for t in self.nodes if not self._is_deleted(t)}
-        for t in old:
-            if t not in new_alive:
-                d.items.append(TreeDiffItem(t, TreeDiffAction.Delete))
+        gone = [t for t in old if t not in new_alive]
+        for t in sorted(gone, key=lambda t: -_depth_in(old_nodes, t)):
+            d.items.append(
+                TreeDiffItem(
+                    t,
+                    TreeDiffAction.Delete,
+                    old_parent=old[t][0],
+                    old_index=_index_in(old_nodes, t),
+                )
+            )
         for t in sorted(new_alive, key=self._depth):
             n = self.nodes[t]
             if t not in old:
@@ -113,63 +240,128 @@ class TreeState(ContainerState):
                 )
             elif old[t] != (n.parent, n.position):
                 d.items.append(
-                    TreeDiffItem(t, TreeDiffAction.Move, n.parent, self.index_of(t), n.position)
+                    TreeDiffItem(
+                        t,
+                        TreeDiffAction.Move,
+                        n.parent,
+                        self.index_of(t),
+                        n.position,
+                        old_parent=old[t][0],
+                        old_index=_index_in(old_nodes, t),
+                    )
                 )
         return d if d.items else None
 
     # ------------------------------------------------------------------
     def _creates_cycle(self, target: TreeID, new_parent: TreeID) -> bool:
         """True if target is an ancestor of new_parent (or equal)."""
-        cur: Optional[TreeID] = new_parent
-        seen = 0
-        while cur is not None and cur != TRASH:
-            if cur == target:
-                return True
-            node = self.nodes.get(cur)
-            cur = node.parent if node else None
-            seen += 1
-            if seen > len(self.nodes) + 1:  # corrupted cycle guard
-                return True
-        return False
+        return _cycle_in(self.nodes, target, new_parent)
 
     def _is_deleted_parent(self, parent: Optional[TreeID]) -> bool:
         return parent == TRASH or (parent is not None and self._is_deleted(parent))
 
     def _is_deleted(self, t: TreeID) -> bool:
-        cur: Optional[TreeID] = t
-        while cur is not None:
-            if cur == TRASH:
-                return True
-            node = self.nodes.get(cur)
-            if node is None:
-                return False
-            cur = node.parent
-        return False
+        return _deleted_in(self.nodes, t)
 
     def _depth(self, t: TreeID) -> int:
-        d = 0
-        node = self.nodes.get(t)
-        while node is not None and node.parent is not None and node.parent != TRASH:
-            d += 1
-            node = self.nodes.get(node.parent)
+        return _depth_in(self.nodes, t)
+
+    # -- exact version diffs (element identity over the move log) ------
+    def _nodes_at(self, vv) -> Dict[TreeID, TreeNode]:
+        """Node table at an arbitrary version: replay the move log
+        filtered to ops included in `vv` (reference: diff_calc/tree.rs
+        :230-396 reaches the same states via retreat/forward on its
+        per-container history cache).  Small memo keyed on (version,
+        log length) so checkout scrubs / repeated diffs near the same
+        versions don't re-replay."""
+        from ..core.ids import ID
+
+        memo_key = (tuple(sorted(vv.items())), len(self.moves))
+        cache = getattr(self, "_nodes_at_memo", None)
+        if cache is None:
+            cache = self._nodes_at_memo = {}
+        if memo_key in cache:
+            return cache[memo_key]
+        nodes: Dict[TreeID, TreeNode] = {}
+        for key, c in self.moves:
+            lam, peer, ctr = key
+            if not vv.includes(ID(peer, ctr)):
+                continue
+            target = c.target
+            parent = TRASH if c.is_delete else c.parent
+            if parent is not None and parent != TRASH and _cycle_in(nodes, target, parent):
+                continue
+            nodes[target] = TreeNode(parent, c.position, key)
+        if len(cache) >= 8:
+            cache.pop(next(iter(cache)))
+        cache[memo_key] = nodes
+        return nodes
+
+    def delta_between(self, va, vb) -> TreeDiff:
+        """Exact TreeDiff turning state(va) into state(vb), by move-op
+        identity: per-node Create (incl. every node of a revived
+        subtree, parents first), Move (with old_parent/old_index), and
+        Delete (children first).  reference: tree.rs:230-396."""
+        old = self._nodes_at(va)
+        new = self._nodes_at(vb)
+        alive_old, _kids_old, idx_old = _table_views(old)
+        alive_new, kids_new, idx_new = _table_views(new)
+        d = TreeDiff()
+        # deletes children-first so consumers never orphan a live child
+        gone = alive_old - alive_new
+        for t in sorted(gone, key=lambda t: -_depth_in(old, t)):
+            d.items.append(
+                TreeDiffItem(
+                    t,
+                    TreeDiffAction.Delete,
+                    old_parent=old[t].parent,
+                    old_index=idx_old.get(t, -1),
+                )
+            )
+        # creates + moves parents-first in the NEW tree (BFS): a parent
+        # is always placed before its children, which also makes the
+        # item sequence safe to apply move-by-move (no transient cycles)
+        order: List[TreeID] = []
+        queue: List[Optional[TreeID]] = [None]
+        while queue:
+            p = queue.pop(0)
+            for t in kids_new.get(p, ()):
+                order.append(t)
+                queue.append(t)
+        for t in order:
+            n = new[t]
+            if t not in alive_old:
+                d.items.append(
+                    TreeDiffItem(
+                        t,
+                        TreeDiffAction.Create,
+                        n.parent,
+                        idx_new.get(t, -1),
+                        n.position,
+                    )
+                )
+            else:
+                o = old[t]
+                if (o.parent, o.position) != (n.parent, n.position):
+                    d.items.append(
+                        TreeDiffItem(
+                            t,
+                            TreeDiffAction.Move,
+                            n.parent,
+                            idx_new.get(t, -1),
+                            n.position,
+                            old_parent=o.parent,
+                            old_index=idx_old.get(t, -1),
+                        )
+                    )
         return d
 
     # -- queries ------------------------------------------------------
     def children_of(self, parent: Optional[TreeID]) -> List[TreeID]:
-        kids = [
-            (n.position or b"", n.move_key, t)
-            for t, n in self.nodes.items()
-            if n.parent == parent and not self._is_deleted(t)
-        ]
-        kids.sort(key=lambda x: (x[0], x[1]))
-        return [t for _, _, t in kids]
+        return _children_in(self.nodes, parent)
 
     def index_of(self, t: TreeID) -> int:
-        n = self.nodes.get(t)
-        if n is None or self._is_deleted(t):
-            return -1
-        sibs = self.children_of(n.parent)
-        return sibs.index(t)
+        return _index_in(self.nodes, t)
 
     def parent_of(self, t: TreeID) -> Optional[TreeID]:
         n = self.nodes.get(t)

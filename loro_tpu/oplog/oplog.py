@@ -93,12 +93,18 @@ class OpLog:
                         f"{d} which no block covers"
                     )
         for peer in store.peers():
-            first = store.blocks[peer][0].ctr_start
+            bl = store.blocks[peer]
+            first = bl[0].ctr_start
             floor = self.dag.shallow_since_vv.get(peer)
             if first != floor:
                 raise DecodeError(
                     f"peer {peer} history starts at {first}, expected {floor}"
                 )
+            # no intra-peer gaps (BlockStore.decode also checks; this
+            # covers hand-built stores so the dag never gets a hole)
+            for a, b in zip(bl, bl[1:]):
+                if a.ctr_end != b.ctr_start:
+                    raise DecodeError(f"peer {peer} history has a gap at {a.ctr_end}")
         for peer, cs, ce, lam, deps in metas:
             self.dag.add_node(peer, cs, ce, lam, tuple(deps))
             lam_end = lam + (ce - cs)

@@ -5,7 +5,7 @@ import random
 
 import pytest
 
-from loro_tpu import CounterDiff, Delta, LoroDoc, MapDiff
+from loro_tpu import CounterDiff, Delta, LoroDoc, MapDiff, TreeDiff
 
 
 class Mirror:
@@ -35,6 +35,18 @@ class Mirror:
                 self.values[cid] = cur
             elif isinstance(d, CounterDiff):
                 self.values[cid] = self.values.get(cid, 0.0) + d.delta
+            elif isinstance(d, TreeDiff):
+                # {TreeID: (parent, position)}; the event contract is
+                # strictly by-id: deletes arrive per node (children
+                # first) and revivals re-create every descendant, so
+                # the mirror never infers subtree membership itself
+                cur = dict(self.values.get(cid, {}))
+                for item in d.items:
+                    if item.action.name == "Delete":
+                        cur.pop(item.target, None)
+                    else:  # Create / Move
+                        cur[item.target] = (item.parent, item.position)
+                self.values[cid] = cur
 
     def assert_matches(self) -> None:
         for cid, mirrored in self.values.items():
@@ -50,6 +62,15 @@ class Mirror:
                 assert mirrored == actual, f"map mirror diverged for {cid}"
             elif cid.ctype.name == "Counter":
                 assert abs(mirrored - actual) < 1e-9, f"counter mirror diverged"
+            elif cid.ctype.name == "Tree":
+                want = {
+                    t: (n.parent, n.position)
+                    for t, n in st.nodes.items()
+                    if not st._is_deleted(t)
+                }
+                assert mirrored == want, (
+                    f"tree mirror diverged for {cid}:\n{mirrored}\nvs\n{want}"
+                )
 
 
 @pytest.mark.parametrize("seed", range(6))
@@ -117,6 +138,56 @@ def test_movable_list_event_mirror():
         if rng.random() < 0.4:
             a.import_(b.export_updates(a.oplog_vv()))
             b.import_(a.export_updates(b.oplog_vv()))
+            mirror.assert_matches()
+    a.import_(b.export_updates(a.oplog_vv()))
+    mirror.assert_matches()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tree_event_mirror_with_checkout(seed):
+    """Tree events (live edits, imports, AND checkout time travel) keep
+    an event-driven mirror exact (reference: diff_calc/tree.rs version
+    diffs; VERDICT round-1 item 6)."""
+    rng = random.Random(1000 + seed)
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    mirror = Mirror(a)
+    frontier_log = []
+    for step in range(80):
+        d = a if rng.random() < 0.6 else b
+        tr = d.get_tree("tree")
+        nodes = tr.nodes()
+        r = rng.random()
+        if not nodes or r < 0.35:
+            parent = rng.choice(nodes) if nodes and rng.random() < 0.5 else None
+            tr.create(parent)
+        elif r < 0.6:
+            t = rng.choice(nodes)
+            p = rng.choice(nodes + [None])
+            try:
+                tr.move(t, p)
+            except Exception:
+                pass  # cycle: rejected
+        elif r < 0.8:
+            tr.delete(rng.choice(nodes))
+        else:
+            t = rng.choice(nodes)
+            p = rng.choice(nodes + [None])
+            try:
+                tr.move(t, p, index=rng.randint(0, 2))
+            except Exception:
+                pass
+        d.commit()
+        if rng.random() < 0.4:
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+            mirror.assert_matches()
+            frontier_log.append(a.oplog_frontiers())
+        # time travel: checkout events must keep the mirror exact
+        if frontier_log and rng.random() < 0.15:
+            f = rng.choice(frontier_log)
+            a.checkout(f)
+            mirror.assert_matches()
+            a.checkout_to_latest()
             mirror.assert_matches()
     a.import_(b.export_updates(a.oplog_vv()))
     mirror.assert_matches()

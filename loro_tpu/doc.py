@@ -20,12 +20,10 @@ from .core.ids import ContainerID, ContainerType, ID, IdSpan, PeerID
 from .core.version import Frontiers, VersionRange, VersionVector
 from .event import (
     ContainerDiff,
-    Delta,
     DocDiff,
     EventTriggerKind,
     MapDiff,
     Observer,
-    TreeDiff,
 )
 from .models.handlers import (
     CounterHandler,

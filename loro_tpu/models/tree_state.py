@@ -142,13 +142,14 @@ class TreeState(ContainerState):
         doomed: List[TreeID] = []
         old_spots = {}
         if was_alive and will_die:
+            _alive, kids, index = _table_views(self.nodes)
             queue = [target]
             while queue:
                 p = queue.pop(0)
                 doomed.append(p)
-                queue.extend(self.children_of(p))
+                queue.extend(kids.get(p, ()))
             old_spots = {
-                t: (self.nodes[t].parent, _index_in(self.nodes, t)) for t in doomed
+                t: (self.nodes[t].parent, index.get(t, -1)) for t in doomed
             }
         self.nodes[target] = TreeNode(parent, c.position, key)
         if not record:
@@ -206,11 +207,11 @@ class TreeState(ContainerState):
         """Rebuild node table by replaying the sorted move log, then diff
         old vs new tables (reference retreat/forward, tree.rs:230-396)."""
         old_nodes = dict(self.nodes) if record else {}
-        old = (
-            {t: (n.parent, n.position) for t, n in old_nodes.items() if not _deleted_in(old_nodes, t)}
-            if record
-            else {}
-        )
+        if record:
+            old_alive, _old_kids, old_index = _table_views(old_nodes)
+            old = {t: (old_nodes[t].parent, old_nodes[t].position) for t in old_alive}
+        else:
+            old = {}
         self.nodes = {}
         for key, c in self.moves:
             target = c.target
@@ -221,7 +222,7 @@ class TreeState(ContainerState):
         if not record:
             return None
         d = TreeDiff()
-        new_alive = {t for t in self.nodes if not self._is_deleted(t)}
+        new_alive, _new_kids, new_index = _table_views(self.nodes)
         gone = [t for t in old if t not in new_alive]
         for t in sorted(gone, key=lambda t: -_depth_in(old_nodes, t)):
             d.items.append(
@@ -229,14 +230,14 @@ class TreeState(ContainerState):
                     t,
                     TreeDiffAction.Delete,
                     old_parent=old[t][0],
-                    old_index=_index_in(old_nodes, t),
+                    old_index=old_index.get(t, -1),
                 )
             )
         for t in sorted(new_alive, key=self._depth):
             n = self.nodes[t]
             if t not in old:
                 d.items.append(
-                    TreeDiffItem(t, TreeDiffAction.Create, n.parent, self.index_of(t), n.position)
+                    TreeDiffItem(t, TreeDiffAction.Create, n.parent, new_index.get(t, -1), n.position)
                 )
             elif old[t] != (n.parent, n.position):
                 d.items.append(
@@ -244,10 +245,10 @@ class TreeState(ContainerState):
                         t,
                         TreeDiffAction.Move,
                         n.parent,
-                        self.index_of(t),
+                        new_index.get(t, -1),
                         n.position,
                         old_parent=old[t][0],
-                        old_index=_index_in(old_nodes, t),
+                        old_index=old_index.get(t, -1),
                     )
                 )
         return d if d.items else None

@@ -427,6 +427,31 @@ def chain_merge_docs_u(cols: SeqColumnsU, c_pad: int):
     return jax.vmap(lambda c: chain_contract_materialize_u(c, c_pad))(cols)
 
 
+@jax.jit
+def materialize_by_key(cols: SeqColumnsU, key_hi, key_lo):
+    """Visible content from standing order keys (incremental path):
+    one multi-key sort by (key_hi, key_lo) replaces the rank solve —
+    the host ShadowOrder (parallel/order_maintenance.py) guarantees
+    ascending key == Fugue traversal order.  [D, N] -> (codes, counts)
+    with the same contract as chain_merge_docs_u."""
+    d, n = cols.content.shape
+    inf = jnp.uint32(0xFFFFFFFF)
+    hi = jnp.where(cols.valid, key_hi, inf)
+    lo = jnp.where(cols.valid, key_lo, inf)
+    visible = cols.valid & ~cols.deleted & (cols.content >= 0)
+    _hi_s, _lo_s, content_s, vis_s = jax.lax.sort(
+        (hi, lo, cols.content, visible.astype(jnp.int32)), dimension=1, num_keys=2
+    )
+    vis_s = vis_s.astype(bool)
+    pos = jnp.cumsum(vis_s.astype(jnp.int32), axis=1) - 1
+    counts = vis_s.sum(axis=1)
+    target = jnp.where(vis_s, pos, n)  # invisible rows -> dump column
+    out = jnp.full((d, n + 1), -1, cols.content.dtype)
+    d_idx = jnp.broadcast_to(jnp.arange(d)[:, None], (d, n))
+    out = out.at[d_idx, target].set(content_s, mode="drop")
+    return out[:, :n], counts
+
+
 # batched-over-documents variants --------------------------------------
 fugue_order_batch = jax.vmap(fugue_order)
 visible_order_batch = jax.vmap(visible_order)

@@ -523,6 +523,12 @@ class DeviceDocBatch:
         # host-side id -> row resolution per doc
         self.id2row: List[Dict[Tuple[int, int], int]] = [dict() for _ in range(n_docs)]
         self.value_store: List[List] = [[] for _ in range(n_docs)]
+        # incremental order: per-doc host ShadowOrder assigns standing
+        # 64-bit order keys in O(delta); materialization sorts by key
+        # instead of re-ranking the table (VERDICT round-1 item 4)
+        from .order_maintenance import ShadowOrder
+
+        self.order: List[ShadowOrder] = [ShadowOrder() for _ in range(n_docs)]
         from ..ops.fugue_batch import SeqColumnsU
 
         sh = doc_sharding(self.mesh)
@@ -539,6 +545,8 @@ class DeviceDocBatch:
             content=z(np.int32, -1),
             valid=z(bool, False),
         )
+        self.key_hi = z(np.uint32, 0xFFFFFFFF)
+        self.key_lo = z(np.uint32, 0xFFFFFFFF)
 
     # ------------------------------------------------------------------
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
@@ -634,6 +642,8 @@ class DeviceDocBatch:
             if overlay:
                 self.id2row[di].update(overlay)
         if max_new:
+            from .order_maintenance import split_keys
+
             blk_shape = (self.d, max_new)
             blk = {
                 "parent": np.full(blk_shape, -1, np.int32),
@@ -645,11 +655,15 @@ class DeviceDocBatch:
                 "content": np.full(blk_shape, -1, np.int32),
                 "valid": np.zeros(blk_shape, bool),
             }
+            key_blk_hi = np.full(blk_shape, 0xFFFFFFFF, np.uint32)
+            key_blk_lo = np.full(blk_shape, 0xFFFFFFFF, np.uint32)
             offsets = np.zeros(self.d, np.int32)
+            renumbered: List[int] = []
             for di, rows in enumerate(rows_per_doc):
                 if not rows:
                     continue
                 k = len(rows)
+                base = int(self.counts[di])
                 arr = np.asarray([(r[0], r[1], r[2], r[3]) for r in rows], np.int64)
                 pu = np.asarray([r[4] for r in rows], np.uint64)
                 blk["parent"][di, :k] = arr[:, 0]
@@ -660,13 +674,33 @@ class DeviceDocBatch:
                 blk["deleted"][di, :k] = False
                 blk["content"][di, :k] = arr[:, 3]
                 blk["valid"][di, :k] = True
-                offsets[di] = int(self.counts[di])
+                keys = self.order[di].append_rows(
+                    [(r[0], r[1], int(r[4]), r[2]) for r in rows], base
+                )
+                if keys is None:
+                    renumbered.append(di)
+                else:
+                    kh, kl = split_keys(np.asarray(keys, np.int64))
+                    key_blk_hi[di, :k] = kh
+                    key_blk_lo[di, :k] = kl
+                offsets[di] = base
                 self.counts[di] += k
             sh = doc_sharding(self.mesh)
             blk_dev = {f: jax.device_put(v, sh) for f, v in blk.items()}
-            self.cols = _scatter_rows(
-                self.cols, blk_dev, jax.device_put(offsets, replicated(self.mesh))
+            blk_dev["key_hi"] = jax.device_put(key_blk_hi, sh)
+            blk_dev["key_lo"] = jax.device_put(key_blk_lo, sh)
+            packed = _scatter_rows(
+                (self.cols, self.key_hi, self.key_lo),
+                blk_dev,
+                jax.device_put(offsets, replicated(self.mesh)),
             )
+            self.cols, self.key_hi, self.key_lo = packed
+            # renumbered docs: re-upload the whole key row (rare)
+            for di in renumbered:
+                kh, kl = split_keys(self.order[di].all_keys())
+                n = len(kh)
+                self.key_hi = self.key_hi.at[di, :n].set(jnp.asarray(kh))
+                self.key_lo = self.key_lo.at[di, :n].set(jnp.asarray(kl))
         self.mark_deleted(del_pairs)
 
     def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]], cid) -> None:
@@ -765,13 +799,19 @@ class DeviceDocBatch:
     def resolve_row(self, doc: int, peer: int, counter: int) -> Optional[int]:
         return self.id2row[doc].get((peer, counter))
 
-    def texts(self) -> List[str]:
-        """Materialize every doc (one launch).  Uses the device-side
-        chain-contracted solver — ranking cost follows the actual chain
-        count, not the buffer capacity; the chain budget doubles and
-        retries on overflow (rare, compile-cached per bucket)."""
-        from ..ops.fugue_batch import chain_merge_docs_u
+    def _materialize(self, use_solver: bool = False):
+        """(codes, counts) for the whole batch in one launch.
 
+        Default path: sort by the standing ShadowOrder keys — the
+        per-sync order work already happened incrementally on ingest
+        (O(delta)); the launch is one multi-key sort, no rank solve.
+        use_solver=True runs the full chain-contracted rank solve
+        instead (bulk path; also the differential check in tests)."""
+        from ..ops.fugue_batch import chain_merge_docs_u, materialize_by_key
+
+        if not use_solver:
+            codes, counts = materialize_by_key(self.cols, self.key_hi, self.key_lo)
+            return np.asarray(codes), np.asarray(counts)
         while True:
             codes, counts, n_chains = chain_merge_docs_u(self.cols, self._c_pad)
             max_chains = int(np.asarray(n_chains).max()) if self.d else 0
@@ -779,24 +819,17 @@ class DeviceDocBatch:
                 break
             while self._c_pad < max_chains:
                 self._c_pad *= 2
-        codes = np.asarray(codes)
-        counts = np.asarray(counts)
+        return np.asarray(codes), np.asarray(counts)
+
+    def texts(self, use_solver: bool = False) -> List[str]:
+        """Materialize every doc (one launch)."""
+        codes, counts = self._materialize(use_solver)
         return ["".join(map(chr, codes[i, : counts[i]])) for i in range(self.n_docs)]
 
-    def values(self) -> List[list]:
+    def values(self, use_solver: bool = False) -> List[list]:
         """Materialize value lists (as_text=False batches)."""
-        from ..ops.fugue_batch import chain_merge_docs_u
-
         assert not self.as_text, "values() is for as_text=False batches"
-        while True:
-            codes, counts, n_chains = chain_merge_docs_u(self.cols, self._c_pad)
-            max_chains = int(np.asarray(n_chains).max()) if self.d else 0
-            if max_chains <= self._c_pad:
-                break
-            while self._c_pad < max_chains:
-                self._c_pad *= 2
-        codes = np.asarray(codes)
-        counts = np.asarray(counts)
+        codes, counts = self._materialize(use_solver)
         return [
             [self.value_store[i][j] for j in codes[i, : counts[i]]] for i in range(self.n_docs)
         ]
@@ -1014,11 +1047,12 @@ class DeviceMapBatch:
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_rows(cols, blk, offsets):
+def _scatter_rows(state, blk, offsets):
     """Write each doc's new-row block at its per-doc offset (donated
     update — the old buffer is reused, no [D, N] copy).  Padding rows of
     a block restore the window's previous values so short updates don't
-    clobber neighbors."""
+    clobber neighbors.  `state` is (SeqColumnsU, key_hi, key_lo)."""
+    cols, key_hi, key_lo = state
 
     def per_field(col, nbl, vbl, off):
         window = jax.lax.dynamic_slice(col, (off,), (nbl.shape[0],))
@@ -1027,7 +1061,9 @@ def _scatter_rows(cols, blk, offsets):
     out = {}
     for f in cols._fields:
         out[f] = jax.vmap(per_field)(getattr(cols, f), blk[f], blk["valid"], offsets)
-    return type(cols)(**out)
+    new_hi = jax.vmap(per_field)(key_hi, blk["key_hi"], blk["valid"], offsets)
+    new_lo = jax.vmap(per_field)(key_lo, blk["key_lo"], blk["valid"], offsets)
+    return type(cols)(**out), new_hi, new_lo
 
 
 @functools.lru_cache(maxsize=32)

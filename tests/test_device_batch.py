@@ -233,8 +233,10 @@ class TestDeviceDocBatch:
         batch = DeviceDocBatch(n_docs=1, capacity=1024)
         batch._c_pad = 16  # force overflow
         batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
-        assert batch.texts() == [t.to_string()]
+        assert batch.texts(use_solver=True) == [t.to_string()]
         assert batch._c_pad > 16  # budget grew
+        # incremental key path agrees with the solver
+        assert batch.texts() == [t.to_string()]
 
     def test_uncontracted_solver_agrees(self):
         """merge_docs_u (no contraction) is the differential oracle for
@@ -407,4 +409,68 @@ class TestDeviceDocBatch:
         t.insert(3, "d")  # parents on the end-anchor region
         doc.commit()
         batch.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], cid)
+        assert batch.texts() == [t.to_string()]
+
+
+class TestIncrementalOrder:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_key_path_matches_solver(self, seed):
+        """The ShadowOrder key materialization must agree with the full
+        chain-contracted rank solve after every sync epoch."""
+        rng = random.Random(40 + seed)
+        docs = [LoroDoc(peer=i + 1) for i in range(2)]
+        cid = docs[0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=2, capacity=4096)
+        marks = [d.oplog_vv() for d in docs]
+        for epoch in range(5):
+            for d in docs:
+                t = d.get_text("t")
+                for _ in range(rng.randint(1, 12)):
+                    if len(t) and rng.random() < 0.3:
+                        pos = rng.randrange(len(t))
+                        t.delete(pos, min(2, len(t) - pos))
+                    else:
+                        t.insert(rng.randint(0, len(t)), rng.choice(["a", "bc "]))
+                d.commit()
+            docs[0].import_(docs[1].export_updates(docs[0].oplog_vv()))
+            docs[1].import_(docs[0].export_updates(docs[1].oplog_vv()))
+            ups = []
+            for i, d in enumerate(docs):
+                ups.append(d.oplog.changes_between(marks[i], d.oplog_vv()))
+                marks[i] = d.oplog_vv()
+            batch.append_changes(ups, cid)
+            want = [d.get_text("t").to_string() for d in docs]
+            assert batch.texts() == want, f"key path diverged epoch {epoch}"
+            assert batch.texts(use_solver=True) == want
+
+    def test_append_soak_sublinear(self):
+        """Append-heavy steady state: per-sync ingest cost must not grow
+        with the standing table (the old design re-ranked everything).
+        Deterministic check: zero renumbers + O(1) fast-path placement;
+        plus a loose wall-clock ratio guard."""
+        import time
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        cid = t.id
+        batch = DeviceDocBatch(n_docs=1, capacity=1 << 15)
+        mark = doc.oplog_vv()
+
+        def sync(n_chars):
+            nonlocal mark
+            t.insert(len(t), "x" * n_chars)
+            doc.commit()
+            ups = doc.oplog.changes_between(mark, doc.oplog_vv())
+            mark = doc.oplog_vv()
+            t0 = time.perf_counter()
+            batch.append_changes([ups], cid)
+            return time.perf_counter() - t0
+
+        times = [sync(200) for _ in range(40)]
+        assert batch.order[0].renumbers == 0
+        early = sorted(times[2:10])[:4]
+        late = sorted(times[-8:])[:4]
+        assert sum(late) < 6 * sum(early), (
+            f"per-sync ingest grew: early {sum(early):.4f}s late {sum(late):.4f}s"
+        )
         assert batch.texts() == [t.to_string()]

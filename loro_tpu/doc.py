@@ -510,15 +510,19 @@ class LoroDoc:
                 ch for p in store.peers() for ch in store.changes_for_peer(p)
             ]
             return self._import_changes(changes, origin)
-        if base is not None:
-            self._install_shallow_base(*base)
         try:
-            states, parents = scodec.decode_doc_state(state_bytes)
-        except Exception as e:
-            raise DecodeError(f"malformed snapshot state: {e}") from e
-        # lazy attach: dag/vv come from block metas; op payloads decode
-        # per peer only when replay/diff/export actually needs them
-        self.oplog.attach_cold_store(store)
+            if base is not None:
+                self._install_shallow_base(*base)
+            try:
+                states, parents = scodec.decode_doc_state(state_bytes)
+            except Exception as e:
+                raise DecodeError(f"malformed snapshot state: {e}") from e
+            # lazy attach: dag/vv come from block metas; op payloads
+            # decode per peer only when replay/diff/export needs them
+            self.oplog.attach_cold_store(store)
+        except DecodeError:
+            self._reset_to_empty()
+            raise
         self.state.states = states
         self.state.parents.update(parents)
         self.state.vv = self.oplog.vv.copy()
@@ -533,6 +537,160 @@ class LoroDoc:
             if hi > lo:
                 status.extend_to_include(IdSpan(peer, lo, hi))
         return ImportStatus(status, None)
+
+    def _validate_planned(self, inserts: List[Change]) -> None:
+        """Semantic integrity gate between decode and commit: every
+        sequence/movable op reference must resolve against the known
+        element ids (state tables keep tombstones, so an attached
+        state's by_id is the full element history) or ids created
+        earlier in this batch; delete spans must be sane.  A corrupt
+        payload whose deps lie fails HERE, typed, with nothing mutated
+        (reference: the random_import fuzz contract + oplog rollback)."""
+        from .core.change import (
+            CounterIncr,
+            MapSet,
+            MovableMove,
+            MovableSet,
+            SeqDelete,
+            SeqInsert,
+            StyleAnchor,
+            TreeMove,
+        )
+
+        allowed_kinds = {
+            ContainerType.Map: (MapSet,),
+            ContainerType.Text: (SeqInsert, SeqDelete),
+            ContainerType.List: (SeqInsert, SeqDelete),
+            ContainerType.MovableList: (SeqInsert, SeqDelete, MovableSet, MovableMove),
+            ContainerType.Tree: (TreeMove,),
+            ContainerType.Counter: (CounterIncr,),
+        }
+        attached = not self._detached
+        # ids created by THIS batch, per container (small); existing ids
+        # are probed directly against the live state dicts — no O(doc)
+        # set materialization on the import hot path
+        batch_ids: Dict[ContainerID, set] = {}
+        detached_extra: Dict[ContainerID, set] = {}
+
+        def known_ids(cid: ContainerID) -> set:
+            s_ = batch_ids.get(cid)
+            if s_ is None:
+                s_ = batch_ids[cid] = set()
+            return s_
+
+        def detached_ids(cid: ContainerID) -> set:
+            """Element ids for `cid` over the FULL history — only built
+            when the doc is detached (state lags the oplog) and a probe
+            missed; cached per import."""
+            s_ = detached_extra.get(cid)
+            if s_ is None:
+                s_ = set()
+                for ch in self.oplog.changes_in_causal_order():
+                    for op in ch.ops:
+                        if op.container != cid:
+                            continue
+                        c = op.content
+                        if isinstance(c, SeqInsert):
+                            n_b = 1 if isinstance(c.content, StyleAnchor) else len(c.content)
+                            for j in range(n_b):
+                                s_.add((ch.peer, op.counter + j))
+                        elif isinstance(c, MovableMove):
+                            s_.add((ch.peer, op.counter))
+                detached_extra[cid] = s_
+            return s_
+
+        def resolvable(cid: ContainerID, key: Tuple[int, int]) -> bool:
+            if key in known_ids(cid):
+                return True
+            st = self.state.states.get(cid)
+            if st is not None:
+                seq = getattr(st, "seq", None)
+                if seq is not None and key in seq.by_id:
+                    return True
+                elems = getattr(st, "elems", None)
+                if elems is not None and ID(key[0], key[1]) in elems:
+                    return True
+            if not attached:
+                # detached state lags the oplog: check the history
+                # itself, per container (precise; built lazily)
+                return key in detached_ids(cid)
+            return False
+
+        total_atoms = self.oplog.total_ops() + sum(ch.atom_len() for ch in inserts)
+        for ch in inserts:
+            for op in ch.ops:
+                c = op.content
+                ok_kinds = allowed_kinds.get(op.container.ctype)
+                if ok_kinds is not None and not isinstance(c, ok_kinds):
+                    # UnknownContent is only legal on Unknown containers
+                    raise DecodeError(
+                        f"op kind {type(c).__name__} not valid for "
+                        f"{op.container.ctype.name} container (corrupt payload?)"
+                    )
+                if (
+                    isinstance(c, SeqInsert)
+                    and isinstance(c.content, StyleAnchor)
+                    and op.container.ctype != ContainerType.Text
+                ):
+                    raise DecodeError(
+                        "style anchor outside a Text container (corrupt payload?)"
+                    )
+                if isinstance(c, SeqInsert):
+                    self._check_placement(op.container, ch.peer, op.counter, c.parent, c.side, resolvable)
+                    if op.container.ctype == ContainerType.Text:
+                        body_ok = isinstance(c.content, StyleAnchor) or (
+                            isinstance(c.content, str)
+                        )
+                        if not body_ok:
+                            raise DecodeError(
+                                "non-text content in a Text container "
+                                "(corrupt payload?)"
+                            )
+                    n_body = 1 if isinstance(c.content, StyleAnchor) else len(c.content)
+                    ids = known_ids(op.container)
+                    for j in range(n_body):
+                        ids.add((ch.peer, op.counter + j))
+                elif isinstance(c, SeqDelete):
+                    for sp in c.spans:
+                        if sp.end - sp.start > total_atoms or sp.end < sp.start:
+                            raise DecodeError(
+                                f"delete span of {sp.end - sp.start} atoms exceeds "
+                                f"total history ({total_atoms}) — corrupt payload?"
+                            )
+                elif isinstance(c, (MovableSet, MovableMove)):
+                    e = c.elem
+                    if not resolvable(op.container, (e.peer, e.counter)):
+                        raise DecodeError(
+                            f"movable op references unknown element {e} "
+                            "(corrupt payload?)"
+                        )
+                    if isinstance(c, MovableMove):
+                        # a move creates a new position slot placed like
+                        # an insert: validate its Fugue parent too
+                        self._check_placement(
+                            op.container, ch.peer, op.counter, c.parent, c.side, resolvable
+                        )
+                        known_ids(op.container).add((ch.peer, op.counter))
+
+    @staticmethod
+    def _check_placement(cid, peer, counter, parent, side, resolvable) -> None:
+        from .core.change import Side
+        from .oplog.oplog import _RunCont
+
+        if isinstance(parent, _RunCont):
+            if not resolvable(cid, (peer, counter - 1)):
+                raise DecodeError(
+                    f"run continuation at {peer}:{counter} has no preceding "
+                    "element (corrupt payload?)"
+                )
+        elif parent is not None:
+            if not resolvable(cid, (parent.peer, parent.counter)):
+                raise DecodeError(
+                    f"placement parent {parent} not a known element "
+                    "(corrupt payload?)"
+                )
+        elif side == Side.Left:
+            raise DecodeError("root placement must be right-side (corrupt payload?)")
 
     def _emit_state_install_event(self, origin: str) -> None:
         """Subscribers registered before a snapshot import still need to
@@ -567,19 +725,39 @@ class LoroDoc:
             changes = bcodec.decode_changes(updates) if updates else []
         except Exception as e:
             raise DecodeError(f"malformed shallow snapshot: {e}") from e
-        self._install_shallow_base(state_bytes, base_vv, base_f)
         try:
-            states, parents = _decode_state_z(state_bytes)
-        except Exception as e:
-            raise DecodeError(f"malformed snapshot state: {e}") from e
-        self.state.states = states
-        self.state.parents.update(parents)
-        self.state.vv = base_vv.copy()
-        self.state.frontiers = base_f
+            self._install_shallow_base(state_bytes, base_vv, base_f)
+            try:
+                states, parents = _decode_state_z(state_bytes)
+            except Exception as e:
+                raise DecodeError(f"malformed snapshot state: {e}") from e
+            self.state.states = states
+            self.state.parents.update(parents)
+            self.state.vv = base_vv.copy()
+            self.state.frontiers = base_f
+            if changes:
+                # validate BEFORE announcing anything to subscribers so
+                # a corrupt retained-changes section leaves no trace
+                plan = self.oplog.plan_import(changes)
+                self._validate_planned(plan.inserts)
+        except DecodeError:
+            self._reset_to_empty()
+            raise
         self._emit_state_install_event(origin)
         if changes:
             return self._import_changes(changes, origin)
         return ImportStatus(VersionRange(), None)
+
+    def _reset_to_empty(self) -> None:
+        """Roll a failed snapshot install back to the pristine empty
+        doc (the import paths that install state require emptiness, so
+        a full reset IS the rollback)."""
+        self.oplog = OpLog()
+        self.oplog.config = self.config
+        self.state = DocState()
+        self._shallow_base = None
+        self._detached = False
+        self._state_cache.clear()
 
     def _install_shallow_base(self, state_bytes: bytes, vv: VersionVector, f: Frontiers) -> None:
         self._shallow_base = (state_bytes, vv.copy(), f)
@@ -591,7 +769,9 @@ class LoroDoc:
 
     def _import_changes(self, changes: List[Change], origin: str) -> ImportStatus:
         with tracing.span("oplog.import", n_changes=len(changes)):
-            applied, pending = self.oplog.import_changes(changes)
+            plan = self.oplog.plan_import(changes)
+            self._validate_planned(plan.inserts)
+            applied, pending = self.oplog.commit_import(plan)
         success = VersionRange()
         for ch in applied:
             success.extend_to_include(ch.id_span())

@@ -45,6 +45,15 @@ class PendingChanges:
         return sum(len(v) for v in self.by_missing.values())
 
 
+@dataclass
+class ImportPlan:
+    """Outcome of OpLog.plan_import: inserts in causal order + the
+    pending store as it would look after commit."""
+
+    inserts: List[Change]
+    pending: Dict[ID, List[Change]]
+
+
 class OpLog:
     """Append-only causal history: changes + DAG + pending queue."""
 
@@ -194,13 +203,20 @@ class OpLog:
             self.next_lamport = ch.lamport_end
 
     # -- remote import ------------------------------------------------
-    def import_changes(self, changes: Iterable[Change]) -> Tuple[List[Change], VersionRange]:
-        """Import remote changes: dedup known spans, park dep-missing ones,
-        apply the rest in causal order.  Returns (applied changes in causal
-        order, still-pending version range).
-        reference: oplog.rs apply_decoded_changes_to_oplog + pending loop."""
+    def plan_import(self, changes: Iterable[Change]) -> "ImportPlan":
+        """Pure planning pass: decide which changes would insert (in
+        causal order, trimmed), which would park, and what the pending
+        store would become — WITHOUT mutating anything.  The doc layer
+        validates the planned inserts against known element ids before
+        committing (a corrupt payload whose deps lie must fail typed,
+        leaving oplog AND state untouched — reference: import rollback,
+        oplog.rs)."""
+        vv = self.vv.copy()
+        pending = PendingChanges(
+            by_missing={k: list(v) for k, v in self.pending.by_missing.items()}
+        )
         queue: List[Change] = list(changes)
-        applied: List[Change] = []
+        inserts: List[Change] = []
         progress = True
         while progress:
             progress = False
@@ -208,26 +224,39 @@ class OpLog:
             # causal linearization attempt: sort by (lamport, peer, ctr)
             queue.sort(key=lambda c: (c.lamport, c.peer, c.ctr_start))
             for ch in queue:
-                known_end = self.vv.get(ch.peer)
+                known_end = vv.get(ch.peer)
                 if ch.ctr_end <= known_end:
                     continue  # fully known — dedup (trim_the_known_part)
                 if ch.ctr_start > known_end:
                     # a gap within the same peer: park on the previous op
-                    self.pending.park(ID(ch.peer, ch.ctr_start - 1), ch)
+                    pending.park(ID(ch.peer, ch.ctr_start - 1), ch)
                     continue
                 if ch.ctr_start < known_end:
                     ch = self._trim_known_prefix(ch, known_end)
-                missing = next((d for d in ch.deps if not self.dag.contains(d)), None)
+                missing = next((d for d in ch.deps if not vv.includes(d)), None)
                 if missing is not None:
-                    self.pending.park(missing, ch)
+                    pending.park(missing, ch)
                     continue
-                self._insert_change(ch)
-                applied.append(ch)
+                inserts.append(ch)
+                vv.set_end(ch.peer, max(vv.get(ch.peer), ch.ctr_end))
                 progress = True
                 # unlock parked changes whose trigger is now satisfied
-                next_queue.extend(self.pending.take_unlocked(self.vv))
+                next_queue.extend(pending.take_unlocked(vv))
             queue = next_queue
-        return applied, self.pending.pending_range()
+        return ImportPlan(inserts=inserts, pending=pending.by_missing)
+
+    def commit_import(self, plan: "ImportPlan") -> Tuple[List[Change], VersionRange]:
+        for ch in plan.inserts:
+            self._insert_change(ch)
+        self.pending.by_missing = plan.pending
+        return plan.inserts, self.pending.pending_range()
+
+    def import_changes(self, changes: Iterable[Change]) -> Tuple[List[Change], VersionRange]:
+        """Import remote changes: dedup known spans, park dep-missing ones,
+        apply the rest in causal order.  Returns (applied changes in causal
+        order, still-pending version range).
+        reference: oplog.rs apply_decoded_changes_to_oplog + pending loop."""
+        return self.commit_import(self.plan_import(changes))
 
     def _trim_known_prefix(self, ch: Change, known_end: Counter) -> Change:
         ops: List[Op] = []

@@ -1,0 +1,244 @@
+"""Import fuzzing with a pinned repro corpus.
+
+reference: crates/fuzz/fuzz/fuzz_targets/random_import.rs (arbitrary
+bytes into import) + crates/fuzz/tests (minimized repros checked in).
+
+The mutator RECOMPUTES the envelope crc after corrupting the payload so
+mutations reach the inner decoders (binary columnar, block store,
+snapshot state tables) instead of dying at the checksum gate.  The
+contract under fuzz:
+  - import_ either succeeds or raises DecodeError (LoroError for
+    semantic rejections); never any other exception type;
+  - on failure the document is unmutated (deep value, vv, frontiers);
+  - the document still converges with a healthy peer afterwards.
+
+Unexpected failures are minimized (greedy chunk removal) and written to
+tests/repros/ — test_pinned_repros replays everything in that directory
+so fixed bugs stay fixed.
+"""
+import hashlib
+import os
+import random
+import zlib
+
+import pytest
+
+from loro_tpu import DecodeError, ExportMode, LoroDoc, LoroError
+
+REPRO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "repros")
+
+
+def _rich_doc(seed=0):
+    rng = random.Random(seed)
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    for d in (a, b):
+        t = d.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(0, 5, "bold", True)
+        d.get_map("m").set("k", {"nested": [1, 2, {"x": None}]})
+        d.get_list("l").push(1, "two", 3.0, True, None, b"bytes")
+        ml = d.get_movable_list("ml")
+        ml.push("a", "b", "c")
+        ml.move(0, 2)
+        ml.set(0, "B")
+        tr = d.get_tree("tree")
+        r = tr.create()
+        c = tr.create(r)
+        tr.move(c, None)
+        tr.delete(r)
+        d.get_counter("cnt").increment(2.5)
+        d.commit()
+    a.import_(b.export_updates(a.oplog_vv()))
+    b.import_(a.export_updates(b.oplog_vv()))
+    # a second epoch so updates-in-range / run-continuations exist
+    for d in (a, b):
+        d.get_text("t").insert(3, "X" * rng.randint(1, 9))
+        d.commit()
+    a.import_(b.export_updates(a.oplog_vv()))
+    return a
+
+
+def _corpus():
+    a = _rich_doc()
+    mid_vv = LoroDoc(peer=9).oplog_vv()  # empty vv
+    return [
+        a.export_updates(),
+        a.export(ExportMode.Snapshot),
+        a.export(ExportMode.StateOnly),
+        a.export(ExportMode.ShallowSnapshot(a.oplog_frontiers())),
+        a.export_updates(mid_vv),
+    ]
+
+
+def _fix_crc(blob: bytearray) -> bytes:
+    """Recompute the envelope crc so mutations reach inner decoders."""
+    if len(blob) >= 10:
+        crc = zlib.crc32(bytes(blob[10:]))
+        blob[6:10] = crc.to_bytes(4, "little")
+    return bytes(blob)
+
+
+def _mutate(rng: random.Random, blob: bytes) -> bytes:
+    b = bytearray(blob)
+    kind = rng.randrange(6)
+    if not b:
+        return bytes(b)
+    if kind == 0:  # bitflip(s)
+        for _ in range(rng.randint(1, 8)):
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+    elif kind == 1:  # byte overwrite with interesting values
+        i = rng.randrange(len(b))
+        b[i] = rng.choice([0x00, 0x01, 0x7F, 0x80, 0xFF, 0xFE])
+    elif kind == 2:  # truncate
+        b = b[: rng.randrange(len(b))]
+    elif kind == 3:  # insert junk
+        i = rng.randrange(len(b) + 1)
+        b[i:i] = bytes(rng.getrandbits(8) for _ in range(rng.randint(1, 6)))
+    elif kind == 4:  # delete a span
+        i = rng.randrange(len(b))
+        del b[i : i + rng.randint(1, 8)]
+    else:  # splice from another corpus blob
+        other = rng.choice(_MUT_CORPUS)
+        if other:
+            i = rng.randrange(len(b) + 1)
+            j = rng.randrange(len(other))
+            b[i : i + rng.randint(0, 16)] = other[j : j + rng.randint(1, 16)]
+    if rng.random() < 0.8:
+        return _fix_crc(b)
+    return bytes(b)
+
+
+_MUT_CORPUS = []
+
+
+def _doc_fingerprint(doc):
+    return (
+        doc.get_deep_value(),
+        dict(doc.oplog.vv.items()),
+        set(doc.oplog.frontiers),
+    )
+
+
+def _check_import(blob: bytes) -> None:
+    """The fuzz contract for one blob: against an EMPTY doc (snapshot
+    install paths incl. rollback) and a non-empty doc (update paths)."""
+    empty = LoroDoc(peer=76)
+    before_e = _doc_fingerprint(empty)
+    try:
+        empty.import_(blob)
+    except DecodeError:
+        assert _doc_fingerprint(empty) == before_e, (
+            "failed snapshot install mutated the empty doc"
+        )
+        assert empty.oplog.is_empty() and not empty.state.states
+    except LoroError:
+        pass
+
+    doc = LoroDoc(peer=77)
+    doc.get_text("pre").insert(0, "pre-existing")
+    doc.commit()
+    before = _doc_fingerprint(doc)
+    try:
+        doc.import_(blob)
+    except DecodeError:
+        after = _doc_fingerprint(doc)
+        assert after == before, "failed import mutated the doc"
+    except LoroError:
+        pass  # semantic rejection (e.g. shallow into non-empty): fine
+    # still functional: sync with a healthy peer
+    peer = LoroDoc(peer=78)
+    peer.get_text("pre").insert(0, "live")
+    peer.commit()
+    doc.import_(peer.export_updates(doc.oplog_vv()))
+    peer.import_(doc.export_updates(peer.oplog_vv()))
+    assert doc.get_deep_value() == peer.get_deep_value()
+
+
+def _minimize(blob: bytes, fails) -> bytes:
+    """Greedy chunk-removal ddmin-lite."""
+    cur = blob
+    chunk = max(1, len(cur) // 2)
+    while chunk >= 1:
+        i = 0
+        progressed = False
+        while i < len(cur):
+            cand = cur[:i] + cur[i + chunk :]
+            if fails(cand):
+                cur = cand
+                progressed = True
+            else:
+                i += chunk
+        if not progressed:
+            chunk //= 2
+    return cur
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_mutation_fuzz(seed):
+    rng = random.Random(1234 + seed)
+    corpus = _corpus()
+    global _MUT_CORPUS
+    _MUT_CORPUS = corpus
+    for _ in range(120):
+        base = rng.choice(corpus)
+        blob = _mutate(rng, base)
+        try:
+            _check_import(blob)
+        except AssertionError:
+            raise
+        except (DecodeError, LoroError):
+            raise  # _check_import already handles these; a leak is a bug
+        except Exception:
+            # unexpected exception type: minimize + pin the repro
+            def fails(cand):
+                try:
+                    _check_import(cand)
+                    return False
+                except (AssertionError, DecodeError, LoroError):
+                    return False
+                except Exception:
+                    return True
+
+            small = _minimize(blob, fails)
+            os.makedirs(REPRO_DIR, exist_ok=True)
+            name = hashlib.sha1(small).hexdigest()[:16] + ".bin"
+            with open(os.path.join(REPRO_DIR, name), "wb") as f:
+                f.write(small)
+            raise AssertionError(
+                f"non-typed import failure; minimized repro pinned at "
+                f"tests/repros/{name} ({len(small)} bytes)"
+            )
+
+
+def test_random_structured_headers():
+    """Valid envelope + random payloads of every mode byte: must raise
+    typed DecodeError, never anything else."""
+    rng = random.Random(7)
+    for _ in range(300):
+        mode = rng.randrange(0, 12)
+        payload = bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 80)))
+        blob = bytearray(b"LTPU" + bytes([2, mode]) + b"\0\0\0\0" + payload)
+        blob = _fix_crc(blob)
+        doc = LoroDoc(peer=5)
+        try:
+            doc.import_(blob)
+        except (DecodeError, LoroError):
+            pass
+        assert doc.oplog.is_empty()
+
+
+def test_pinned_repros():
+    """Replay every minimized repro in tests/repros/ — fixed decoder
+    bugs must stay fixed."""
+    if not os.path.isdir(REPRO_DIR):
+        pytest.skip("no repro corpus yet")
+    files = sorted(os.listdir(REPRO_DIR))
+    if not files:
+        pytest.skip("no repro corpus yet")
+    for name in files:
+        if name.startswith("."):
+            continue
+        with open(os.path.join(REPRO_DIR, name), "rb") as f:
+            blob = f.read()
+        _check_import(blob)

@@ -100,6 +100,30 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p,
             ctypes.c_longlong,
         ] + [ctypes.c_void_p] * 6 + [ctypes.c_longlong]
+        lib.loro_count_tree_ops.restype = ctypes.c_longlong
+        lib.loro_count_tree_ops.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ]
+        lib.loro_explode_tree.restype = ctypes.c_longlong
+        lib.loro_explode_tree.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ] + [ctypes.c_void_p] * 10 + [ctypes.c_longlong]
+        lib.loro_count_movable.restype = ctypes.c_longlong
+        lib.loro_count_movable.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ] + [ctypes.c_void_p] * 3
+        lib.loro_explode_movable.restype = ctypes.c_longlong
+        lib.loro_explode_movable.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ] + [ctypes.c_void_p] * 15 + [ctypes.c_longlong] * 3
         _lib = lib
         return lib
 
@@ -264,3 +288,97 @@ def decode_value_at(payload: bytes, offset: int, cids):
     r = Reader(payload)
     r.i = offset
     return _read_value(r, cids)
+
+
+def explode_tree_payload(payload: bytes, target_cid_index: int):
+    """All TreeMove rows of one container (wire order) as numpy
+    columns, or None when the native library is unavailable.  Peer
+    columns are WIRE indexes; positions are (offset, len) into the
+    payload."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.loro_count_tree_ops(payload, len(payload), target_cid_index)
+    if n < 0:
+        raise ValueError("native decode failed (malformed payload?)")
+    cols = {
+        "lamport": np.empty(n, np.int32),
+        "peer_idx": np.empty(n, np.int32),
+        "counter": np.empty(n, np.int32),
+        "target_peer_idx": np.empty(n, np.int32),
+        "target_ctr": np.empty(n, np.int32),
+        "flags": np.empty(n, np.int32),
+        "parent_peer_idx": np.empty(n, np.int32),
+        "parent_ctr": np.empty(n, np.int32),
+        "pos_off": np.empty(n, np.int64),
+        "pos_len": np.empty(n, np.int32),
+    }
+    wrote = lib.loro_explode_tree(
+        payload,
+        len(payload),
+        target_cid_index,
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in cols.values()],
+        n,
+    )
+    if wrote != n:
+        raise ValueError("native decode failed (count mismatch)")
+    return cols
+
+
+def explode_movable_payload(payload: bytes, target_cid_index: int):
+    """Slots / sets / delete spans of one MovableList container, or
+    None when unavailable.  Raises ValueError on malformed input or
+    out-of-payload references (caller falls back to Python).  Value
+    columns carry byte offsets; winners decode lazily."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_slots = ctypes.c_longlong()
+    n_sets = ctypes.c_longlong()
+    n_dels = ctypes.c_longlong()
+    rc = lib.loro_count_movable(
+        payload,
+        len(payload),
+        target_cid_index,
+        ctypes.byref(n_slots),
+        ctypes.byref(n_sets),
+        ctypes.byref(n_dels),
+    )
+    if rc < 0:
+        raise ValueError("native decode failed (malformed payload?)")
+    ns, nv, nd = n_slots.value, n_sets.value, n_dels.value
+    slots = {
+        "parent": np.empty(ns, np.int32),
+        "side": np.empty(ns, np.int32),
+        "peer_idx": np.empty(ns, np.int32),
+        "counter": np.empty(ns, np.int32),
+        "lamport": np.empty(ns, np.int32),
+        "elem_peer_idx": np.empty(ns, np.int32),
+        "elem_ctr": np.empty(ns, np.int32),
+    }
+    sets = {
+        "elem_peer_idx": np.empty(nv, np.int32),
+        "elem_ctr": np.empty(nv, np.int32),
+        "lamport": np.empty(nv, np.int32),
+        "peer_idx": np.empty(nv, np.int32),
+        "value_off": np.empty(nv, np.int64),
+    }
+    dels = {
+        "peer_idx": np.empty(nd, np.int32),
+        "start": np.empty(nd, np.int64),
+        "end": np.empty(nd, np.int64),
+    }
+    wrote = lib.loro_explode_movable(
+        payload,
+        len(payload),
+        target_cid_index,
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in slots.values()],
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in sets.values()],
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in dels.values()],
+        ns,
+        nv,
+        nd,
+    )
+    if wrote != ns:
+        raise ValueError("native decode failed (unresolvable refs or count mismatch)")
+    return {"slots": slots, "sets": sets, "dels": dels}

@@ -222,6 +222,22 @@ def peer_counter_perm(peer: np.ndarray, counter: np.ndarray, parent: np.ndarray)
     return perm, inv, out_parent.astype(np.int32)
 
 
+def wire_peer_ranks(peers_wire) -> np.ndarray:
+    """rank_of[wire_idx] -> sorted-u64 peer rank (the LWW/sibling
+    tie-break ordering contract; wire registration order must not
+    leak)."""
+    peer_u64 = np.asarray(peers_wire, np.uint64)
+    rank_of = np.empty(len(peers_wire), np.int64)
+    rank_of[np.argsort(peer_u64, kind="stable")] = np.arange(len(peers_wire))
+    return rank_of
+
+
+def pack_wire_ids(peer_idx, ctr) -> np.ndarray:
+    """(wire peer idx, counter) packed into i64 for vectorized id
+    dictionaries (peer indexes are small; counters non-negative)."""
+    return (np.asarray(peer_idx, np.int64) << 32) | np.asarray(ctr, np.int64)
+
+
 def extract_seq_from_payload(payload: bytes, cid: ContainerID) -> Optional[SeqExtract]:
     """Native-decoder fast path: binary updates payload -> SeqExtract
     without materializing Python Change objects (the fleet ingest path;

@@ -202,3 +202,101 @@ def extract_movable(changes, cid):
         set_valid=np.ones(k, bool),
     )
     return cols, elems, values
+
+
+class LazyPayloadValue:
+    """Undecoded value: payload bytes + offset (decoded only if it wins
+    the set-LWW — mirrors the map batch's lazy cells)."""
+
+    __slots__ = ("payload", "offset", "cids")
+
+    def __init__(self, payload: bytes, offset: int, cids):
+        self.payload = payload
+        self.offset = offset
+        self.cids = cids
+
+    def get(self):
+        from ..native import decode_value_at
+
+        return decode_value_at(self.payload, self.offset, self.cids)
+
+
+def extract_movable_from_payload(payload: bytes, cid):
+    """Native fast path: binary updates payload -> (MovableCols, elems,
+    values) with lazy value cells (same contract as extract_movable).
+    Returns None when the native library is unavailable; raises
+    ValueError on malformed payloads / out-of-payload references
+    (caller falls back to Python)."""
+    from ..codec.binary import read_tables
+    from ..native import available, explode_movable_payload
+
+    if not available():
+        return None
+    peers_wire, _keys, cids, _r = read_tables(payload)
+    try:
+        target = cids.index(cid)
+    except ValueError:
+        target = -1
+    if target < 0:
+        return extract_movable([], cid)
+    out = explode_movable_payload(payload, target)
+    sl, st, dl = out["slots"], out["sets"], out["dels"]
+    n = len(sl["parent"])
+    from .columnar import pack_wire_ids, wire_peer_ranks
+
+    rank_of = wire_peer_ranks(peers_wire)
+
+    # vectorized element dictionary over slot + set references: pack
+    # (wire peer idx, ctr) into i64 and unique+inverse
+    k = len(st["elem_peer_idx"])
+    se_packed = pack_wire_ids(sl["elem_peer_idx"], sl["elem_ctr"])
+    st_packed = pack_wire_ids(st["elem_peer_idx"], st["elem_ctr"])
+    uniq, inv = np.unique(np.concatenate([se_packed, st_packed]), return_inverse=True)
+    elems = [
+        (int(peers_wire[int(q) >> 32]), int(q) & 0xFFFFFFFF) for q in uniq
+    ]
+    slot_elem = inv[:n].astype(np.int32)
+    set_elem = inv[n:].astype(np.int32)
+
+    # tombstones: resolve delete spans through the packed slot id map
+    # (spans referencing slots outside the payload drop, matching the
+    # Python fallback's id2slot.get semantics)
+    deleted = np.zeros(n, bool)
+    if n:
+        slot_packed = pack_wire_ids(sl["peer_idx"], sl["counter"])
+        slot_order = np.argsort(slot_packed, kind="stable")
+        slot_sorted = slot_packed[slot_order]
+        for j in range(len(dl["peer_idx"])):
+            dp = np.int64(int(dl["peer_idx"][j])) << 32
+            span = np.arange(int(dl["start"][j]), int(dl["end"][j]), dtype=np.int64) | dp
+            pos = np.searchsorted(slot_sorted, span)
+            pos = np.clip(pos, 0, n - 1)
+            hit = slot_sorted[pos] == span
+            deleted[slot_order[pos[hit]]] = True
+
+    from .columnar import peer_counter_perm
+
+    slot_rank = rank_of[sl["peer_idx"]].astype(np.int64) if n else np.zeros(0, np.int64)
+    perm, _inv, parent = peer_counter_perm(slot_rank, sl["counter"], sl["parent"])
+    from .fugue_batch import SeqColumns
+
+    seq = SeqColumns(
+        parent=parent.astype(np.int32),
+        side=sl["side"][perm].astype(np.int32),
+        peer=slot_rank[perm].astype(np.int32),
+        counter=sl["counter"][perm].astype(np.int32),
+        deleted=deleted[perm],
+        content=slot_elem[perm].astype(np.int32),
+        valid=np.ones(n, bool),
+    )
+    values = [LazyPayloadValue(payload, int(off), cids) for off in st["value_off"]]
+    cols = MovableCols(
+        seq=seq,
+        lamport=sl["lamport"][perm].astype(np.int32),
+        set_elem=set_elem,
+        set_lamport=st["lamport"].astype(np.int32),
+        set_peer=rank_of[st["peer_idx"]].astype(np.int32) if k else np.zeros(0, np.int32),
+        set_valid=np.ones(k, bool),
+        set_value=np.arange(k, dtype=np.int32),
+    )
+    return cols, elems, values

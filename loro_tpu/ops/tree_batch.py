@@ -168,3 +168,80 @@ def pad_tree_cols(cols: TreeOpCols, m: int) -> TreeOpCols:
         parent=pad(cols.parent, ROOT, np.int32),
         valid=pad(cols.valid, False, bool),
     )
+
+
+class _LazyPositions:
+    """Row-indexed fractional-index bytes, sliced from the payload on
+    demand (positions_of touches only effected rows — no per-row copy
+    for the losers)."""
+
+    __slots__ = ("payload", "off", "ln", "has")
+
+    def __init__(self, payload, off, ln, has):
+        self.payload = payload
+        self.off = off
+        self.ln = ln
+        self.has = has
+
+    def __len__(self):
+        return len(self.off)
+
+    def __getitem__(self, i):
+        if not self.has[i]:
+            return None
+        o = int(self.off[i])
+        return bytes(self.payload[o : o + int(self.ln[i])])
+
+    def __eq__(self, other):
+        return list(self) == list(other)
+
+
+def extract_tree_from_payload(payload: bytes, cid):
+    """Native fast path: binary updates payload -> (TreeOpCols, nodes,
+    row_positions) without Python Change objects (same contract as
+    extract_tree_ops).  Returns None when the native library is
+    unavailable; raises ValueError on malformed payloads."""
+    from ..codec.binary import read_tables
+    from ..native import available, explode_tree_payload
+
+    if not available():
+        return None
+    from ..core.ids import TreeID
+
+    peers_wire, _keys, cids, _r = read_tables(payload)
+    try:
+        target = cids.index(cid)
+    except ValueError:
+        return TreeOpCols(
+            target=np.zeros(0, np.int32),
+            parent=np.zeros(0, np.int32),
+            valid=np.zeros(0, bool),
+        ), [], []
+    out = explode_tree_payload(payload, target)
+    n = len(out["lamport"])
+    peer_u64 = np.asarray(peers_wire, np.uint64)
+    order = np.lexsort(
+        (out["counter"], peer_u64[out["peer_idx"]] if n else out["peer_idx"], out["lamport"])
+    )
+    tp = out["target_peer_idx"][order].astype(np.int64)
+    tc = out["target_ctr"][order].astype(np.int64)
+    fl = out["flags"][order]
+    pp = out["parent_peer_idx"][order].astype(np.int64)
+    pc = out["parent_ctr"][order].astype(np.int64)
+    po = out["pos_off"][order]
+    pl = out["pos_len"][order]
+    # vectorized node dictionary: pack (wire peer idx, ctr) into i64
+    # (peer indexes are small; counters non-negative), unique+inverse
+    from .columnar import pack_wire_ids
+
+    has_parent = (fl & 4) != 0
+    t_packed = pack_wire_ids(tp, tc)
+    p_packed = pack_wire_ids(pp[has_parent], pc[has_parent])
+    uniq, inv = np.unique(np.concatenate([t_packed, p_packed]), return_inverse=True)
+    nodes = [TreeID(int(peers_wire[int(k) >> 32]), int(k) & 0xFFFFFFFF) for k in uniq]
+    target_col = inv[:n].astype(np.int32)
+    parent_col = np.full(n, ROOT, np.int32)
+    parent_col[has_parent] = inv[n:].astype(np.int32)
+    parent_col[(fl & 2) != 0] = TRASH
+    cols = TreeOpCols(target=target_col, parent=parent_col, valid=np.ones(n, bool))
+    return cols, nodes, _LazyPositions(payload, po, pl, (fl & 8) != 0)

@@ -154,7 +154,14 @@ class Fleet:
                 # referencing elements outside it): python fallback
                 ex = None
             if ex is None:
-                ex = extract_seq_container(decode_changes(p), cid)
+                try:
+                    ex = extract_seq_container(decode_changes(p), cid)
+                except KeyError as e:
+                    raise ValueError(
+                        "payload is not self-contained (references elements "
+                        f"outside it: {e}); one-shot fleet merges need full-"
+                        "history payloads — use DeviceDocBatch for deltas"
+                    ) from e
             extracts.append(ex)
         return self.merge_text_docs(extracts)
 
@@ -235,12 +242,46 @@ class Fleet:
     def merge_movable_changes(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[list]:
         """Batched movable-list merge: per-doc change lists -> final
         value lists (one vmapped launch)."""
+        from ..ops.movable_batch import extract_movable
+
+        return self._merge_movable_extracted(
+            [extract_movable(chs, cid) for chs in docs_changes]
+        )
+
+    def merge_movable_payloads(self, payloads: Sequence[bytes], cid) -> List[list]:
+        """Native ingest: envelope-stripped update payloads -> C++
+        movable explode -> one launch.  Values decode lazily (winners
+        only); unresolvable payloads fall back to the Python decoder."""
+        from ..codec.binary import decode_changes
+        from ..ops.movable_batch import extract_movable, extract_movable_from_payload
+
+        extracts = []
+        for p in payloads:
+            try:
+                ex = extract_movable_from_payload(p, cid)
+            except ValueError:
+                ex = None
+            if ex is None:
+                try:
+                    ex = extract_movable(decode_changes(p), cid)
+                except KeyError as e:
+                    raise ValueError(
+                        "payload is not self-contained (references elements "
+                        f"outside it: {e}); one-shot fleet merges need full-"
+                        "history payloads — use DeviceDocBatch for deltas"
+                    ) from e
+            extracts.append(ex)
+        return self._merge_movable_extracted(extracts)
+
+    def _merge_movable_extracted(self, extracts) -> List[list]:
         import jax.numpy as jnp
 
         from ..ops.fugue_batch import SeqColumns, pad_bucket, pad_seq_columns
-        from ..ops.movable_batch import MovableCols, extract_movable, movable_merge_batch
-
-        extracts = [extract_movable(chs, cid) for chs in docs_changes]
+        from ..ops.movable_batch import (
+            LazyPayloadValue,
+            MovableCols,
+            movable_merge_batch,
+        )
         s = pad_bucket(max(1, max(c.seq.parent.shape[0] for c, _, _ in extracts)))
         k = pad_bucket(max(1, max(c.set_elem.shape[0] for c, _, _ in extracts)), floor=16)
         n_elems = pad_bucket(max(1, max(len(e) for _, e, _ in extracts)), floor=16)
@@ -297,7 +338,13 @@ class Fleet:
         results = []
         for i, (_, _, values) in enumerate(extracts):
             idxs = out[i, : counts[i]]
-            results.append([values[j] if j >= 0 else None for j in idxs])
+            row = []
+            for j in idxs:
+                v = values[j] if j >= 0 else None
+                if isinstance(v, LazyPayloadValue):
+                    v = v.get()  # winners only ever decode
+                row.append(v)
+            results.append(row)
         return results
 
     # ------------------------------------------------------------------
@@ -306,6 +353,33 @@ class Fleet:
     def merge_tree_changes(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[dict]:
         """Batched movable-tree merge: per-doc change lists -> parent
         maps {TreeID: parent TreeID | None} of alive nodes."""
+        from ..ops.tree_batch import extract_tree_ops
+
+        return self._merge_tree_extracted(
+            [extract_tree_ops(chs, cid) for chs in docs_changes]
+        )
+
+    def merge_tree_payloads(self, payloads: Sequence[bytes], cid) -> List[dict]:
+        """Native ingest: envelope-stripped update payloads -> C++ tree
+        explode -> one launch (no per-op Python objects).  Falls back to
+        the Python decoder per payload on unresolvable input."""
+        from ..codec.binary import decode_changes
+        from ..ops.tree_batch import extract_tree_from_payload, extract_tree_ops
+
+        extracted = []
+        for p in payloads:
+            try:
+                ex = extract_tree_from_payload(p, cid)
+            except ValueError:
+                ex = None
+            if ex is None:
+                # tree ops carry no intra-payload row references, so the
+                # Python fallback is total
+                ex = extract_tree_ops(decode_changes(p), cid)
+            extracted.append(ex)
+        return self._merge_tree_extracted(extracted)
+
+    def _merge_tree_extracted(self, extracted) -> List[dict]:
         import jax.numpy as jnp
 
         from ..ops.fugue_batch import pad_bucket
@@ -314,13 +388,11 @@ class Fleet:
             ROOT,
             TRASH,
             TreeOpCols,
-            extract_tree_ops,
             is_deleted_batch,
             pad_tree_cols,
             tree_merge_batch,
         )
 
-        extracted = [extract_tree_ops(chs, cid) for chs in docs_changes]
         m = pad_bucket(max(1, max(c.target.shape[0] for c, _, _ in extracted)), floor=16)
         n = max(1, max(len(nodes) for _, nodes, _ in extracted))
         d = len(extracted)

@@ -262,3 +262,92 @@ class TestNativeDecoder:
         t_py = time.perf_counter() - t0
         _assert_same(ex_py, ex_nat)
         assert t_nat < t_py, f"native {t_nat*1e3:.1f}ms not faster than python {t_py*1e3:.1f}ms"
+
+
+class TestNativeTreeMovable:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_tree_payload_matches_python(self, seed):
+        """Native tree explode vs Python extraction vs host state."""
+        from loro_tpu.parallel.fleet import Fleet
+
+        rng = random.Random(200 + seed)
+        docs = [LoroDoc(peer=i + 1) for i in range(2)]
+        for epoch in range(4):
+            for d in docs:
+                tr = d.get_tree("tr")
+                ns = tr.nodes()
+                r = rng.random()
+                if not ns or r < 0.4:
+                    tr.create(rng.choice(ns) if ns and rng.random() < 0.5 else None)
+                elif r < 0.6:
+                    try:
+                        tr.move(rng.choice(ns), rng.choice(ns + [None]))
+                    except Exception:
+                        pass
+                elif r < 0.8:
+                    tr.delete(rng.choice(ns))
+                else:
+                    try:
+                        tr.move(rng.choice(ns), rng.choice(ns + [None]), index=0)
+                    except Exception:
+                        pass
+                d.commit()
+            docs[0].import_(docs[1].export_updates(docs[0].oplog_vv()))
+            docs[1].import_(docs[0].export_updates(docs[1].oplog_vv()))
+        cid = docs[0].get_tree("tr").id
+        fleet = Fleet()
+        payloads = [_payload(d) for d in docs]
+        got_native = fleet.merge_tree_payloads(payloads, cid)
+        got_python = fleet.merge_tree_changes(
+            [d.oplog.changes_in_causal_order() for d in docs], cid
+        )
+        assert got_native == got_python
+        # host oracle
+        for i, d in enumerate(docs):
+            st = d.state.get(cid)
+            want = {
+                t: (None if st.nodes[t].parent is None else st.nodes[t].parent)
+                for t in st.nodes
+                if not st._is_deleted(t)
+            }
+            assert got_native[i] == want, f"seed {seed} doc {i}"
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_movable_payload_matches_python(self, seed):
+        """Native movable explode (lazy values) vs Python vs host."""
+        from loro_tpu.parallel.fleet import Fleet
+
+        rng = random.Random(300 + seed)
+        docs = [LoroDoc(peer=i + 1) for i in range(2)]
+        for d in docs:
+            d.get_movable_list("ml").push("seed0", "seed1")
+            d.commit()
+        docs[0].import_(docs[1].export_updates(docs[0].oplog_vv()))
+        docs[1].import_(docs[0].export_updates(docs[1].oplog_vv()))
+        for epoch in range(4):
+            for d in docs:
+                ml = d.get_movable_list("ml")
+                n = len(ml)
+                r = rng.random()
+                if n == 0 or r < 0.35:
+                    ml.insert(rng.randint(0, n), {"v": rng.randint(0, 99)})
+                elif r < 0.55:
+                    ml.move(rng.randint(0, n - 1), rng.randint(0, n - 1))
+                elif r < 0.75:
+                    ml.set(rng.randint(0, n - 1), rng.randint(100, 199))
+                else:
+                    ml.delete(rng.randint(0, n - 1), 1)
+                d.commit()
+            docs[0].import_(docs[1].export_updates(docs[0].oplog_vv()))
+            docs[1].import_(docs[0].export_updates(docs[1].oplog_vv()))
+        cid = docs[0].get_movable_list("ml").id
+        fleet = Fleet()
+        payloads = [_payload(d) for d in docs]
+        got_native = fleet.merge_movable_payloads(payloads, cid)
+        got_python = fleet.merge_movable_changes(
+            [d.oplog.changes_in_causal_order() for d in docs], cid
+        )
+        assert got_native == got_python
+        for i, d in enumerate(docs):
+            want = d.get_movable_list("ml").get_value()
+            assert got_native[i] == want, f"seed {seed} doc {i}"

@@ -67,6 +67,48 @@ def counter_merge_batch(slot, delta, valid, n_slots: int):
     return jax.vmap(lambda s, d, v: counter_merge_doc(s, d, v, n_slots))(slot, delta, valid)
 
 
+def make_lww_sharded(mesh, n_slots: int):
+    """Op-axis-sharded LWW merge (SURVEY.md §2.4 item 2: "sequence
+    parallelism" for very large imports).  Each (docs, ops) shard
+    computes per-slot partial winners with the same three scatter-max
+    passes as lww_merge_doc; partials combine across the ops axis with
+    three pmax collectives over the lexicographic (lamport, peer,
+    value) order.  Returns a jitted fn: MapOpCols [D, M] sharded
+    P(docs, ops) -> (value_idx, lamport, peer) [D, S] P(docs)."""
+    from jax.sharding import PartitionSpec as P
+
+    try:  # jax >= 0.8
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..parallel.mesh import DOC_AXIS, OP_AXIS
+
+    def local(cols: MapOpCols):
+        def per_doc(c: MapOpCols):
+            v, l, p = lww_merge_doc(c, n_slots)
+            return v, l, p
+
+        val, lam, peer = jax.vmap(per_doc)(cols)
+        # cross-shard lexicographic argmax, one field at a time
+        g_lam = jax.lax.pmax(lam, OP_AXIS)
+        peer_c = jnp.where(lam == g_lam, peer, NEG)
+        g_peer = jax.lax.pmax(peer_c, OP_AXIS)
+        val_c = jnp.where((lam == g_lam) & (peer == g_peer), val, jnp.int32(-2))
+        g_val = jax.lax.pmax(val_c, OP_AXIS)
+        g_val = jnp.where(g_lam == NEG, -2, g_val)
+        return g_val, g_lam, g_peer
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(MapOpCols(*([P(DOC_AXIS, OP_AXIS)] * 5)),),
+            out_specs=(P(DOC_AXIS), P(DOC_AXIS), P(DOC_AXIS)),
+        )
+    )
+
+
 class LwwResident(NamedTuple):
     """Device-resident per-(doc, slot) LWW winners.  Peers as u64 halves
     so no batch-wide rank dictionary is needed (append path)."""

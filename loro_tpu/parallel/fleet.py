@@ -28,7 +28,7 @@ from ..utils import tracing
 from ..ops.columnar import MapExtract, SeqExtract, extract_seq_container
 from ..ops.fugue_batch import SeqColumns, materialize_content_batch, pad_bucket
 from ..ops.lww import MapOpCols, lww_merge_doc
-from .mesh import DOC_AXIS, doc_sharding, make_mesh, replicated
+from .mesh import DOC_AXIS, OP_AXIS, doc_sharding, make_mesh, replicated
 
 
 @dataclass
@@ -532,13 +532,9 @@ class Fleet:
     # ------------------------------------------------------------------
     # LWW map merge
     # ------------------------------------------------------------------
-    def merge_map_docs(self, extracts: Sequence[MapExtract]) -> List[Dict[str, object]]:
-        """Resolve LWW winners for a batch of docs; returns per-doc
-        {key: value} for root map containers."""
-        m = pad_bucket(max(1, max(len(e.slot) for e in extracts)))
-        s = max(1, max(len(e.slots) for e in extracts))
-        d = len(extracts)
-        d_pad = _mesh_pad(self.mesh, d)
+    def _batch_map_cols(self, extracts: Sequence[MapExtract], m: int) -> MapOpCols:
+        """Stack per-doc MapExtract rows into padded [D, M] columns."""
+        d_pad = _mesh_pad(self.mesh, len(extracts))
 
         def col(rows_list, fill, dtype):
             out = np.full((d_pad, m), fill, dtype)
@@ -546,18 +542,27 @@ class Fleet:
                 out[i, : len(r)] = r
             return out
 
-        batched = MapOpCols(
+        return MapOpCols(
             slot=col([e.slot for e in extracts], 0, np.int32),
             lamport=col([e.lamport for e in extracts], 0, np.int32),
             peer=col([e.peer for e in extracts], 0, np.int32),
             value_idx=col([e.value_idx for e in extracts], 0, np.int32),
             valid=col([e.valid for e in extracts], False, bool),
         )
+
+    def merge_map_docs(self, extracts: Sequence[MapExtract]) -> List[Dict[str, object]]:
+        """Resolve LWW winners for a batch of docs; returns per-doc
+        {key: value} for root map containers."""
+        m = pad_bucket(max(1, max(len(e.slot) for e in extracts)))
+        s = max(1, max(len(e.slots) for e in extracts))
+        batched = self._batch_map_cols(extracts, m)
         sh = doc_sharding(self.mesh)
         batched = MapOpCols(*[jax.device_put(np.asarray(a), sh) for a in batched])
         fn = _lww_batch_fn(self.mesh, s)
         vi, _, _ = fn(batched)
-        vi = np.asarray(vi)
+        return self._map_winner_values(np.asarray(vi), extracts)
+
+    def _map_winner_values(self, vi: np.ndarray, extracts) -> List[Dict[str, object]]:
         out: List[Dict[str, object]] = []
         for i, e in enumerate(extracts):
             got: Dict[str, object] = {}
@@ -567,6 +572,25 @@ class Fleet:
                     got[key] = e.values[idx]
             out.append(got)
         return out
+
+    def merge_map_docs_sharded(self, extracts: Sequence[MapExtract]) -> List[Dict[str, object]]:
+        """Op-axis-sharded LWW merge for very large imports (SURVEY.md
+        §2.4 "sp"): op rows shard over the mesh's ops axis; per-shard
+        scatter-max partials combine with pmax collectives.  Requires a
+        Fleet built on a 2D mesh (make_mesh(op_parallel=k)).  Same
+        output contract as merge_map_docs."""
+        op_dim = self.mesh.shape[OP_AXIS]
+        if op_dim <= 1:
+            return self.merge_map_docs(extracts)
+        m = pad_bucket(max(1, max(len(e.slot) for e in extracts)))
+        m = ((m + op_dim - 1) // op_dim) * op_dim  # divisible by the op axis
+        s = max(1, max(len(e.slots) for e in extracts))
+        batched = self._batch_map_cols(extracts, m)
+        sh = NamedSharding(self.mesh, P(DOC_AXIS, OP_AXIS))
+        batched = MapOpCols(*[jax.device_put(np.asarray(a), sh) for a in batched])
+        fn = _lww_sharded_fn(self.mesh, s)
+        vi, _, _ = fn(batched)
+        return self._map_winner_values(np.asarray(vi), extracts)
 
 
 class DeviceDocBatch:
@@ -1136,6 +1160,13 @@ def _scatter_rows(state, blk, offsets):
     new_hi = jax.vmap(per_field)(key_hi, blk["key_hi"], blk["valid"], offsets)
     new_lo = jax.vmap(per_field)(key_lo, blk["key_lo"], blk["valid"], offsets)
     return type(cols)(**out), new_hi, new_lo
+
+
+@functools.lru_cache(maxsize=32)
+def _lww_sharded_fn(mesh, n_slots: int):
+    from ..ops.lww import make_lww_sharded
+
+    return make_lww_sharded(mesh, n_slots)
 
 
 @functools.lru_cache(maxsize=32)

@@ -121,3 +121,44 @@ def test_fleet_tree_children_order(fleet):
             if ch:
                 host[t] = ch
         assert got[i] == host, f"doc {i}"
+
+
+def test_fleet_map_op_axis_sharded():
+    """merge_map_docs_sharded on a 2D (docs x ops) mesh must agree with
+    the unsharded path and the host states (SURVEY.md 2.4 sp axis)."""
+    import numpy as np
+
+    from loro_tpu.ops.columnar import extract_map_ops
+
+    fleet2d = Fleet(make_mesh(op_parallel=2))
+    rng = random.Random(77)
+    docs = []
+    for i in range(5):
+        a, b = LoroDoc(peer=500 + 2 * i), LoroDoc(peer=501 + 2 * i)
+        for d in (a, b):
+            m = d.get_map("m")
+            for _ in range(rng.randint(3, 30)):
+                if rng.random() < 0.2:
+                    m.delete(rng.choice("abcdef"))
+                else:
+                    m.set(rng.choice("abcdef"), rng.randint(0, 999))
+            d.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        docs.append(a)
+    extracts = [extract_map_ops(d.oplog.changes_in_causal_order()) for d in docs]
+    got_sharded = fleet2d.merge_map_docs_sharded(extracts)
+    got_plain = fleet2d.merge_map_docs(extracts)
+    assert got_sharded == got_plain
+    for i, d in enumerate(docs):
+        assert got_sharded[i] == d.get_map("m").get_value(), f"doc {i}"
+
+
+def test_fleet_map_sharded_falls_back_on_1d_mesh(fleet):
+    from loro_tpu.ops.columnar import extract_map_ops
+
+    a = LoroDoc(peer=900)
+    a.get_map("m").set("k", 1)
+    a.commit()
+    ex = [extract_map_ops(a.oplog.changes_in_causal_order())]
+    assert fleet.merge_map_docs_sharded(ex) == fleet.merge_map_docs(ex)

@@ -90,7 +90,10 @@ def bench_tree() -> None:
         target=target, parent=parent, valid=np.ones((docs, m), bool)
     )
     dev = TreeOpCols(*[jax.device_put(a) for a in cols])
-    d_max = int(os.environ.get("BENCH_TREE_DEPTH", "64"))
+    # sound default (d_max = n_nodes): the early-exit cycle walk costs
+    # actual chain depth, so no depth-cap crutch is needed
+    d_max = os.environ.get("BENCH_TREE_DEPTH")
+    d_max = int(d_max) if d_max else None
     out = tree_merge_batch(dev, n_nodes, d_max)
     jax.block_until_ready(out)
     t0 = time.perf_counter()

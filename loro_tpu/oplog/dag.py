@@ -180,28 +180,58 @@ class AppDag:
         return vv
 
     def frontiers_to_vv(self, f: Frontiers) -> VersionVector:
-        """reference: loro_dag.rs:1192."""
+        """reference: loro_dag.rs:1192.  Memoized: the dag is
+        append-only, so a frontier's closure never changes once all its
+        heads exist."""
         if f == self.shallow_since_frontiers and not f.is_empty():
             return self.shallow_since_vv.copy()
+        cache = getattr(self, "_f2vv_cache", None)
+        if cache is None:
+            cache = self._f2vv_cache = {}
+        hit = cache.get(f)
+        if hit is not None:
+            return hit.copy()
         vv = VersionVector()
         vv.merge(self.shallow_since_vv)
         for id in f:
             vv.merge(self.id_vv(id))
+        if len(cache) >= 64:
+            cache.pop(next(iter(cache)))
+        cache[f] = vv.copy()
         return vv
 
     def vv_to_frontiers(self, vv: VersionVector) -> Frontiers:
         """reference: loro_dag.rs:1269.  Heads = last id per peer that is
-        not dominated by another head's closure."""
+        not dominated by another head's closure.
+
+        Dominance probes the CACHED node closures directly (no per-pair
+        VV copies): a mid-span id's cross-peer closure equals its
+        node's — RLE merge only absorbs dep-on-self extensions, so a
+        merged node's deps all hang off its first change."""
         cands: List[ID] = []
         for p, c in vv.items():
             if c > 0:
                 cands.append(ID(p, c - 1))
-        # drop candidates strictly included in another candidate's closure
+        if len(cands) <= 1:
+            return Frontiers(cands)
+        nodes = [self.node_at(i) for i in cands]
         heads = []
         for i, id in enumerate(cands):
-            dominated = any(
-                i != j and self.id_vv(other).includes(id) for j, other in enumerate(cands)
-            )
+            dominated = False
+            for j, other in enumerate(cands):
+                if i == j:
+                    continue
+                n = nodes[j]
+                if n is None:
+                    # other is at/below the shallow root: its closure is
+                    # within shallow_since_vv, which every candidate vv
+                    # already includes — cannot dominate a live head
+                    continue
+                closure = self.node_vv(n)
+                cover = closure.get(id.peer)
+                if id.counter < cover:
+                    dominated = True
+                    break
             if not dominated:
                 heads.append(id)
         return Frontiers(heads)

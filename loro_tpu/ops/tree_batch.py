@@ -57,14 +57,26 @@ def tree_merge_doc(
     def step(state, mv):
         t, p, v = mv
 
-        # cycle check: does walking up from p reach t?
-        def walk(_, carry):
-            cur, hit = carry
-            hit = hit | (cur == t)
-            nxt = jnp.where(cur >= 0, state[jnp.clip(cur, 0, n_nodes - 1)], jnp.int32(ROOT - 10))
-            return nxt, hit
+        # cycle check: does walking up from p reach t?  Early-exit
+        # while_loop — cost follows the ACTUAL ancestor-chain depth,
+        # not the d_max bound (the sound default d_max = n_nodes is
+        # only the worst-case cap; typical trees walk O(depth) steps)
+        def cond(carry):
+            cur, hit, steps = carry
+            return (cur >= 0) & ~hit & (steps < d_max)
 
-        _, cycle = jax.lax.fori_loop(0, d_max, walk, (p, jnp.bool_(False)))
+        def walk(carry):
+            cur, hit, steps = carry
+            hit = hit | (cur == t)
+            nxt = jnp.where(
+                hit, jnp.int32(ROOT - 10), state[jnp.clip(cur, 0, n_nodes - 1)]
+            )
+            return nxt, hit, steps + 1
+
+        cur, cycle, _ = jax.lax.while_loop(
+            cond, walk, (p, jnp.bool_(False), jnp.int32(0))
+        )
+        cycle = cycle | (cur == t)
         ok = v & ~(cycle & (p >= 0))
         new_state = jnp.where(
             ok, state.at[jnp.clip(t, 0, n_nodes - 1)].set(p), state

@@ -761,11 +761,7 @@ class LoroDoc:
 
     def _install_shallow_base(self, state_bytes: bytes, vv: VersionVector, f: Frontiers) -> None:
         self._shallow_base = (state_bytes, vv.copy(), f)
-        dag = self.oplog.dag
-        dag.shallow_since_vv = vv.copy()
-        dag.shallow_since_frontiers = f
-        dag.vv = vv.copy()
-        dag.frontiers = f
+        self.oplog.dag.set_shallow_root(vv, f)
 
     def _import_changes(self, changes: List[Change], origin: str) -> ImportStatus:
         with tracing.span("oplog.import", n_changes=len(changes)):

@@ -200,6 +200,18 @@ class AppDag:
         cache[f] = vv.copy()
         return vv
 
+    def set_shallow_root(self, vv: VersionVector, f: Frontiers) -> None:
+        """Install the shallow replay floor.  Clears the frontier-
+        closure memo — cached closures were computed against the old
+        floor."""
+        self.shallow_since_vv = vv.copy()
+        self.shallow_since_frontiers = f
+        self.vv = vv.copy()
+        self.frontiers = f
+        cache = getattr(self, "_f2vv_cache", None)
+        if cache:
+            cache.clear()
+
     def vv_to_frontiers(self, vv: VersionVector) -> Frontiers:
         """reference: loro_dag.rs:1269.  Heads = last id per peer that is
         not dominated by another head's closure.
@@ -208,6 +220,11 @@ class AppDag:
         VV copies): a mid-span id's cross-peer closure equals its
         node's — RLE merge only absorbs dep-on-self extensions, so a
         merged node's deps all hang off its first change."""
+        if len(self.shallow_since_vv) and vv <= self.shallow_since_vv:
+            # at/below the replay floor: the floor's own frontiers are
+            # the only representable heads (per-peer last ids would
+            # reference ids outside the dag)
+            return self.shallow_since_frontiers
         cands: List[ID] = []
         for p, c in vv.items():
             if c > 0:

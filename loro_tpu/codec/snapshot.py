@@ -28,8 +28,9 @@ from .binary import Reader, Writer, _Dicts, _read_cid, _read_value, _write_cid, 
 S_MAP, S_SEQ, S_MOVABLE, S_TREE, S_COUNTER, S_UNKNOWN = range(6)
 
 # bump on any incompatible state-table layout change (v2: per-element
-# deleted_by records; v3: movable-list slot/set histories)
-STATE_FORMAT = 3
+# deleted_by records; v3: movable-list slot/set histories; v4:
+# per-container byte-length table for lazy hydration)
+STATE_FORMAT = 4
 
 # element content tags for sequence states
 E_CHAR, E_VALUE, E_ANCHOR, E_ELEMREF = range(4)
@@ -290,8 +291,11 @@ def encode_doc_state(doc_state, parents: Dict) -> bytes:
     items = sorted(doc_state.states.items(), key=lambda kv: kv[0]._key())
     for cid, st in items:
         d.cid(cid)
+    seg_lens = []
     for cid, st in items:
+        before = len(scratch.buf)
         encode_container_state(scratch, d, st)
+        seg_lens.append(len(scratch.buf) - before)
     # parent links (for event paths after fast import)
     pw = Writer()
     links = [(c, p, k) for c, (p, k) in parents.items()]
@@ -326,13 +330,24 @@ def encode_doc_state(doc_state, parents: Dict) -> bytes:
     w.varint(len(items))
     for cid, _ in items:
         w.varint(d.cid(cid))
+    # per-container byte lengths: lets the decoder hydrate containers
+    # lazily (reference: container_store.rs per-container kv entries)
+    for n in seg_lens:
+        w.varint(n)
     w.buf += scratch.buf
     w.buf += pw.buf
     return bytes(w.buf)
 
 
 def decode_doc_state(buf: bytes):
-    """Returns (states dict, parents dict)."""
+    """Returns (states, parents).  `states` is a StateTable whose
+    container payloads decode on first access — importing a snapshot
+    with many containers touches none of them until read (reference:
+    container_store.rs lazy per-container entries).  Deferred decode
+    failures surface as typed DecodeError at the read site (same
+    contract as the change store's lazy blocks)."""
+    from ..state import StateTable
+
     r = Reader(buf)
     fmt = r.u8()
     if fmt != STATE_FORMAT:
@@ -341,9 +356,19 @@ def decode_doc_state(buf: bytes):
     keys = [r.str_() for _ in range(r.varint())]
     cids = [_read_cid(r, peers) for _ in range(r.varint())]
     order = [cids[r.varint()] for _ in range(r.varint())]
-    states = {}
-    for cid in order:
-        states[cid] = decode_container_state(r, cid, peers, keys, cids)
+    seg_lens = [r.varint() for _ in range(len(order))]
+    states = StateTable()
+    for cid, ln in zip(order, seg_lens):
+        if r.i + ln > len(buf):
+            raise ValueError("truncated container state segment")
+        seg = buf[r.i : r.i + ln]
+        r.i += ln
+
+        def thunk(seg=seg, cid=cid):
+            rr = Reader(seg)
+            return decode_container_state(rr, cid, peers, keys, cids)
+
+        states.put_cold(cid, thunk)
     parents = {}
     for _ in range(r.varint()):
         c = cids[r.varint()]

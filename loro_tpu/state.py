@@ -34,6 +34,81 @@ _STATE_BY_TYPE = {
 }
 
 
+class StateTable(dict):
+    """Container states with lazy per-container hydration (reference:
+    container_store.rs — states decode from their kv entries on first
+    access).  Keys are always present (iteration/`in` never hydrates);
+    values decode on first read.  `hydrated` counts decodes — tests
+    assert laziness with it."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._thunks: Dict[ContainerID, Any] = {}
+        self.hydrated = 0
+
+    def put_cold(self, cid: ContainerID, thunk) -> None:
+        super().__setitem__(cid, None)
+        self._thunks[cid] = thunk
+
+    def _hydrate(self, cid: ContainerID):
+        from .errors import DecodeError
+
+        th = self._thunks[cid]
+        try:
+            st = th()
+        except DecodeError:
+            raise  # keep the thunk: the error repeats, data never drops
+        except Exception as e:
+            raise DecodeError(f"malformed container state for {cid}: {e}") from e
+        self._thunks.pop(cid, None)
+        self.hydrated += 1
+        super().__setitem__(cid, st)
+        return st
+
+    def __getitem__(self, cid):
+        v = super().__getitem__(cid)
+        if v is None and cid in self._thunks:
+            v = self._hydrate(cid)
+        return v
+
+    def get(self, cid, default=None):
+        if cid not in self:
+            return default
+        return self[cid]
+
+    def __setitem__(self, cid, st) -> None:
+        self._thunks.pop(cid, None)
+        super().__setitem__(cid, st)
+
+    def values(self):
+        return [self[c] for c in self]
+
+    def items(self):
+        return [(c, self[c]) for c in self]
+
+    def pop(self, cid, *a):
+        self._thunks.pop(cid, None)
+        return super().pop(cid, *a)
+
+    # dict C fast paths would leak the None placeholders: route the
+    # remaining mutation/copy surface through hydration
+    def copy(self):
+        return {c: self[c] for c in self}
+
+    def setdefault(self, cid, default=None):
+        if cid in self:
+            return self[cid]
+        self[cid] = default
+        return default
+
+    def update(self, other=(), **kw):
+        items = other.items() if hasattr(other, "items") else other
+        for k, v in items:
+            self[k] = v
+        for k, v in kw.items():
+            self[k] = v
+
+
 class DocState:
     def __init__(self) -> None:
         self.states: Dict[ContainerID, ContainerState] = {}

@@ -248,3 +248,44 @@ class TestStateOnly:
         # legal Fugue parents for ops causally after the root), so the
         # win is history-meta removal, not tombstone pruning
         assert len(so) < len(full)
+
+
+class TestLazyContainerStates:
+    def test_snapshot_import_hydrates_on_demand(self):
+        """ContainerStore parity (reference container_store.rs): a fast
+        snapshot import decodes NO container state until one is read;
+        reading one container hydrates only it."""
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "hello")
+        a.get_map("m").set("k", 1)
+        a.get_list("l").push(1, 2, 3)
+        a.get_counter("c").increment(5)
+        a.commit()
+        blob = a.export(ExportMode.Snapshot)
+        b = LoroDoc(peer=2)
+        b.import_(blob)
+        assert b.state.states.hydrated == 0
+        assert set(b.state.states) == set(a.state.states)  # keys cheap
+        assert b.state.states.hydrated == 0
+        t = b.get_text("t")
+        assert t.to_string() == "hello"
+        assert b.state.states.hydrated == 1  # only the text state
+        assert b.get_deep_value() == a.get_deep_value()  # hydrates rest
+        assert b.state.states.hydrated == len(a.state.states)
+
+    def test_lazy_states_survive_edits_and_reexport(self):
+        a = LoroDoc(peer=1)
+        for i in range(5):
+            a.get_map(f"m{i}").set("k", i)
+        a.commit()
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.Snapshot))
+        b.get_map("m0").set("k2", "new")  # hydrates m0 only
+        b.commit()
+        assert b.state.states.hydrated == 1
+        blob2 = b.export(ExportMode.Snapshot)  # hydrates all (encode)
+        c = LoroDoc(peer=3)
+        c.import_(blob2)
+        want = a.get_deep_value()
+        want["m0"] = {"k": 0, "k2": "new"}
+        assert c.get_deep_value() == want

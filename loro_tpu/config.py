@@ -13,3 +13,7 @@ class Configure:
     hide_empty_root_containers: bool = False
     # style expand behavior per key: "after" (default), "before", "both", "none"
     text_style_config: Dict[str, str] = field(default_factory=dict)
+    # tree sibling positions: fractional indexes on create/move
+    # (reference: Tree::enable/disable_fractional_index)
+    fractional_index_enabled: bool = True
+    fractional_index_jitter: int = 0

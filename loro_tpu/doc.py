@@ -192,8 +192,16 @@ class LoroDoc:
         if txn is None or txn.is_empty():
             self._txn = None
             return
+        pend_msg = getattr(self, "_next_commit_message", None)
+        pend_origin = getattr(self, "_next_commit_origin", None)
+        self._next_commit_message = None
+        self._next_commit_origin = None
         if message is not None:
             txn.message = message
+        elif pend_msg is not None and txn.message is None:
+            txn.message = pend_msg
+        if not origin and pend_origin:
+            origin = pend_origin
         for cb in self._pre_commit_subs:
             cb(txn)
         change = txn.build_change()
@@ -1220,6 +1228,137 @@ class LoroDoc:
                 break
             ch = self.oplog.change_at(nxt)
         return out
+
+    def len_ops(self) -> int:
+        return self.oplog.total_ops()
+
+    def has_container(self, cid: Union[ContainerID, str]) -> bool:
+        if isinstance(cid, str):
+            cid = ContainerID.parse(cid)
+        return cid in self.state.states
+
+    def get_pending_txn_len(self) -> int:
+        return 0 if self._txn is None else self._txn.atom_len()
+
+    def delete_root_container(self, cid: Union[ContainerID, str]) -> None:
+        """Clear a root container's content so it reads as empty
+        (reference: LoroDoc::delete_root_container)."""
+        if isinstance(cid, str):
+            cid = ContainerID.parse(cid)
+        h = self.get_container(cid)
+        if cid.ctype == ContainerType.Tree:
+            for root in list(h.roots()):
+                h.delete(root)
+        elif cid.ctype == ContainerType.Counter:
+            v = h.get_value()
+            if v:
+                h.decrement(v)
+        elif hasattr(h, "clear"):
+            h.clear()
+        elif hasattr(h, "delete") and hasattr(h, "__len__"):
+            h.delete(0, len(h))
+        self.commit()
+
+    # -- shallow introspection (reference: is_shallow / shallow_since) -
+    def is_shallow(self) -> bool:
+        return self._shallow_base is not None
+
+    def shallow_since_vv(self) -> VersionVector:
+        return self.oplog.dag.shallow_since_vv.copy()
+
+    def shallow_since_frontiers(self) -> Frontiers:
+        return self.oplog.dag.shallow_since_frontiers
+
+    # -- version algebra (reference: cmp/minimize frontiers) -----------
+    def cmp_with_frontiers(self, f: Frontiers) -> int:
+        """Compare the doc version with `f`: -1 behind, 0 equal, 1
+        ahead (raises on concurrent — reference returns Ordering)."""
+        va = self.oplog.vv
+        vb = self.oplog.dag.frontiers_to_vv(f)
+        if va == vb:
+            return 0
+        if va <= vb:
+            return -1
+        if vb <= va:
+            return 1
+        raise LoroError("versions are concurrent")
+
+    def cmp_frontiers(self, a: Frontiers, b: Frontiers) -> Optional[int]:
+        """Partial compare of two frontiers: -1/0/1 or None when
+        concurrent (reference: LoroDoc::cmp_frontiers)."""
+        va = self.oplog.dag.frontiers_to_vv(a)
+        vb = self.oplog.dag.frontiers_to_vv(b)
+        if va == vb:
+            return 0
+        if va <= vb:
+            return -1
+        if vb <= va:
+            return 1
+        return None
+
+    def minimize_frontiers(self, f: Frontiers) -> Frontiers:
+        """Drop heads dominated by other heads' closures."""
+        return self.oplog.dag.vv_to_frontiers(self.oplog.dag.frontiers_to_vv(f))
+
+    def find_id_spans_between(self, from_f: Frontiers, to_f: Frontiers) -> VersionRange:
+        """Per-peer id spans in to_f's closure but not from_f's
+        (reference: LoroDoc::find_id_spans_between)."""
+        va = self.oplog.dag.frontiers_to_vv(from_f)
+        vb = self.oplog.dag.frontiers_to_vv(to_f)
+        out = VersionRange()
+        for p in vb:
+            lo, hi = va.get(p), vb.get(p)
+            if hi > lo:
+                out.extend_to_include(IdSpan(p, lo, hi))
+        return out
+
+    # -- commit options / config sugar ---------------------------------
+    def set_next_commit_message(self, message: str) -> None:
+        """Message for the NEXT non-empty commit (stored on the doc, not
+        an eager empty transaction — empty txns are discarded by any
+        implicit commit and would go stale across set_peer_id)."""
+        self._next_commit_message = message
+
+    def set_next_commit_origin(self, origin: str) -> None:
+        self._next_commit_origin = origin
+
+    def set_record_timestamp(self, record: bool) -> None:
+        self.config.record_timestamp = record
+
+    def set_change_merge_interval(self, interval_s: int) -> None:
+        self.config.merge_interval_s = interval_s
+
+    set_merge_interval = set_change_merge_interval
+
+    def compact_change_store(self) -> None:
+        """Push hot decoded history back into sealed compressed blocks
+        and free the Change objects (reference:
+        LoroDoc::compact_change_store)."""
+        self.commit()
+        self.oplog.compact()
+
+    @staticmethod
+    def decode_import_blob_meta(blob: bytes) -> Dict[str, Any]:
+        """Inspect a blob without importing it (reference:
+        LoroDoc::decode_import_blob_meta): mode, format version, and for
+        update payloads the per-peer span range + change count."""
+        from .codec import binary as bcodec
+
+        version, mode, payload = parse_envelope_header(blob)
+        meta: Dict[str, Any] = {"mode": mode.name, "version": version}
+        if mode in (EncodeMode.ColumnarUpdates, EncodeMode.ColumnarSnapshot):
+            start = VersionRange()
+            changes = bcodec.decode_changes(payload)
+            end_vv = VersionVector()
+            n = 0
+            for ch in changes:
+                start.extend_to_include(IdSpan(ch.peer, ch.ctr_start, ch.ctr_end))
+                end_vv.set_end(ch.peer, max(end_vv.get(ch.peer), ch.ctr_end))
+                n += 1
+            meta["change_num"] = n
+            meta["partial_start_vv"] = {p: s for p, (s, _e) in start.items()}
+            meta["partial_end_vv"] = dict(end_vv.items())
+        return meta
 
     def diagnose_size(self) -> Dict[str, int]:
         return self.oplog.diagnose_size()

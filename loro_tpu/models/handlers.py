@@ -20,7 +20,7 @@ from ..core.change import (
     StyleAnchor,
     TreeMove,
 )
-from ..core.ids import ContainerID, ContainerType, TreeID
+from ..core.ids import ContainerID, ContainerType, ID, TreeID
 from ..utils.fractional_index import key_between
 from ..core.value import validate_value
 
@@ -91,33 +91,48 @@ class TextHandler(Handler):
 
     # -- utf16 index space (JS interop; reference tracks unicode/utf16/
     # utf8/entity lengths per rope node) ------------------------------
-    def len_utf16(self) -> int:
-        return sum(1 + (ord(e.content) > 0xFFFF) for e in self._state.seq.visible_elems())
+    @staticmethod
+    def _w16(ch: str) -> int:
+        return 1 + (ord(ch) > 0xFFFF)
 
-    def utf16_to_unicode(self, u16: int) -> int:
-        """Convert a utf16 offset to a codepoint position.  Offsets
-        landing inside a surrogate pair are rejected (the reference
-        errors on non-boundary utf16 indices rather than silently
-        snapping — a JS peer's bug must not become data loss)."""
+    @staticmethod
+    def _w8(ch: str) -> int:
+        return len(ch.encode())
+
+    def _width_len(self, width) -> int:
+        return sum(width(e.content) for e in self._state.seq.visible_elems())
+
+    def _offset_to_unicode(self, off: int, width, space: str) -> int:
+        """Convert a unit offset in a variable-width index space to a
+        codepoint position.  Offsets landing inside a unit (surrogate
+        pair / multi-byte codepoint) are rejected — the reference errors
+        on non-boundary indices rather than silently snapping (a JS
+        peer's bug must not become data loss)."""
         acc = 0
         for i, e in enumerate(self._state.seq.visible_elems()):
-            if acc == u16:
+            if acc == off:
                 return i
-            if acc > u16:
-                raise IndexError(f"utf16 pos {u16} is inside a surrogate pair")
-            acc += 1 + (ord(e.content) > 0xFFFF)
-        if acc < u16:
-            raise IndexError(f"utf16 pos {u16} > len {acc}")
-        if acc > u16:
-            raise IndexError(f"utf16 pos {u16} is inside a surrogate pair")
+            if acc > off:
+                raise IndexError(f"{space} pos {off} is inside a unit boundary")
+            acc += width(e.content)
+        if acc < off:
+            raise IndexError(f"{space} pos {off} > len {acc}")
+        if acc > off:
+            raise IndexError(f"{space} pos {off} is inside a unit boundary")
         return len(self._state)
+
+    def len_utf16(self) -> int:
+        return self._width_len(self._w16)
+
+    def utf16_to_unicode(self, u16: int) -> int:
+        return self._offset_to_unicode(u16, self._w16, "utf16")
 
     def unicode_to_utf16(self, pos: int) -> int:
         acc = 0
         for i, e in enumerate(self._state.seq.visible_elems()):
             if i >= pos:
                 return acc
-            acc += 1 + (ord(e.content) > 0xFFFF)
+            acc += self._w16(e.content)
         if pos > len(self._state):
             raise IndexError(pos)
         return acc
@@ -246,6 +261,140 @@ class TextHandler(Handler):
             if tag in ("replace", "insert"):
                 self.insert(i1, new_text[j1:j2])
 
+    def update_by_line(self, new_text: str) -> None:
+        """Line-granular minimal-diff update (reference:
+        Text::update_by_line) — cheaper than char-level Myers on large
+        texts and keeps whole-line edits as single splices."""
+        old_lines = self.to_string().splitlines(keepends=True)
+        new_lines = new_text.splitlines(keepends=True)
+        if old_lines == new_lines:
+            return
+        starts = [0]
+        for ln in old_lines:
+            starts.append(starts[-1] + len(ln))
+        sm = difflib.SequenceMatcher(a=old_lines, b=new_lines, autojunk=False)
+        ops = [op for op in sm.get_opcodes() if op[0] != "equal"]
+        for tag, i1, i2, j1, j2 in reversed(ops):
+            if tag in ("replace", "delete"):
+                self.delete(starts[i1], starts[i2] - starts[i1])
+            if tag in ("replace", "insert"):
+                self.insert(starts[i1], "".join(new_lines[j1:j2]))
+
+    # -- quill-style deltas (reference: Text::to_delta / apply_delta /
+    # slice_delta) ----------------------------------------------------
+    def to_delta(self) -> List[dict]:
+        """Styled segments as quill-style ops: [{"insert": str,
+        "attributes": {...}?}, ...]."""
+        out = []
+        for seg in self.get_richtext_value():
+            item = {"insert": seg["insert"]}
+            if seg.get("attributes"):
+                item["attributes"] = dict(seg["attributes"])
+            out.append(item)
+        return out
+
+    def slice_delta(self, start: int, end: int) -> List[dict]:
+        """to_delta() restricted to the unicode range [start, end)."""
+        out: List[dict] = []
+        pos = 0
+        for seg in self.to_delta():
+            s = seg["insert"]
+            seg_start, seg_end = pos, pos + len(s)
+            pos = seg_end
+            lo, hi = max(seg_start, start), min(seg_end, end)
+            if lo >= hi:
+                continue
+            item = {"insert": s[lo - seg_start : hi - seg_start]}
+            if seg.get("attributes"):
+                item["attributes"] = dict(seg["attributes"])
+            out.append(item)
+        return out
+
+    def apply_delta(self, items: List[dict]) -> None:
+        """Apply a quill-style delta: [{"retain": n, "attributes"?},
+        {"insert": s, "attributes"?}, {"delete": n}] (reference:
+        Text::apply_delta)."""
+        pos = 0
+        for it in items:
+            if "retain" in it:
+                n = it["retain"]
+                attrs = it.get("attributes") or {}
+                for k, v in attrs.items():
+                    if v is None:
+                        self.unmark(pos, pos + n, k)
+                    else:
+                        self.mark(pos, pos + n, k, v)
+                pos += n
+            elif "insert" in it:
+                s = it["insert"]
+                self.insert(pos, s)
+                # the delta's attributes are authoritative for inserted
+                # text: neutralize styles inherited from surrounding
+                # anchor pairs too (same contract as doc.apply_diff)
+                st = self._state
+                elem = st.seq.elem_at(pos)
+                inherited = (
+                    st._styles_at_elem(elem)
+                    if (st.n_anchors and elem is not None)
+                    else {}
+                )
+                target = {
+                    k: v for k, v in (it.get("attributes") or {}).items() if v is not None
+                }
+                for k in set(inherited) | set(target):
+                    tv = target.get(k)
+                    if tv is None:
+                        self.unmark(pos, pos + len(s), k)
+                    elif inherited.get(k) != tv:
+                        self.mark(pos, pos + len(s), k, tv)
+                pos += len(s)
+            elif "delete" in it:
+                self.delete(pos, it["delete"])
+
+    # -- utf8 index space (reference tracks unicode/utf16/utf8 lengths
+    # per rope node) ---------------------------------------------------
+    def len_utf8(self) -> int:
+        return self._width_len(self._w8)
+
+    def utf8_to_unicode(self, b: int) -> int:
+        return self._offset_to_unicode(b, self._w8, "utf8")
+
+    def insert_utf8(self, b_pos: int, s: str) -> None:
+        self.insert(self.utf8_to_unicode(b_pos), s)
+
+    def delete_utf8(self, b_pos: int, b_len: int) -> None:
+        start = self.utf8_to_unicode(b_pos)
+        end = self.utf8_to_unicode(b_pos + b_len)
+        self.delete(start, end - start)
+
+    def mark_utf8(self, b_start: int, b_end: int, key: str, value: Any) -> None:
+        self.mark(self.utf8_to_unicode(b_start), self.utf8_to_unicode(b_end), key, value)
+
+    # -- utf16 mark/slice/splice (JS interop) -------------------------
+    def mark_utf16(self, u_start: int, u_end: int, key: str, value: Any) -> None:
+        self.mark(self.utf16_to_unicode(u_start), self.utf16_to_unicode(u_end), key, value)
+
+    def unmark_utf16(self, u_start: int, u_end: int, key: str) -> None:
+        self.mark_utf16(u_start, u_end, key, None)
+
+    def slice_utf16(self, u_start: int, u_end: int) -> str:
+        return self.slice(self.utf16_to_unicode(u_start), self.utf16_to_unicode(u_end))
+
+    def splice_utf16(self, u_pos: int, u_len: int, replacement: str = "") -> str:
+        start = self.utf16_to_unicode(u_pos)
+        end = self.utf16_to_unicode(u_pos + u_len)
+        return self.splice(start, end - start, replacement)
+
+    def get_id_at(self, pos: int) -> Optional[ID]:
+        """Op id of the character at unicode position `pos` (reference:
+        Text::get_id_at / get_editor_at_unicode_pos)."""
+        e = self._state.seq.elem_at(pos)
+        return e.id if e is not None else None
+
+    def get_editor_at_unicode_pos(self, pos: int) -> Optional[int]:
+        e = self._state.seq.elem_at(pos)
+        return e.peer if e is not None else None
+
 
 class ListHandler(Handler):
     CT = ContainerType.List
@@ -311,6 +460,9 @@ class ListHandler(Handler):
 
     def is_empty(self) -> bool:
         return len(self._state) == 0
+
+    def to_vec(self) -> List[Any]:
+        return self.get_value()
 
 
 class _ChildMarker:
@@ -450,6 +602,42 @@ class MovableListHandler(Handler):
         parent, side = st.seq.placement_for_visible_pos(anchor)
         self._apply(MovableMove(eid, parent, side))
 
+    def to_vec(self) -> List[Any]:
+        return self.get_value()
+
+    def mov(self, from_pos: int, to_pos: int) -> None:
+        self.move(from_pos, to_pos)
+
+    def push_container(self, ctype: ContainerType) -> Handler:
+        return self.insert_container(len(self._state), ctype)
+
+    # -- element attribution (reference: MovableList::get_creator_at /
+    # get_last_editor_at / get_last_mover_at) -------------------------
+    def _entry_at(self, pos: int):
+        slot = self._state.seq.elem_at(pos)
+        if slot is None:
+            return None, None
+        eid = slot.content
+        return eid, self._state.elems.get(eid)
+
+    def get_creator_at(self, pos: int) -> Optional[int]:
+        eid, entry = self._entry_at(pos)
+        return eid.peer if eid is not None else None
+
+    def get_last_editor_at(self, pos: int) -> Optional[int]:
+        """Peer of the winning set op (or the creator when never set)."""
+        eid, entry = self._entry_at(pos)
+        if entry is None:
+            return eid.peer if eid is not None else None
+        return entry.value_key[1]
+
+    def get_last_mover_at(self, pos: int) -> Optional[int]:
+        """Peer of the winning position slot."""
+        eid, entry = self._entry_at(pos)
+        if entry is None:
+            return None
+        return entry.slot.peer
+
     def set_container(self, pos: int, ctype: ContainerType) -> Handler:
         eid = self._state.elem_id_at(pos)
         if eid is None:
@@ -505,6 +693,19 @@ class TreeHandler(Handler):
 
     def _position_for(
         self, parent: Optional[TreeID], index: Optional[int], moving: Optional[TreeID] = None
+    ) -> Optional[bytes]:
+        if not self.doc.config.fractional_index_enabled:
+            return None
+        key = self._position_key(parent, index, moving)
+        jitter = self.doc.config.fractional_index_jitter
+        if jitter:
+            import random as _random
+
+            key += bytes(_random.getrandbits(8) for _ in range(jitter))
+        return key
+
+    def _position_key(
+        self, parent: Optional[TreeID], index: Optional[int], moving: Optional[TreeID] = None
     ) -> bytes:
         sibs = [t for t in self._state.children_of(parent) if t != moving]
         positions = [self._state.nodes[t].position for t in sibs]
@@ -519,6 +720,53 @@ class TreeHandler(Handler):
         return key_between(lo, hi)
 
     # -- reads --------------------------------------------------------
+    # reference aliases / sibling-relative moves ----------------------
+    def create_at(self, parent: Optional[TreeID] = None, index: int = 0) -> TreeID:
+        return self.create(parent, index)
+
+    def mov(self, target: TreeID, parent: Optional[TreeID], index: Optional[int] = None) -> None:
+        self.move(target, parent, index)
+
+    def mov_to(self, target: TreeID, parent: Optional[TreeID], index: int) -> None:
+        self.move(target, parent, index)
+
+    def mov_after(self, target: TreeID, after: TreeID) -> None:
+        """Place `target` as the next sibling after `after`."""
+        p = self._state.parent_of(after)
+        sibs = [t for t in self._state.children_of(p) if t != target]
+        self.move(target, p, sibs.index(after) + 1)
+
+    def mov_before(self, target: TreeID, before: TreeID) -> None:
+        p = self._state.parent_of(before)
+        sibs = [t for t in self._state.children_of(p) if t != target]
+        self.move(target, p, sibs.index(before))
+
+    def children_num(self, parent: Optional[TreeID] = None) -> int:
+        return len(self._state.children_of(parent))
+
+    def is_node_deleted(self, target: TreeID) -> bool:
+        """True when the node exists but is trash-reachable (reference:
+        Tree::is_node_deleted; unknown nodes raise)."""
+        if target not in self._state.nodes:
+            raise ValueError(f"unknown tree node {target}")
+        return self._state._is_deleted(target)
+
+    def enable_fractional_index(self, jitter: int = 0) -> None:
+        """Generate fractional indexes on create/move (on by default;
+        reference: Tree::enable_fractional_index).  With jitter > 0,
+        keys get that many random suffix bytes so concurrent peers
+        inserting into the same gap rarely collide."""
+        self.doc.config.fractional_index_enabled = True
+        self.doc.config.fractional_index_jitter = jitter
+
+    def disable_fractional_index(self) -> None:
+        """New moves ship no position: sibling order falls back to the
+        move-key tiebreak (reference: Tree::disable_fractional_index)."""
+        self.doc.config.fractional_index_enabled = False
+
+    def is_fractional_index_enabled(self) -> bool:
+        return self.doc.config.fractional_index_enabled
+
     def contains(self, target: TreeID) -> bool:
         return self._state.contains(target)
 

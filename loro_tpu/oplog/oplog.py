@@ -371,6 +371,23 @@ class OpLog:
                     st.blocks[peer] = blocks_from_changes(chs)
         return st
 
+    def compact(self) -> None:
+        """Seal all hot history into compressed blocks and free the
+        decoded Change objects (reference: compact_change_store).  The
+        next access hydrates from the blocks."""
+        store = self.export_block_store()
+        # drop decoded caches inside reused blocks so memory actually
+        # shrinks (they were populated for dirty/hot peers)
+        for bl in store.blocks.values():
+            for b in bl:
+                b._changes = None
+        self.cold = store
+        self.cold.decoded_blocks = 0
+        self._cold_peers = set(store.peers())
+        self._dirty_peers = set()
+        self.changes = {}
+        self._starts = {}
+
     def diagnose_size(self) -> Dict[str, int]:
         """reference: oplog.rs:675 diagnose_size."""
         self._hydrate_all()

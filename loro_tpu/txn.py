@@ -64,6 +64,9 @@ class Transaction:
     def is_empty(self) -> bool:
         return not self.ops
 
+    def atom_len(self) -> int:
+        return sum(op.ctr_end - op.counter for op in self.ops)
+
     def _resolve_markers(self, content: OpContent, counter: int) -> OpContent:
         """Replace handler-side child/tree markers with real ids — the
         child container id / tree node id is the op's own (peer, counter)."""

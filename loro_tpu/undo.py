@@ -96,6 +96,32 @@ class UndoManager:
     def close(self) -> None:
         self._unsub()
 
+    # -- introspection (reference: UndoManager counts / peek) ----------
+    def undo_count(self) -> int:
+        return len(self.undo_stack)
+
+    def redo_count(self) -> int:
+        return len(self.redo_stack)
+
+    def set_max_undo_steps(self, n: int) -> None:
+        self.max_stack = n
+        while len(self.undo_stack) > n:
+            self.undo_stack.pop(0)
+
+    def add_exclude_origin_prefix(self, prefix: str) -> None:
+        """Commits whose origin starts with `prefix` neither push undo
+        items nor clear the redo stack (reference:
+        UndoManager::add_exclude_origin_prefix)."""
+        self.exclude_origin_prefixes.append(prefix)
+
+    def set_on_push(self, cb) -> None:
+        """Called with (is_undo: bool, span frontiers) when a stack item
+        is pushed (reference: OnPush — used to capture cursors/meta)."""
+        self._on_push = cb
+
+    def set_on_pop(self, cb) -> None:
+        self._on_pop = cb
+
     # -- grouping (reference: undo group_start/group_end) --------------
     def group_start(self) -> None:
         self.doc.commit()
@@ -117,8 +143,14 @@ class UndoManager:
             # concurrency transforms the stacks (reference undo.rs).
             if ev.origin == UNDO_ORIGIN:
                 self.redo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
+                cb = getattr(self, "_on_push", None)
+                if cb is not None:
+                    cb(False, (ev.from_frontiers, ev.to_frontiers))
             elif ev.origin == REDO_ORIGIN:
                 self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
+                cb = getattr(self, "_on_push", None)
+                if cb is not None:
+                    cb(True, (ev.from_frontiers, ev.to_frontiers))
             elif any(ev.origin.startswith(p) for p in self.exclude_origin_prefixes):
                 # excluded local work behaves like remote concurrency:
                 # it must transform the stacks, not become a step
@@ -145,6 +177,9 @@ class UndoManager:
                     self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
                     if len(self.undo_stack) > self.max_stack:
                         self.undo_stack.pop(0)
+                    cb = getattr(self, "_on_push", None)
+                    if cb is not None:
+                        cb(True, (ev.from_frontiers, ev.to_frontiers))
                 self._last_push_ms = now
                 self.redo_stack.clear()
             return
@@ -177,6 +212,9 @@ class UndoManager:
         if not stack:
             return False
         item = stack.pop()
+        cb = getattr(self, "_on_pop", None)
+        if cb is not None:
+            cb(stack is self.undo_stack, (item.from_f, item.to_f))
         inv = self.doc.diff(item.to_f, item.from_f)  # inverse of the span
         inv = _transform_batch(inv, item.post)
         if not inv:

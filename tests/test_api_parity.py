@@ -1,0 +1,296 @@
+"""Round-2 API parity batch (reference: crates/loro/src/lib.rs public
+fns): text deltas/utf8/utf16, tree sibling moves + fractional-index
+toggle, undo introspection, movable attribution, doc version algebra,
+blob meta, compaction."""
+import pytest
+
+from loro_tpu import DecodeError, ExportMode, Frontiers, LoroDoc, LoroError
+from loro_tpu.undo import UndoManager
+
+
+class TestTextDeltas:
+    def test_to_apply_slice_roundtrip(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        t.apply_delta([{"insert": "hello world"}])
+        t.apply_delta([{"retain": 5, "attributes": {"bold": True}}])
+        assert t.to_delta() == [
+            {"insert": "hello", "attributes": {"bold": True}},
+            {"insert": " world"},
+        ]
+        assert t.slice_delta(3, 8) == [
+            {"insert": "lo", "attributes": {"bold": True}},
+            {"insert": " wo"},
+        ]
+        # delta applied on a second replica converges to same styled doc
+        b = LoroDoc(peer=2)
+        b.import_(a.export_updates())
+        assert b.get_text("t").to_delta() == t.to_delta()
+
+    def test_apply_delta_insert_attrs_authoritative(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        t.insert(0, "xy")
+        t.mark(0, 2, "bold", True)
+        # insert inside the bold run WITHOUT bold: must not inherit
+        t.apply_delta([{"retain": 1}, {"insert": "Q", "attributes": {}}])
+        segs = {s["insert"]: s.get("attributes") for s in t.to_delta()}
+        assert segs["Q"] in (None, {})
+
+    def test_update_by_line(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        t.insert(0, "line one\nline two\nline three\n")
+        t.update_by_line("line one\nLINE 2\nline three\nline four\n")
+        assert t.to_string() == "line one\nLINE 2\nline three\nline four\n"
+
+    def test_utf8_and_utf16_index_spaces(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        t.insert(0, "aé☃𝄞z")  # 1,2,3,4-byte utf8; 𝄞 is a surrogate pair
+        assert t.len_utf8() == 1 + 2 + 3 + 4 + 1
+        assert t.len_utf16() == 6
+        t.insert_utf8(3, "X")  # after é
+        assert t.to_string() == "aéX☃𝄞z"
+        t.delete_utf8(3, 1)
+        assert t.to_string() == "aé☃𝄞z"
+        with pytest.raises(IndexError):
+            t.utf8_to_unicode(2)  # inside é
+        t.mark_utf16(0, 2, "b", 1)
+        assert t.to_delta()[0]["attributes"] == {"b": 1}
+        assert t.slice_utf16(1, 3) == "é☃"
+        t.splice_utf16(0, 1, "A")
+        assert t.to_string().startswith("A")
+
+    def test_get_id_and_editor_at(self):
+        a = LoroDoc(peer=7)
+        t = a.get_text("t")
+        t.insert(0, "ab")
+        assert t.get_editor_at_unicode_pos(0) == 7
+        assert t.get_id_at(1).peer == 7
+
+
+class TestTreeParityApis:
+    def test_sibling_relative_moves(self):
+        a = LoroDoc(peer=1)
+        tr = a.get_tree("tr")
+        n1, n2, n3 = tr.create(), tr.create(), tr.create()
+        tr.mov_after(n1, n3)
+        assert tr.roots() == [n2, n3, n1]
+        tr.mov_before(n1, n2)
+        assert tr.roots() == [n1, n2, n3]
+        tr.mov_to(n3, n1, 0)
+        assert tr.children(n1) == [n3]
+        assert tr.children_num(n1) == 1
+        assert tr.children_num() == 2
+
+    def test_is_node_deleted(self):
+        a = LoroDoc(peer=1)
+        tr = a.get_tree("tr")
+        n = tr.create()
+        c = tr.create(n)
+        assert not tr.is_node_deleted(c)
+        tr.delete(n)
+        assert tr.is_node_deleted(n) and tr.is_node_deleted(c)
+        with pytest.raises(ValueError):
+            tr.is_node_deleted(type(n)(99, 99))
+
+    def test_fractional_index_toggle(self):
+        a = LoroDoc(peer=1)
+        tr = a.get_tree("tr")
+        assert tr.is_fractional_index_enabled()
+        tr.disable_fractional_index()
+        n = tr.create()
+        assert tr.fractional_index(n) is None
+        tr.enable_fractional_index()
+        m = tr.create()
+        assert tr.fractional_index(m) is not None
+
+
+class TestUndoParityApis:
+    def test_counts_and_max_steps(self):
+        a = LoroDoc(peer=1)
+        um = UndoManager(a, merge_interval_ms=0)
+        t = a.get_text("t")
+        for i in range(5):
+            t.insert(0, str(i))
+            a.commit()
+        assert um.undo_count() == 5 and um.redo_count() == 0
+        um.set_max_undo_steps(3)
+        assert um.undo_count() == 3
+        assert um.undo() and um.redo_count() == 1
+
+    def test_on_push_on_pop(self):
+        a = LoroDoc(peer=1)
+        um = UndoManager(a, merge_interval_ms=0)
+        pushes, pops = [], []
+        um.set_on_push(lambda is_undo, span: pushes.append(is_undo))
+        um.set_on_pop(lambda is_undo, span: pops.append(is_undo))
+        a.get_text("t").insert(0, "x")
+        a.commit()
+        um.undo()
+        # the undo itself pushes a redo item (is_undo=False) — the
+        # reference's OnPush fires for every stack push
+        assert pushes == [True, False] and pops == [True]
+
+    def test_add_exclude_origin_prefix(self):
+        a = LoroDoc(peer=1)
+        um = UndoManager(a, merge_interval_ms=0)
+        um.add_exclude_origin_prefix("sys:")
+        a.get_text("t").insert(0, "x")
+        a.commit(origin="sys:auto")
+        assert um.undo_count() == 0
+
+
+class TestMovableAttribution:
+    def test_creator_editor_mover(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        ml = a.get_movable_list("ml")
+        ml.push("v0", "v1")
+        a.commit()
+        b.import_(a.export_updates())
+        b.get_movable_list("ml").set(0, "edited")
+        b.get_movable_list("ml").move(1, 0)
+        b.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        mla = a.get_movable_list("ml")
+        vals = mla.to_vec()
+        i_e = vals.index("edited")
+        assert mla.get_creator_at(i_e) == 1
+        assert mla.get_last_editor_at(i_e) == 2
+        i_m = vals.index("v1")
+        assert mla.get_last_mover_at(i_m) == 2
+        assert mla.push_container is not None
+
+
+class TestDocParityApis:
+    def test_version_algebra(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "x")
+        a.commit()
+        f1 = a.oplog_frontiers()
+        a.get_text("t").insert(0, "y")
+        a.commit()
+        assert a.cmp_with_frontiers(a.oplog_frontiers()) == 0
+        assert a.cmp_frontiers(f1, a.oplog_frontiers()) == -1
+        assert a.cmp_frontiers(a.oplog_frontiers(), f1) == 1
+        spans = a.find_id_spans_between(f1, a.oplog_frontiers())
+        assert dict(spans.items()) == {1: (1, 2)}
+        assert a.minimize_frontiers(a.oplog_frontiers()) == a.oplog_frontiers()
+        # concurrent versions: cmp_frontiers -> None, cmp_with_frontiers raises
+        b = LoroDoc(peer=2)
+        b.get_text("t").insert(0, "z")
+        b.commit()
+        fb = b.oplog_frontiers()
+        b.import_(a.export_updates(b.oplog_vv()))
+        assert b.cmp_frontiers(f1, fb) is None
+        # direct concurrent compare
+        d1, d2 = LoroDoc(peer=11), LoroDoc(peer=12)
+        d1.get_text("t").insert(0, "p")
+        d1.commit()
+        d2.get_text("t").insert(0, "q")
+        d2.commit()
+        hub = LoroDoc(peer=13)
+        hub.import_(d1.export_updates())
+        f_d1 = hub.oplog_frontiers()
+        hub2 = LoroDoc(peer=14)
+        hub2.import_(d2.export_updates())
+        hub.import_(d2.export_updates(hub.oplog_vv()))
+        assert hub.cmp_frontiers(f_d1, hub2.oplog_frontiers()) is None
+
+    def test_blob_meta_and_misc(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "hello")
+        a.commit()
+        meta = a.decode_import_blob_meta(a.export_updates())
+        assert meta["mode"] == "ColumnarUpdates" and meta["change_num"] == 1
+        assert meta["partial_end_vv"] == {1: 5}
+        snap_meta = a.decode_import_blob_meta(a.export(ExportMode.Snapshot))
+        assert snap_meta["mode"] == "FastSnapshot" and snap_meta["version"] == 2
+        with pytest.raises(DecodeError):
+            a.decode_import_blob_meta(b"junk")
+        assert a.len_ops() == 5
+        assert a.has_container("cid:root-t:Text")
+        assert not a.has_container("cid:root-nope:Text")
+        assert not a.is_shallow()
+
+    def test_shallow_introspection(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "hello")
+        a.commit()
+        blob = a.export(ExportMode.ShallowSnapshot(a.oplog_frontiers()))
+        s = LoroDoc(peer=2)
+        s.import_(blob)
+        assert s.is_shallow()
+        assert s.shallow_since_vv() == s.oplog.dag.shallow_since_vv
+        assert s.shallow_since_frontiers() == s.oplog.dag.shallow_since_frontiers
+
+    def test_compact_change_store(self):
+        a = LoroDoc(peer=1)
+        t = a.get_text("t")
+        for i in range(50):
+            t.insert(len(t), f"w{i} ")
+            a.commit(message=f"c{i}")
+        a.compact_change_store()
+        assert not a.oplog.changes  # hot lists freed
+        assert a.oplog._cold_peers == {1}
+        # everything still works (hydrates on demand)
+        assert t.to_string().count("w") == 50
+        b = LoroDoc(peer=2)
+        b.import_(a.export_updates())
+        assert b.get_text("t").to_string() == t.to_string()
+
+    def test_commit_options(self):
+        a = LoroDoc(peer=1)
+        a.set_next_commit_message("first!")
+        a.set_next_commit_origin("api")
+        origins = []
+        a.subscribe_root(lambda ev: origins.append(ev.origin))
+        a.get_text("t").insert(0, "x")
+        a.commit()
+        head = a.oplog_frontiers().as_ids()[0]
+        assert a.get_change(head)["message"] == "first!"
+        assert origins == ["api"]
+        a.set_change_merge_interval(0)
+        assert a.config.merge_interval_s == 0
+
+    def test_delete_root_container(self):
+        a = LoroDoc(peer=1)
+        a.get_text("t").insert(0, "x")
+        a.get_map("m").set("k", 1)
+        tr = a.get_tree("tr")
+        tr.create(tr.create())
+        a.get_counter("c").increment(5)
+        a.commit()
+        a.delete_root_container("cid:root-m:Map")
+        a.delete_root_container("cid:root-tr:Tree")
+        a.delete_root_container("cid:root-c:Counter")
+        v = a.get_deep_value()
+        assert v["m"] == {} and v["tr"] == [] and v["c"] == 0
+
+    def test_commit_options_survive_implicit_commit(self):
+        """Review regression: a pending message must not be eaten by an
+        intervening import's implicit commit, and set_peer_id with only
+        a pending message must not mis-attribute the next change."""
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        b.get_text("t").insert(0, "remote")
+        b.commit()
+        a.set_next_commit_message("important")
+        a.import_(b.export_updates())  # implicit commit (empty txn)
+        a.get_text("t").insert(0, "x")
+        a.commit()
+        head = next(i for i in a.oplog_frontiers() if i.peer == 1)
+        assert a.get_change(head)["message"] == "important"
+        c = LoroDoc(peer=10)
+        c.set_next_commit_message("m")
+        c.set_peer_id(42)
+        c.get_text("t").insert(0, "q")
+        c.commit()
+        assert next(iter(c.oplog_frontiers())).peer == 42
+
+    def test_fractional_index_jitter(self):
+        a = LoroDoc(peer=1)
+        tr = a.get_tree("tr")
+        tr.enable_fractional_index(jitter=4)
+        n = tr.create()
+        assert len(tr.fractional_index(n)) > 4

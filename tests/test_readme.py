@@ -22,12 +22,18 @@ def test_readme_quickstart_executes():
         d.get_text("t").insert(0, f"readme {i}")
         d.commit()
         docs.append(d)
+    from loro_tpu.ops.columnar import extract_map_ops
+
+    for d in docs:
+        d.get_map("m").set("k", int(d.peer))
+        d.commit()
     ns2 = {
         "payloads": [d.export_updates()[10:] for d in docs],
         "container_id": docs[0].get_text("t").id,
         "changes_per_doc": [d.oplog.changes_in_causal_order() for d in docs],
         "cid": docs[0].get_text("t").id,
         "new_changes_per_doc": [d.oplog.changes_in_causal_order() for d in docs],
+        "extracts": [extract_map_ops(d.oplog.changes_in_causal_order()) for d in docs],
     }
     fleet_block = blocks[1]
     # shrink the illustrative capacities so the smoke run is fast

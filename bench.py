@@ -326,34 +326,85 @@ def main() -> None:
     if not native_available():
         note("bench: native codec unavailable; skipping e2e pipeline number")
     elif variants and not os.environ.get("BENCH_SKIP_E2E"):
-        note("bench: timing end-to-end (decode -> contract -> upload -> merge)...")
+        note("bench: timing end-to-end (decode -> contract -> upload -> merge, pipelined)...")
+        from concurrent.futures import ThreadPoolExecutor
+
         from loro_tpu.core.ids import ContainerID, ContainerType
 
         cid = ContainerID.root("text", ContainerType.Text)
         payloads = [(v["payload"], v["n_ops"]) for v in variants]
+
+        def decode_one(i: int):
+            # the native explode releases the GIL, so decode threads
+            # overlap each other AND the async device merges
+            pl, p_ops = payloads[i % len(payloads)]
+            exd = extract_seq_from_payload(pl, cid)
+            return chain_columns(exd, pad_n=pad_n, pad_c=pad_c), p_ops
+
+        n_workers = min(8, os.cpu_count() or 1)
+        # full chunks only: a partial tail batch would be a fresh XLA
+        # shape (recompile inside the timed region)
+        e2e_docs = max(chunk, (e2e_docs_req // chunk) * chunk)
         e2e_done = 0
         e2e_ops = 0
-        t0 = time.perf_counter()
         out = None
-        while e2e_done < e2e_docs_req and (time.perf_counter() - t0) < e2e_budget_s:
-            docs = []
-            for j in range(chunk):
-                p, p_ops = payloads[(e2e_done + j) % len(payloads)]
-                exd = extract_seq_from_payload(p, cid)
-                docs.append(chain_columns(exd, pad_n=pad_n, pad_c=pad_c))
-                e2e_ops += p_ops
-            batched = ChainColumns(
-                *[np.stack([getattr(c, f) for c in docs]) for f in ChainColumns._fields]
-            )
-            dev = ChainColumns(*[jax.device_put(a) for a in batched])
-            out = chain_merge_docs_checksum(dev)
-            e2e_done += chunk
-        jax.block_until_ready(out)
-        e2e_dt = time.perf_counter() - t0
+        pool = ThreadPoolExecutor(max_workers=n_workers)
+        try:
+            t0 = time.perf_counter()
+            # bounded in-flight decode window (2 chunks ahead): caps
+            # host RAM at O(chunk) padded docs and leaves little to
+            # cancel on budget expiry
+            futs = [pool.submit(decode_one, i) for i in range(min(3 * chunk, e2e_docs))]
+            next_submit = len(futs)
+            while e2e_done < e2e_docs and (time.perf_counter() - t0) < e2e_budget_s:
+                group = futs[e2e_done : e2e_done + chunk]
+                docs = []
+                for f in group:
+                    c, p_ops = f.result()
+                    docs.append(c)
+                    e2e_ops += p_ops
+                while next_submit < e2e_docs and next_submit < e2e_done + 3 * chunk:
+                    futs.append(pool.submit(decode_one, next_submit))
+                    next_submit += 1
+                batched = ChainColumns(
+                    *[np.stack([getattr(c, f) for c in docs]) for f in ChainColumns._fields]
+                )
+                dev = ChainColumns(*[jax.device_put(a) for a in batched])
+                out = chain_merge_docs_checksum(dev)  # async dispatch
+                e2e_done += chunk
+            jax.block_until_ready(out)
+            e2e_dt = time.perf_counter() - t0
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
         e2e_ops_s = e2e_ops / e2e_dt
-        note(f"bench: e2e {e2e_done} docs in {e2e_dt:.1f}s")
+        note(
+            f"bench: e2e {e2e_done} docs in {e2e_dt:.1f}s "
+            f"({n_workers} decode threads overlapping device merges)"
+        )
+
+    # per-launch latency, sized by the pilot so it cannot blow the
+    # watchdog budget (skipped entirely on very slow paths)
+    lat_extras = {}
+    n_lat = int(min(12, max(0, (budget_s * 0.1) / max(t_pilot, 1e-9))))
+    if n_lat >= 3:
+        note(f"bench: measuring per-launch merge latency ({n_lat} samples)...")
+        lat = []
+        for i in range(n_lat):
+            t0 = time.perf_counter()
+            jax.block_until_ready(chain_merge_docs_checksum(batches[i % n_batches]))
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        lat_extras = {
+            "merge_latency_ms_p50": round(lat[len(lat) // 2] * 1e3, 1),
+            "merge_latency_ms_max": round(lat[-1] * 1e3, 1),
+            "latency_note": (
+                f"blocking {chunk}-doc chunk merges, full trace per doc, "
+                f"{n_lat} samples (max, not a true p99)"
+            ),
+        }
 
     extras = {
+        **lat_extras,
         "baseline_note": (
             "denominator is an ESTIMATE (2.0e6 ops/s single-thread Rust B4; "
             "Rust unavailable in image — BASELINE.md says measure, we cannot)"

@@ -325,7 +325,11 @@ def main() -> None:
     e2e_ops_s = None
     if not native_available():
         note("bench: native codec unavailable; skipping e2e pipeline number")
-    elif variants and not os.environ.get("BENCH_SKIP_E2E"):
+    elif (
+        variants
+        and not os.environ.get("BENCH_SKIP_E2E")
+        and e2e_docs_req >= chunk
+    ):
         note("bench: timing end-to-end (decode -> contract -> upload -> merge, pipelined)...")
         from concurrent.futures import ThreadPoolExecutor
 
@@ -343,8 +347,9 @@ def main() -> None:
 
         n_workers = min(8, os.cpu_count() or 1)
         # full chunks only: a partial tail batch would be a fresh XLA
-        # shape (recompile inside the timed region)
-        e2e_docs = max(chunk, (e2e_docs_req // chunk) * chunk)
+        # shape (recompile inside the timed region); a request smaller
+        # than one chunk runs nothing
+        e2e_docs = (e2e_docs_req // chunk) * chunk
         e2e_done = 0
         e2e_ops = 0
         out = None
@@ -359,10 +364,11 @@ def main() -> None:
             while e2e_done < e2e_docs and (time.perf_counter() - t0) < e2e_budget_s:
                 group = futs[e2e_done : e2e_done + chunk]
                 docs = []
-                for f in group:
+                for j, f in enumerate(group):
                     c, p_ops = f.result()
                     docs.append(c)
                     e2e_ops += p_ops
+                    futs[e2e_done + j] = None  # release decoded columns
                 while next_submit < e2e_docs and next_submit < e2e_done + 3 * chunk:
                     futs.append(pool.submit(decode_one, next_submit))
                     next_submit += 1

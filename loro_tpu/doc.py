@@ -1360,6 +1360,69 @@ class LoroDoc:
             meta["partial_end_vv"] = dict(end_vv.items())
         return meta
 
+    # -- cursor / jsonpath / path sugar (reference exposes these as doc
+    # methods; the implementations live in their modules) --------------
+    def get_cursor(self, container, pos: int, side=None):
+        from .cursor import CursorSide, get_cursor
+
+        return get_cursor(self, container, pos, side if side is not None else CursorSide.Middle)
+
+    def get_cursor_pos(self, cursor):
+        from .cursor import get_cursor_pos
+
+        return get_cursor_pos(self, cursor)
+
+    def jsonpath(self, path: str) -> List[Any]:
+        from .jsonpath import query
+
+        return query(self, path)
+
+    def subscribe_jsonpath(self, path: str, cb):
+        from .jsonpath import subscribe_jsonpath
+
+        return subscribe_jsonpath(self, path, cb)
+
+    def get_path_to_container(self, cid: Union[ContainerID, str]):
+        if isinstance(cid, str):
+            cid = ContainerID.parse(cid)
+        if cid not in self.state.states:
+            return None
+        return self.state.path_of(cid)
+
+    def get_by_path(self, parts) -> Any:
+        """Navigate a path given as a sequence of keys/indexes,
+        segment-by-segment (reference: get_by_path) — keys containing
+        "/" keep their meaning, unlike the string form."""
+        cur: Any = self.get_deep_value()
+        for part in parts:
+            if isinstance(cur, list):
+                try:
+                    idx = int(part)
+                except (TypeError, ValueError):
+                    return None
+                if idx < 0 or idx >= len(cur):
+                    return None
+                cur = cur[idx]
+            elif isinstance(cur, dict):
+                cur = cur.get(part)
+            else:
+                return None
+            if cur is None:
+                return None
+        return cur
+
+    def export_json_in_id_span(self, span: IdSpan) -> List[Dict[str, Any]]:
+        """JSON form of the changes covering one peer's id span
+        (reference: LoroDoc::export_json_in_id_span)."""
+        self.commit()
+        chs = self.oplog.changes_between(
+            VersionVector({span.peer: span.start}) if span.start else VersionVector({}),
+            VersionVector({span.peer: span.end}),
+        )
+        return jcodec.export_json_updates(chs, VersionVector(), self.oplog.vv.copy())[
+            "changes"
+        ]
+
     def diagnose_size(self) -> Dict[str, int]:
         return self.oplog.diagnose_size()
 

@@ -294,3 +294,31 @@ class TestDocParityApis:
         tr.enable_fractional_index(jitter=4)
         n = tr.create()
         assert len(tr.fractional_index(n)) > 4
+
+
+class TestDocSugarApis:
+    def test_cursor_jsonpath_path_methods(self):
+        from loro_tpu.core.ids import IdSpan
+
+        d = LoroDoc(peer=1)
+        t = d.get_text("t")
+        t.insert(0, "hello")
+        d.commit()
+        d.get_map("m").set("k", {"deep": [1, 2]})
+        d.commit()
+        cur = d.get_cursor(t, 2)
+        t.insert(0, "XX")
+        d.commit()
+        assert d.get_cursor_pos(cur).pos == 4  # stable across edits
+        assert d.jsonpath("$.m.k.deep[1]") == [2]
+        hits = []
+        unsub = d.subscribe_jsonpath("$.t", lambda vals: hits.append(vals))
+        t.insert(0, "!")
+        d.commit()
+        assert hits and hits[-1] == ["!XXhello"]
+        unsub()
+        assert d.get_path_to_container("cid:root-t:Text") == ("t",)
+        assert d.get_path_to_container("cid:root-none:Text") is None
+        assert d.get_by_path(["m", "k"]) == {"deep": [1, 2]}
+        span_json = d.export_json_in_id_span(IdSpan(1, 0, 5))
+        assert span_json and str(span_json[0]["id"]).endswith("@1")

@@ -325,11 +325,12 @@ def main() -> None:
     e2e_ops_s = None
     if not native_available():
         note("bench: native codec unavailable; skipping e2e pipeline number")
-    elif (
-        variants
-        and not os.environ.get("BENCH_SKIP_E2E")
-        and e2e_docs_req >= chunk
-    ):
+    elif variants and not os.environ.get("BENCH_SKIP_E2E") and e2e_docs_req < chunk:
+        note(
+            f"bench: BENCH_E2E_DOCS={e2e_docs_req} < chunk ({chunk}); "
+            "skipping e2e (needs at least one full chunk)"
+        )
+    elif variants and not os.environ.get("BENCH_SKIP_E2E"):
         note("bench: timing end-to-end (decode -> contract -> upload -> merge, pipelined)...")
         from concurrent.futures import ThreadPoolExecutor
 

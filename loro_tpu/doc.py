@@ -1138,6 +1138,12 @@ class LoroDoc:
         """Navigate "container/key/index/..." to a handler or value
         (reference: loro.rs get_by_str_path)."""
         parts = [p for p in path.split("/") if p]
+        return self._navigate_parts(parts)
+
+    def _navigate_parts(self, parts) -> Any:
+        """Segment-by-segment navigation shared by get_by_str_path and
+        get_by_path — lazy (touches only the containers on the path)
+        and returns live handlers for sub-containers."""
         if not parts:
             raise LoroError("empty path")
         cur: Any = None
@@ -1157,7 +1163,7 @@ class LoroDoc:
             if isinstance(cur, (ListHandler, MovableListHandler)):
                 try:
                     idx = int(part)
-                except ValueError:
+                except (TypeError, ValueError):
                     return None  # list segments must be numeric
                 if idx < 0 or idx >= len(cur):
                     return None
@@ -1391,37 +1397,20 @@ class LoroDoc:
 
     def get_by_path(self, parts) -> Any:
         """Navigate a path given as a sequence of keys/indexes,
-        segment-by-segment (reference: get_by_path) — keys containing
-        "/" keep their meaning, unlike the string form."""
-        cur: Any = self.get_deep_value()
-        for part in parts:
-            if isinstance(cur, list):
-                try:
-                    idx = int(part)
-                except (TypeError, ValueError):
-                    return None
-                if idx < 0 or idx >= len(cur):
-                    return None
-                cur = cur[idx]
-            elif isinstance(cur, dict):
-                cur = cur.get(part)
-            else:
-                return None
-            if cur is None:
-                return None
-        return cur
+        segment-by-segment (reference: get_by_path) — lazy, returns
+        live handlers for sub-containers, and keys containing "/" keep
+        their meaning (unlike the string form)."""
+        return self._navigate_parts(list(parts))
 
     def export_json_in_id_span(self, span: IdSpan) -> List[Dict[str, Any]]:
         """JSON form of the changes covering one peer's id span
         (reference: LoroDoc::export_json_in_id_span)."""
         self.commit()
         chs = self.oplog.changes_between(
-            VersionVector({span.peer: span.start}) if span.start else VersionVector({}),
+            VersionVector({span.peer: span.start}),
             VersionVector({span.peer: span.end}),
         )
-        return jcodec.export_json_updates(chs, VersionVector(), self.oplog.vv.copy())[
-            "changes"
-        ]
+        return [jcodec.change_to_json(ch) for ch in chs]
 
     def diagnose_size(self) -> Dict[str, int]:
         return self.oplog.diagnose_size()

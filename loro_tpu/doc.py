@@ -1156,20 +1156,23 @@ class LoroDoc:
                     return None
                 cur = self.get_container(candidates[0])
                 continue
-            if not hasattr(cur, "get"):
-                return None
             from .models.handlers import ListHandler, MovableListHandler
 
-            if isinstance(cur, (ListHandler, MovableListHandler)):
+            if isinstance(cur, (ListHandler, MovableListHandler)) or isinstance(
+                cur, list
+            ):
                 try:
                     idx = int(part)
                 except (TypeError, ValueError):
                     return None  # list segments must be numeric
                 if idx < 0 or idx >= len(cur):
                     return None
-                cur = cur.get(idx)
-            else:  # map: keys are strings (numeric-looking keys stay strings)
+                cur = cur[idx] if isinstance(cur, list) else cur.get(idx)
+            elif hasattr(cur, "get"):
+                # map handler or plain dict: string keys
                 cur = cur.get(part)
+            else:
+                return None
             if cur is None:
                 return None
         return cur

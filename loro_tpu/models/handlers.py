@@ -513,6 +513,48 @@ class MapHandler(Handler):
         assert marker.cid is not None
         return self._child_handler(marker.cid)
 
+    # -- mergeable child containers (reference: ensure_mergeable_*,
+    # state/mergeable.rs) ---------------------------------------------
+    def _ensure_mergeable(self, key: str, ctype: ContainerType) -> Handler:
+        """Child container with a DETERMINISTIC id derived from
+        (this map, key, type): concurrent first creation on different
+        replicas yields the same container, so their edits merge
+        instead of forking (unlike set_container, whose op-id child
+        forks under concurrency).  Raises LoroError if the key already
+        holds a non-mergeable value (the existing value is kept)."""
+        from ..core.ids import mergeable_root_name
+
+        cid = ContainerID.root(mergeable_root_name(self.cid, key, ctype), ctype)
+        cur = self._state.entries.get(key)
+        if cur is not None and not cur.deleted:
+            if cur.value == cid:
+                return self._child_handler(cid)
+            from ..errors import LoroError
+
+            raise LoroError(
+                f"map key {key!r} already holds a non-mergeable value"
+            )
+        self._apply(MapSet(key, cid))
+        return self._child_handler(cid)
+
+    def ensure_mergeable_text(self, key: str):
+        return self._ensure_mergeable(key, ContainerType.Text)
+
+    def ensure_mergeable_map(self, key: str):
+        return self._ensure_mergeable(key, ContainerType.Map)
+
+    def ensure_mergeable_list(self, key: str):
+        return self._ensure_mergeable(key, ContainerType.List)
+
+    def ensure_mergeable_movable_list(self, key: str):
+        return self._ensure_mergeable(key, ContainerType.MovableList)
+
+    def ensure_mergeable_tree(self, key: str):
+        return self._ensure_mergeable(key, ContainerType.Tree)
+
+    def ensure_mergeable_counter(self, key: str):
+        return self._ensure_mergeable(key, ContainerType.Counter)
+
     def clear(self) -> None:
         for k in self.keys():
             self.delete(k)

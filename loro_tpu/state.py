@@ -165,10 +165,22 @@ class DocState:
     def path_of(self, cid: ContainerID) -> Tuple[Union[str, int], ...]:
         """Event path from root (keys for maps, indexes for sequences).
         reference: subscription.rs path resolution via arena parents."""
+        from .core.ids import parse_mergeable_root_name
+
         parts: List[Union[str, int]] = []
         cur = cid
         seen = 0
-        while not cur.is_root:
+        while not cur.is_root or parse_mergeable_root_name(cur.name or "") is not None:
+            if cur.is_root:
+                # mergeable child root: the path runs through its
+                # parent map at the encoded key
+                parent_cid, key = parse_mergeable_root_name(cur.name)
+                parts.append(key)
+                cur = parent_cid
+                seen += 1
+                if seen > 1000:
+                    break
+                continue
             link = self.parents.get(cur)
             if link is None:
                 # maybe a tree-node meta map: cid == (peer,counter,Map) of a node
@@ -235,17 +247,22 @@ class DocState:
 
     # ------------------------------------------------------------------
     def get_value(self) -> Dict[str, Any]:
-        """Shallow doc value: root containers only."""
+        """Shallow doc value: root containers only (internal mergeable-
+        child roots resolve through their parent maps, not here)."""
+        from .core.ids import is_internal_root_name
+
         out: Dict[str, Any] = {}
         for cid, st in self.states.items():
-            if cid.is_root:
+            if cid.is_root and not is_internal_root_name(cid.name):
                 out[cid.name] = st.get_value()  # type: ignore[index]
         return out
 
     def get_deep_value(self) -> Dict[str, Any]:
+        from .core.ids import is_internal_root_name
+
         out: Dict[str, Any] = {}
         for cid, st in sorted(self.states.items(), key=lambda kv: kv[0]._key()):
-            if cid.is_root:
+            if cid.is_root and not is_internal_root_name(cid.name):
                 out[cid.name] = self._deep(st)  # type: ignore[index]
         return out
 

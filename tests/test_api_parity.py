@@ -322,3 +322,86 @@ class TestDocSugarApis:
         assert d.get_by_path(["m", "k"]) == {"deep": [1, 2]}
         span_json = d.export_json_in_id_span(IdSpan(1, 0, 5))
         assert span_json and str(span_json[0]["id"]).endswith("@1")
+
+
+class TestMergeableContainers:
+    def test_concurrent_ensure_merges(self):
+        """ensure_mergeable_*: deterministic child ids — concurrent
+        first creation on two replicas converges to ONE container whose
+        edits merge (reference: state/mergeable.rs)."""
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        ta = a.get_map("m").ensure_mergeable_text("notes")
+        tb = b.get_map("m").ensure_mergeable_text("notes")
+        ta.insert(0, "from-a ")
+        tb.insert(0, "from-b ")
+        a.commit()
+        b.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        assert a.get_deep_value() == b.get_deep_value()
+        merged = a.get_deep_value()["m"]["notes"]
+        assert "from-a" in merged and "from-b" in merged
+        # internal root is hidden from doc-level values
+        assert set(a.get_deep_value()) == {"m"}
+
+    def test_all_types_and_nesting(self):
+        a = LoroDoc(peer=1)
+        m = a.get_map("m")
+        m.ensure_mergeable_map("sub").set("k", 1)
+        m.ensure_mergeable_list("lst").push(1, 2)
+        m.ensure_mergeable_movable_list("ml").push("x")
+        tr = m.ensure_mergeable_tree("tr")
+        tr.create()
+        m.ensure_mergeable_counter("c").increment(2)
+        a.commit()
+        v = a.get_deep_value()["m"]
+        assert v["sub"] == {"k": 1} and v["lst"] == [1, 2] and v["ml"] == ["x"]
+        assert len(v["tr"]) == 1 and v["c"] == 2
+
+    def test_non_mergeable_key_rejected(self):
+        a = LoroDoc(peer=1)
+        a.get_map("m").set("k", 42)
+        a.commit()
+        with pytest.raises(LoroError):
+            a.get_map("m").ensure_mergeable_text("k")
+        assert a.get_map("m").get_value()["k"] == 42
+
+    def test_idempotent_and_path(self):
+        a = LoroDoc(peer=1)
+        t = a.get_map("m").ensure_mergeable_text("t")
+        t.insert(0, "hi")
+        a.commit()
+        t2 = a.get_map("m").ensure_mergeable_text("t")
+        assert t2.to_string() == "hi"
+        assert a.get_path_to_container(t.id) == ("m", "t")
+        assert a.get_by_str_path("m/t").to_string() == "hi"
+        b = LoroDoc(peer=2)
+        b.import_(a.export(ExportMode.Snapshot))
+        assert b.get_deep_value() == a.get_deep_value()
+
+    def test_nested_mergeable_paths(self):
+        """Review regression: nested mergeable containers embed \\x00 in
+        the parent cid — paths must still resolve through every level."""
+        a = LoroDoc(peer=1)
+        t = a.get_map("m").ensure_mergeable_map("sub").ensure_mergeable_text("t")
+        t.insert(0, "deep")
+        a.commit()
+        assert a.get_path_to_container(t.id) == ("m", "sub", "t")
+        assert a.get_deep_value()["m"]["sub"]["t"] == "deep"
+
+    def test_get_by_path_plain_list_values(self):
+        a = LoroDoc(peer=1)
+        a.get_map("m").set("k", {"deep": [1, 2]})
+        a.commit()
+        assert a.get_by_path(["m", "k", "deep", 1]) == 2
+        assert a.get_by_str_path("m/k/deep/1") == 2
+
+    def test_event_path_through_parent(self):
+        a = LoroDoc(peer=1)
+        t = a.get_map("m").ensure_mergeable_text("notes")
+        a.commit()
+        paths = []
+        a.subscribe_root(lambda ev: paths.extend(cd.path for cd in ev.diffs))
+        t.insert(0, "y")
+        a.commit()
+        assert ("m", "notes") in paths

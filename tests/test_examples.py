@@ -1,0 +1,32 @@
+"""The example programs must actually run (same spirit as
+tests/test_readme.py)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("name", ["collab_editor.py", "fleet_server.py"])
+def test_example_runs(name):
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        proc.terminate()  # never SIGKILL a JAX child (CLAUDE.md)
+        out, _ = proc.communicate(timeout=30)
+        pytest.fail(f"{name} timed out:\n{out[-2000:]}")
+    assert proc.returncode == 0, out[-3000:]
+    assert "DIVERGED" not in out

@@ -124,6 +124,21 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong,
             ctypes.c_int,
         ] + [ctypes.c_void_p] * 15 + [ctypes.c_longlong] * 3
+        lib.loro_order_new.restype = ctypes.c_void_p
+        lib.loro_order_new.argtypes = []
+        lib.loro_order_free.restype = None
+        lib.loro_order_free.argtypes = [ctypes.c_void_p]
+        lib.loro_order_nrows.restype = ctypes.c_longlong
+        lib.loro_order_nrows.argtypes = [ctypes.c_void_p]
+        lib.loro_order_renumbers.restype = ctypes.c_longlong
+        lib.loro_order_renumbers.argtypes = [ctypes.c_void_p]
+        lib.loro_order_all_keys.restype = None
+        lib.loro_order_all_keys.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.loro_order_append.restype = ctypes.c_longlong
+        lib.loro_order_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_longlong,
+        ] + [ctypes.c_void_p] * 4 + [ctypes.c_longlong, ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -382,3 +397,66 @@ def explode_movable_payload(payload: bytes, target_cid_index: int):
     if wrote != ns:
         raise ValueError("native decode failed (unresolvable refs or count mismatch)")
     return {"slots": slots, "sets": sets, "dels": dels}
+
+
+class NativeShadowOrder:
+    """C++ twin of parallel.order_maintenance.ShadowOrder (same
+    algorithm — keys are bit-identical; the Python engine is the
+    differential oracle).  Construct via native_order() which returns
+    None when the library is unavailable."""
+
+    __slots__ = ("_lib", "_h")
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.loro_order_new()
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.loro_order_free(h)
+            self._h = None
+
+    @property
+    def renumbers(self) -> int:
+        return int(self._lib.loro_order_renumbers(self._h))
+
+    @property
+    def n(self) -> int:
+        return int(self._lib.loro_order_nrows(self._h))
+
+    def append_rows(self, rows, base_row: int):
+        k = len(rows)
+        parent = np.asarray([r[0] for r in rows], np.int32)
+        side = np.asarray([r[1] for r in rows], np.int32)
+        peer = np.asarray([r[2] for r in rows], np.uint64)
+        ctr = np.asarray([r[3] for r in rows], np.int64)
+        out = np.empty(k, np.int64)
+        rc = self._lib.loro_order_append(
+            self._h,
+            k,
+            parent.ctypes.data_as(ctypes.c_void_p),
+            side.ctypes.data_as(ctypes.c_void_p),
+            peer.ctypes.data_as(ctypes.c_void_p),
+            ctr.ctypes.data_as(ctypes.c_void_p),
+            base_row,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc < 0:
+            raise ValueError("native order append: non-contiguous base row")
+        if rc == 1:
+            return None  # renumbered: caller re-uploads all_keys()
+        return out  # int64 ndarray (split_keys consumes it directly)
+
+    def all_keys(self) -> np.ndarray:
+        n = self.n
+        out = np.empty(n, np.int64)
+        self._lib.loro_order_all_keys(self._h, out.ctypes.data_as(ctypes.c_void_p))
+        return out
+
+
+def native_order():
+    lib = _load()
+    if lib is None:
+        return None
+    return NativeShadowOrder(lib)

@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -921,6 +922,194 @@ long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid
     }
   }
   return srow;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native ShadowOrder: incremental Fugue order maintenance (the exact
+// algorithm of parallel/order_maintenance.py, so keys are bit-identical
+// — the Python engine is the differential oracle).  State lives behind
+// an opaque handle; DeviceDocBatch calls append per sync with the delta
+// rows and gets 64-bit order keys back in O(delta).
+
+namespace order {
+
+constexpr int64_t KEY_STEP = 1ll << 20;
+constexpr int32_t HEAD = -2;
+
+struct Doc {
+  std::vector<uint64_t> peer;
+  std::vector<int64_t> ctr;
+  std::vector<int32_t> prev, next, spine;
+  std::vector<int64_t> key;
+  int32_t first_row = -1;
+  // (row << 1 | side) -> children sorted by (peer, ctr)
+  std::unordered_map<uint64_t, std::vector<int32_t>> branches;
+  std::vector<int32_t> root_children;
+  int64_t renumbers = 0;
+
+  int64_t n() const { return (int64_t)peer.size(); }
+
+  bool sib_less(int32_t a, uint64_t bp, int64_t bc) const {
+    return peer[a] != bp ? peer[a] < bp : ctr[a] < bc;
+  }
+
+  int32_t last_r_child(int32_t row) const {
+    auto it = branches.find(((uint64_t)row << 1) | 1);
+    if (it != branches.end() && !it->second.empty()) return it->second.back();
+    return spine[row];
+  }
+
+  int32_t subtree_last(int32_t row) const {
+    int32_t x = row;
+    while (true) {
+      int32_t nxt = last_r_child(x);
+      if (nxt < 0) return x;
+      x = nxt;
+    }
+  }
+
+  int32_t subtree_first(int32_t row) const {
+    int32_t x = row;
+    while (true) {
+      auto it = branches.find(((uint64_t)x << 1) | 0);
+      if (it == branches.end() || it->second.empty()) return x;
+      x = it->second.front();
+    }
+  }
+
+  void splice_after(int32_t pred, int32_t row) {
+    int32_t succ;
+    if (pred == HEAD) {
+      succ = first_row;
+      first_row = row;
+    } else {
+      succ = next[pred];
+      next[pred] = row;
+    }
+    prev[row] = pred;
+    next[row] = succ;
+    if (succ >= 0) prev[succ] = row;
+  }
+
+  bool assign_key(int32_t row) {
+    int32_t p = prev[row], s = next[row];
+    if (p < 0 && s < 0) key[row] = 0;
+    else if (p < 0) key[row] = key[s] - KEY_STEP;
+    else if (s < 0) key[row] = key[p] + KEY_STEP;
+    else {
+      int64_t lo = key[p], hi = key[s];
+      if (hi - lo < 2) return false;
+      key[row] = lo + (hi - lo) / 2;
+    }
+    return true;
+  }
+
+  void renumber() {
+    renumbers++;
+    int64_t k = 0;
+    int32_t x = first_row;
+    while (x >= 0) {
+      key[x] = k;
+      k += KEY_STEP;
+      x = next[x];
+    }
+  }
+
+  std::vector<int32_t>& sibling_list(int32_t parent_row, int32_t side) {
+    if (parent_row < 0) return root_children;
+    uint64_t bk = ((uint64_t)parent_row << 1) | (uint64_t)side;
+    auto it = branches.find(bk);
+    if (it == branches.end()) {
+      auto& lst = branches[bk];
+      if (side == 1) {
+        int32_t sp = spine[parent_row];
+        if (sp >= 0) {
+          lst.push_back(sp);
+          spine[parent_row] = -1;  // now tracked in branches
+        }
+      }
+      return lst;  // node-stable reference
+    }
+    return it->second;
+  }
+
+  void place(int32_t parent_row, int32_t side, int32_t row) {
+    // run-continuation fast path
+    if (parent_row >= 0 && side == 1 && spine[parent_row] < 0 &&
+        branches.find(((uint64_t)parent_row << 1) | 1) == branches.end() &&
+        peer[parent_row] == peer[row] && ctr[parent_row] == ctr[row] - 1) {
+      spine[parent_row] = row;
+      splice_after(parent_row, row);
+      return;
+    }
+    auto& sibs = sibling_list(parent_row, side);
+    uint64_t mp = peer[row];
+    int64_t mc = ctr[row];
+    size_t i = 0;
+    while (i < sibs.size() && sib_less(sibs[i], mp, mc)) i++;
+    sibs.insert(sibs.begin() + i, row);
+    if (side == 1 || parent_row < 0) {
+      int32_t pred;
+      if (i == 0) pred = parent_row >= 0 ? parent_row : HEAD;
+      else pred = subtree_last(sibs[i - 1]);
+      splice_after(pred, row);
+    } else {
+      if (i > 0) {
+        splice_after(subtree_last(sibs[i - 1]), row);
+      } else {
+        int32_t nxt = sibs.size() > i + 1 ? sibs[i + 1] : -1;
+        int32_t old_first = nxt >= 0 ? subtree_first(nxt) : parent_row;
+        splice_after(prev[old_first], row);
+      }
+    }
+  }
+};
+
+}  // namespace order
+
+extern "C" {
+
+void* loro_order_new() { return new order::Doc(); }
+
+void loro_order_free(void* h) { delete (order::Doc*)h; }
+
+long long loro_order_nrows(void* h) { return ((order::Doc*)h)->n(); }
+
+long long loro_order_renumbers(void* h) { return ((order::Doc*)h)->renumbers; }
+
+void loro_order_all_keys(void* h, int64_t* out) {
+  auto* d = (order::Doc*)h;
+  for (int64_t i = 0; i < d->n(); i++) out[i] = d->key[i];
+}
+
+// Place k rows (parent_row, side, peer, ctr) at indexes base_row..;
+// fills out_keys.  Returns 0, 1 when a renumber happened (caller
+// re-uploads all keys), or -1 on a non-contiguous base.
+long long loro_order_append(void* h, long long k, const int32_t* parent,
+                            const int32_t* side, const uint64_t* peer,
+                            const int64_t* ctr, long long base_row,
+                            int64_t* out_keys) {
+  auto* d = (order::Doc*)h;
+  if (base_row != d->n()) return -1;
+  bool renumbered = false;
+  for (long long j = 0; j < k; j++) {
+    int32_t row = (int32_t)(base_row + j);
+    d->peer.push_back(peer[j]);
+    d->ctr.push_back(ctr[j]);
+    d->prev.push_back(order::HEAD);
+    d->next.push_back(-1);
+    d->spine.push_back(-1);
+    d->key.push_back(0);
+    d->place(parent[j], side[j], row);
+    if (!d->assign_key(row)) {
+      d->renumber();
+      renumbered = true;
+    }
+    out_keys[j] = d->key[row];
+  }
+  return renumbered ? 1 : 0;
 }
 
 }  // extern "C"

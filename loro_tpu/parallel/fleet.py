@@ -621,10 +621,24 @@ class DeviceDocBatch:
         self.value_store: List[List] = [[] for _ in range(n_docs)]
         # incremental order: per-doc host ShadowOrder assigns standing
         # 64-bit order keys in O(delta); materialization sorts by key
-        # instead of re-ranking the table (VERDICT round-1 item 4)
+        # instead of re-ranking the table (VERDICT round-1 item 4).
+        # The C++ engine (native/codec.cpp loro_order_*) is used when
+        # available — bit-identical keys; LORO_PY_ORDER=1 forces the
+        # Python engine (the differential oracle).
+        import os as _os
+
         from .order_maintenance import ShadowOrder
 
-        self.order: List[ShadowOrder] = [ShadowOrder() for _ in range(n_docs)]
+        def _make_order():
+            if _os.environ.get("LORO_PY_ORDER", "0") not in ("1", "true", "yes"):
+                from ..native import native_order
+
+                nat = native_order()
+                if nat is not None:
+                    return nat
+            return ShadowOrder()
+
+        self.order = [_make_order() for _ in range(n_docs)]
         from ..ops.fugue_batch import SeqColumnsU
 
         sh = doc_sharding(self.mesh)

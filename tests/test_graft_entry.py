@@ -16,14 +16,16 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(code: str, n: int, timeout: float = 300.0):
+def _run(code: str, n: int, timeout: float = 300.0, stage_flags: bool = True):
     env = dict(os.environ)
     flags = env.get("XLA_FLAGS", "")
     # strip any prior forcing so we exercise the driver's own setting
     flags = " ".join(
         f for f in flags.split() if "xla_force_host_platform_device_count" not in f
     )
-    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    if stage_flags:
+        flags = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    env["XLA_FLAGS"] = flags
     # No subprocess.run(timeout=...): that SIGKILLs on expiry, and
     # hard-killing a JAX child mid-TPU-launch can wedge the axon tunnel
     # for the whole session (CLAUDE.md).  SIGTERM with a grace period.
@@ -52,6 +54,19 @@ def test_dryrun_multichip_subprocess(n):
     r = _run(
         f"import __graft_entry__ as g; g.dryrun_multichip({n}); print('MULTICHIP_OK')",
         n,
+    )
+    assert r.returncode == 0, r.stderr[-4000:]
+    assert "MULTICHIP_OK" in r.stdout
+
+
+def test_dryrun_multichip_self_stages_device_count():
+    """dryrun_multichip must work even when the caller did NOT set
+    xla_force_host_platform_device_count — it stages the flag itself
+    before backend init."""
+    r = _run(
+        "import __graft_entry__ as g; g.dryrun_multichip(4); print('MULTICHIP_OK')",
+        4,
+        stage_flags=False,
     )
     assert r.returncode == 0, r.stderr[-4000:]
     assert "MULTICHIP_OK" in r.stdout

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .fugue_batch import SeqColumns, fugue_order
+from .fugue_batch import SeqColumns, fugue_order, rank_bound
 
 NEG = jnp.int32(-(2**31) + 1)
 
@@ -82,7 +82,7 @@ def movable_merge_doc(cols: MovableCols, n_elems: int) -> Tuple[jax.Array, jax.A
     # visible slots: the element's winning slot, not tombstoned
     visible = is_win_slot & ~seq.deleted & (win_deleted[elem] == 0)
     rank = fugue_order(seq)
-    m = 3 * (s + 1)
+    m = rank_bound(s)
     rk = jnp.clip(rank, 0, m - 1)
     hist = jnp.zeros(m, jnp.int32).at[jnp.where(visible, rk, m - 1)].add(
         visible.astype(jnp.int32)

@@ -71,7 +71,11 @@ def wyllie_rank(succ: jax.Array, interpret: Optional[bool] = None) -> jax.Array:
 
 
 def wyllie_rank_xla(succ: jax.Array) -> jax.Array:
-    """Reference XLA implementation (same loop as _order_core)."""
+    """Reference XLA implementation of plain two-gather Wyllie ranking.
+    NOTE: production (_order_core) now fuses (dist, succ) into one
+    [m, 2] row so each round is a single gather (measured 2.3x on v5e);
+    this reference keeps the textbook formulation — both compute the
+    same distances, which is what the differential tests assert."""
     m = succ.shape[0]
     idx = jnp.arange(m, dtype=jnp.int32)
     dist = jnp.where(succ == idx, 0, 1).astype(jnp.int32)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .fugue_batch import SeqColumns, fugue_order
+from .fugue_batch import SeqColumns, fugue_order, rank_bound
 
 NEG = jnp.int32(-(2**31) + 1)
 
@@ -49,7 +49,7 @@ def richtext_merge_doc(
     n = seq.parent.shape[0]
     p = cols.pair_start.shape[0]
     rank = fugue_order(seq)
-    m = 3 * (n + 1)
+    m = rank_bound(n)
     rk = jnp.clip(rank, 0, m - 1)
     is_char = seq.content >= 0
     visible = seq.valid & ~seq.deleted & is_char

@@ -13,6 +13,9 @@ class Configure:
     hide_empty_root_containers: bool = False
     # style expand behavior per key: "after" (default), "before", "both", "none"
     text_style_config: Dict[str, str] = field(default_factory=dict)
+    # expand behavior for keys absent from text_style_config
+    # (reference: LoroDoc::config_default_text_style)
+    default_text_style: str = "after"
     # tree sibling positions: fractional indexes on create/move
     # (reference: Tree::enable/disable_fractional_index)
     fractional_index_enabled: bool = True

@@ -140,8 +140,24 @@ class LoroDoc:
         for cb in self._peer_id_change_subs:
             cb(peer)
 
+    @property
+    def peer_id(self) -> PeerID:
+        """reference: LoroDoc::peer_id."""
+        return self.peer
+
     def is_detached(self) -> bool:
         return self._detached
+
+    def set_detached_editing(self, enable: bool) -> None:
+        """Allow edits while detached: commits extend the checked-out
+        branch instead of raising (reference:
+        LoroDoc::set_detached_editing; new branch gets a fresh peer id
+        in the reference — here the peer id is kept, which is safe
+        because counters continue from the branch head)."""
+        self.config.editable_detached_mode = enable
+
+    def is_detached_editing_enabled(self) -> bool:
+        return self.config.editable_detached_mode
 
     def detach(self) -> None:
         self.commit()
@@ -176,6 +192,33 @@ class LoroDoc:
             cid = ContainerID.parse(cid)
         return make_handler(self, cid)
 
+    def _try_get(self, name: str, ctype: ContainerType) -> Optional[Handler]:
+        """Handler for an EXISTING container of the right type, else
+        None (reference: LoroDoc::try_get_text & co — the safe variants
+        that neither create roots nor assert the type)."""
+        cid = ContainerID.root(name, ctype) if isinstance(name, str) else name
+        if cid.ctype != ctype or cid not in self.state.states:
+            return None
+        return make_handler(self, cid)
+
+    def try_get_text(self, name: str) -> Optional[TextHandler]:
+        return self._try_get(name, ContainerType.Text)  # type: ignore[return-value]
+
+    def try_get_list(self, name: str) -> Optional[ListHandler]:
+        return self._try_get(name, ContainerType.List)  # type: ignore[return-value]
+
+    def try_get_map(self, name: str) -> Optional[MapHandler]:
+        return self._try_get(name, ContainerType.Map)  # type: ignore[return-value]
+
+    def try_get_movable_list(self, name: str) -> Optional[MovableListHandler]:
+        return self._try_get(name, ContainerType.MovableList)  # type: ignore[return-value]
+
+    def try_get_tree(self, name: str) -> Optional[TreeHandler]:
+        return self._try_get(name, ContainerType.Tree)  # type: ignore[return-value]
+
+    def try_get_counter(self, name: str) -> Optional[CounterHandler]:
+        return self._try_get(name, ContainerType.Counter)  # type: ignore[return-value]
+
     # ------------------------------------------------------------------
     # transactions
     # ------------------------------------------------------------------
@@ -194,14 +237,18 @@ class LoroDoc:
             return
         pend_msg = getattr(self, "_next_commit_message", None)
         pend_origin = getattr(self, "_next_commit_origin", None)
+        pend_ts = getattr(self, "_next_commit_timestamp", None)
         self._next_commit_message = None
         self._next_commit_origin = None
+        self._next_commit_timestamp = None
         if message is not None:
             txn.message = message
         elif pend_msg is not None and txn.message is None:
             txn.message = pend_msg
         if not origin and pend_origin:
             origin = pend_origin
+        if pend_ts is not None and txn.timestamp_override is None:
+            txn.timestamp_override = pend_ts
         for cb in self._pre_commit_subs:
             cb(txn)
         change = txn.build_change()
@@ -407,6 +454,18 @@ class LoroDoc:
         crc = zlib.crc32(payload)
         header = MAGIC + bytes([_min_version_for_mode(mode), mode.value]) + crc.to_bytes(4, "little")
         return header + payload
+
+    @classmethod
+    def from_snapshot(cls, data: bytes) -> "LoroDoc":
+        """Construct a fresh doc from snapshot bytes (reference:
+        LoroDoc::from_snapshot)."""
+        doc = cls()
+        doc.import_(data, origin="from_snapshot")
+        return doc
+
+    def import_with(self, data: bytes, origin: str = "import") -> ImportStatus:
+        """reference: LoroDoc::import_with (origin-tagged import)."""
+        return self.import_(data, origin)
 
     def import_(self, data: bytes, origin: str = "import") -> ImportStatus:
         """reference: loro.rs:568 LoroDoc::import (header parse + mode
@@ -808,6 +867,14 @@ class LoroDoc:
         chs = self.oplog.changes_between(start_vv, end_vv)
         return jcodec.export_json_updates(chs, start_vv, end_vv)
 
+    def export_json_updates_without_peer_compression(
+        self, start_vv: Optional[VersionVector] = None, end_vv: Optional[VersionVector] = None
+    ):
+        """reference: loro.rs export_json_updates_without_peer_compression.
+        This JSON codec never peer-compresses (ids are spelled out per
+        change), so both exports coincide."""
+        return self.export_json_updates(start_vv, end_vv)
+
     # ------------------------------------------------------------------
     # versions
     # ------------------------------------------------------------------
@@ -1134,6 +1201,34 @@ class LoroDoc:
             v = self._hide_empty_filter(v)
         return v
 
+    def get_deep_value_with_id(self) -> Dict[str, Any]:
+        """Like get_deep_value, but every container value is wrapped as
+        {"cid": str, "value": ...} (reference:
+        LoroDoc::get_deep_value_with_id)."""
+
+        def deep(x):
+            if isinstance(x, ContainerID):
+                return wrap(x)
+            if isinstance(x, dict):
+                return {k: deep(v) for k, v in x.items()}
+            if isinstance(x, list):
+                return [deep(v) for v in x]
+            return x
+
+        def wrap(cid: ContainerID):
+            st = self.state.states.get(cid)
+            if st is None:
+                return {"cid": str(cid), "value": None}
+            return {"cid": str(cid), "value": deep(st.get_value())}
+
+        from .core.ids import is_internal_root_name
+
+        out: Dict[str, Any] = {}
+        for cid in list(self.state.states):
+            if cid.is_root and not is_internal_root_name(cid.name):
+                out[cid.name] = wrap(cid)
+        return out
+
     def get_by_str_path(self, path: str):
         """Navigate "container/key/index/..." to a handler or value
         (reference: loro.rs get_by_str_path)."""
@@ -1331,13 +1426,135 @@ class LoroDoc:
     def set_next_commit_origin(self, origin: str) -> None:
         self._next_commit_origin = origin
 
+    def set_next_commit_timestamp(self, timestamp: int) -> None:
+        """Unix-seconds timestamp for the NEXT commit, overriding both
+        the clock and record_timestamp (reference:
+        LoroDoc::set_next_commit_timestamp)."""
+        self._next_commit_timestamp = timestamp
+
+    def set_next_commit_options(
+        self,
+        origin: Optional[str] = None,
+        message: Optional[str] = None,
+        timestamp: Optional[int] = None,
+    ) -> None:
+        """reference: LoroDoc::set_next_commit_options (CommitOptions)."""
+        if origin is not None:
+            self._next_commit_origin = origin
+        if message is not None:
+            self._next_commit_message = message
+        if timestamp is not None:
+            self._next_commit_timestamp = timestamp
+
+    def clear_next_commit_options(self) -> None:
+        """reference: LoroDoc::clear_next_commit_options."""
+        self._next_commit_message = None
+        self._next_commit_origin = None
+        self._next_commit_timestamp = None
+
+    def commit_with(
+        self,
+        origin: str = "",
+        message: Optional[str] = None,
+        timestamp: Optional[int] = None,
+    ) -> None:
+        """Commit with explicit options (reference: LoroDoc::commit_with).
+        Options apply to THIS commit only — with nothing pending they are
+        dropped, unlike set_next_commit_* which persists to the next
+        non-empty commit."""
+        if timestamp is not None and self._txn is not None and not self._txn.is_empty():
+            self._next_commit_timestamp = timestamp
+        self.commit(origin=origin, message=message)
+
     def set_record_timestamp(self, record: bool) -> None:
         self.config.record_timestamp = record
+
+    def set_hide_empty_root_containers(self, hide: bool) -> None:
+        """reference: LoroDoc::set_hide_empty_root_containers."""
+        self.config.hide_empty_root_containers = hide
+
+    def config_text_style(self, styles: Dict[str, str]) -> None:
+        """Set per-key mark expand behavior: "after" (default), "before",
+        "both", "none" (reference: LoroDoc::config_text_style /
+        StyleConfigMap)."""
+        for key, expand in styles.items():
+            if expand not in ("after", "before", "both", "none"):
+                raise LoroError(f"invalid expand behavior {expand!r} for style {key!r}")
+            self.config.text_style_config[key] = expand
+
+    def config_default_text_style(self, expand: Optional[str]) -> None:
+        """Default expand behavior for keys not in text_style_config
+        (reference: LoroDoc::config_default_text_style; None resets to
+        the built-in "after")."""
+        if expand is None:
+            self.config.default_text_style = "after"
+            return
+        if expand not in ("after", "before", "both", "none"):
+            raise LoroError(f"invalid expand behavior {expand!r}")
+        self.config.default_text_style = expand
 
     def set_change_merge_interval(self, interval_s: int) -> None:
         self.config.merge_interval_s = interval_s
 
     set_merge_interval = set_change_merge_interval
+
+    def has_history_cache(self) -> bool:
+        """Whether checkout/diff checkpoint floors are materialized
+        (reference: LoroDoc::has_history_cache)."""
+        return len(self._state_cache) > 0
+
+    def free_history_cache(self) -> None:
+        """Drop checkout checkpoint floors; time travel re-replays from
+        scratch until re-warmed (reference: LoroDoc::free_history_cache)."""
+        self._state_cache.clear()
+
+    def free_diff_calculator(self) -> None:
+        """reference: LoroDoc::free_diff_calculator.  The merge engine
+        here is stateless between imports (structure-holding states, no
+        persistent tracker), so there is nothing to free beyond the
+        checkout checkpoints."""
+        self.free_history_cache()
+
+    def check_state_correctness_slow(self) -> None:
+        """Deep self-check (reference: LoroDoc::check_state_correctness_slow):
+        replay full history into a fresh doc and require identical deep
+        values + identical frontiers; run structural invariant checkers
+        on every sequence CRDT."""
+        self.commit()
+        if self.is_shallow():
+            # replay floor is the frozen base; rebuild via snapshot
+            fresh = LoroDoc.from_snapshot(self.export(ExportMode.Snapshot))
+        else:
+            fresh = LoroDoc()
+            fresh.import_(self.export_updates())
+        if not self._detached:
+            a, b = self.get_deep_value(), fresh.get_deep_value()
+            if a != b:
+                raise LoroError(f"state mismatch vs replay: {a!r} != {b!r}")
+        for cid, st in self.state.states.items():
+            seq = getattr(st, "seq", None)
+            if seq is not None and hasattr(seq, "check_invariants"):
+                seq.check_invariants()
+
+    def log_internal_state(self) -> str:
+        """Dump sizes + per-container analysis (reference:
+        LoroDoc::log_internal_state); returns the dump and logs it
+        through the tracing layer."""
+        import json as _json
+
+        dump = _json.dumps(
+            {
+                "peer": self.peer,
+                "detached": self._detached,
+                "oplog": self.diagnose_size(),
+                "frontiers": str(self.oplog.frontiers),
+                "containers": self.analyze(),
+            },
+            indent=2,
+            default=str,
+        )
+        tracing.instant("doc.internal_state", dump=dump)
+        return dump
 
     def compact_change_store(self) -> None:
         """Push hot decoded history back into sealed compressed blocks

@@ -21,6 +21,7 @@ from ..core.change import (
     TreeMove,
 )
 from ..core.ids import ContainerID, ContainerType, ID, TreeID
+from ..errors import LoroError
 from ..utils.fractional_index import key_between
 from ..core.value import validate_value
 
@@ -55,6 +56,24 @@ class Handler:
 
     def is_attached(self) -> bool:
         return True
+
+    def get_type(self) -> ContainerType:
+        """reference: Handler::get_type / ContainerTrait."""
+        return self.cid.ctype
+
+    def is_deleted(self) -> bool:
+        """True when this container is no longer reachable from a root
+        (its parent entry was overwritten/deleted, its list slot removed,
+        or its tree node trashed); reference: ContainerTrait::is_deleted."""
+        return not self.doc.state.is_alive(self.cid)
+
+    def get_cursor(self, pos: int, side=None):
+        """Stable cursor at pos (reference: Handler::get_cursor)."""
+        from ..cursor import get_cursor as _get_cursor
+
+        if side is None:
+            return _get_cursor(self.doc, self, pos)
+        return _get_cursor(self.doc, self, pos, side)
 
     def _child_handler(self, cid: ContainerID) -> "Handler":
         return make_handler(self.doc, cid)
@@ -183,7 +202,7 @@ class TextHandler(Handler):
         while cur is not None and cur.vis_w == 0:
             if getattr(cur, "is_anchor", False) and not cur.deleted:
                 anch: StyleAnchor = cur.content
-                exp = styles.get(anch.key, "after")
+                exp = styles.get(anch.key, self.doc.config.default_text_style)
                 if anch.is_start:
                     # range starts here: typing before it inherits only
                     # for expand "before"/"both" -> step inside
@@ -395,6 +414,49 @@ class TextHandler(Handler):
         e = self._state.seq.elem_at(pos)
         return e.peer if e is not None else None
 
+    @property
+    def len_unicode(self) -> int:
+        """reference: LoroText::len_unicode."""
+        return len(self)
+
+    def push_str(self, s: str) -> None:
+        """reference: LoroText::push_str."""
+        self.push(s)
+
+    def convert_pos(self, index: int, from_type: str, to_type: str) -> Optional[int]:
+        """Convert a position between coordinate systems ("unicode",
+        "utf16", "bytes", "event"); None when out of bounds (reference:
+        LoroText::convert_pos / cursor::PosType — Event == Unicode
+        without the wasm feature)."""
+
+        def norm(t: str) -> str:
+            t = t.lower()
+            if t == "event":
+                return "unicode"
+            if t not in ("unicode", "utf16", "bytes"):
+                raise LoroError(f"unsupported position type {t!r}")
+            return t
+
+        from_type, to_type = norm(from_type), norm(to_type)
+        if index < 0:
+            return None
+        try:
+            if from_type == "unicode":
+                uni = index
+            elif from_type == "utf16":
+                uni = self.utf16_to_unicode(index)
+            else:
+                uni = self.utf8_to_unicode(index)
+            if uni > len(self):
+                return None
+            if to_type == "unicode":
+                return uni
+            if to_type == "utf16":
+                return self.unicode_to_utf16(uni)
+            return len(self.slice(0, uni).encode("utf-8"))
+        except (IndexError, ValueError):
+            return None
+
 
 class ListHandler(Handler):
     CT = ContainerType.List
@@ -463,6 +525,21 @@ class ListHandler(Handler):
 
     def to_vec(self) -> List[Any]:
         return self.get_value()
+
+    def get_id_at(self, pos: int) -> Optional[ID]:
+        """Op id of the element at `pos` (reference: LoroList::get_id_at)."""
+        e = self._state.seq.elem_at(pos)
+        return e.id if e is not None else None
+
+    def get_creator_at(self, pos: int) -> Optional[int]:
+        """Peer that inserted the element at `pos` (reference:
+        LoroList::get_creator_at semantics via the op id)."""
+        e = self._state.seq.elem_at(pos)
+        return e.peer if e is not None else None
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self.get(i)
 
 
 class _ChildMarker:
@@ -561,6 +638,18 @@ class MapHandler(Handler):
 
     def is_empty(self) -> bool:
         return len(self._state.get_value()) == 0
+
+    def get_last_editor(self, key: str) -> Optional[int]:
+        """Peer of the winning (LWW) write to `key`, including deletes;
+        None for never-written keys (reference: LoroMap::get_last_editor)."""
+        e = self._state.entries.get(key)
+        return e.peer if e is not None else None
+
+    def keys_iter(self):
+        return iter(self.keys())
+
+    def __iter__(self):
+        return iter(self.keys())
 
     def get_or_create_container(self, key: str, ctype: ContainerType) -> Handler:
         """Existing child or a fresh one (reference: get_or_create)."""
@@ -832,6 +921,41 @@ class TreeHandler(Handler):
     def fractional_index(self, target: TreeID) -> Optional[bytes]:
         n = self._state.nodes.get(target)
         return n.position if n else None
+
+    def get_last_move_id(self, target: TreeID) -> Optional[ID]:
+        """Op id of the effective (winning) move of `target`; None for
+        unknown nodes (reference: LoroTree::get_last_move_id)."""
+        n = self._state.nodes.get(target)
+        if n is None:
+            return None
+        _lamport, peer, counter = n.move_key
+        return ID(peer, counter)
+
+    def get_nodes(self, with_deleted: bool = False) -> List[dict]:
+        """Flat node records {id, parent, index, fractional_index}
+        (reference: LoroTree::get_nodes; deleted nodes get parent=None,
+        index=None)."""
+        st = self._state
+        out = []
+        for t in st.nodes:
+            alive = st.contains(t)
+            if not alive and not with_deleted:
+                continue
+            out.append(
+                {
+                    "id": t,
+                    "parent": st.parent_of(t) if alive else None,
+                    "index": st.index_of(t) if alive else None,
+                    "fractional_index": st.nodes[t].position,
+                    "deleted": not alive,
+                }
+            )
+        return out
+
+    def get_value_with_meta(self) -> List[dict]:
+        """Hierarchy values with each node's meta map resolved
+        (reference: LoroTree::get_value_with_meta == deep value)."""
+        return self.get_deep_value()
 
 
 class _TreeTargetMarker:

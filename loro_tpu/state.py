@@ -227,6 +227,50 @@ class DocState:
                     return tcid, node
         return None
 
+    def is_alive(self, cid: ContainerID) -> bool:
+        """Reachability from a root: each hop's parent must still hold
+        this child (map entry not overwritten/deleted, sequence element
+        visible, tree node not trashed); reference: DocState
+        dead-containers cache semantics (state.rs)."""
+        from .core.ids import parse_mergeable_root_name
+
+        cur = cid
+        for _ in range(1000):
+            if cur.is_root:
+                pm = parse_mergeable_root_name(cur.name or "")
+                if pm is None:
+                    return True
+                parent_cid, key = pm  # mergeable child root: key in parent map
+                pst = self.states.get(parent_cid)
+                if pst is None or pst.get_value().get(key) is None:
+                    return False
+                cur = parent_cid
+                continue
+            link = self.parents.get(cur)
+            if link is None:
+                owner = self._find_tree_owner(cur)
+                if owner is None:
+                    return cur in self.states  # unknown linkage: best effort
+                tcid, node = owner
+                tst = self.states.get(tcid)
+                if tst is None or not tst.contains(node):
+                    return False
+                cur = tcid
+                continue
+            parent_cid, key = link
+            pst = self.states.get(parent_cid)
+            if pst is None:
+                return False
+            v = pst.get_value()
+            if isinstance(v, dict):
+                if not (isinstance(key, str) and v.get(key) == cur):
+                    return False
+            elif isinstance(v, list):
+                if cur not in v:
+                    return False
+            cur = parent_cid
+        return False
+
     def depth_of(self, cid: ContainerID) -> int:
         d = 0
         cur = cid

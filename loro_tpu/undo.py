@@ -27,6 +27,9 @@ class UndoItem:
     to_f: Frontiers
     # diffs applied after this item, per container (for transform)
     post: Dict[ContainerID, Any] = field(default_factory=dict)
+    # user meta captured by the on_push callback (reference:
+    # UndoItemMeta{value, cursors})
+    meta: Any = None
 
 
 def _transform_batch(
@@ -116,11 +119,52 @@ class UndoManager:
 
     def set_on_push(self, cb) -> None:
         """Called with (is_undo: bool, span frontiers) when a stack item
-        is pushed (reference: OnPush — used to capture cursors/meta)."""
+        is pushed; its return value (if any) is stored as the item's
+        meta, readable via top_undo_meta/top_redo_meta (reference:
+        OnPush returning UndoItemMeta — used to capture cursors/meta)."""
         self._on_push = cb
 
     def set_on_pop(self, cb) -> None:
         self._on_pop = cb
+
+    def set_merge_interval(self, interval_ms: int) -> None:
+        """reference: UndoManager::set_merge_interval (0 = no merge)."""
+        self.merge_interval_ms = interval_ms
+
+    @property
+    def peer(self) -> int:
+        """reference: UndoManager::peer."""
+        return self.doc.peer
+
+    def clear(self) -> None:
+        """Drop both stacks (reference: UndoManager::clear)."""
+        self.undo_stack.clear()
+        self.redo_stack.clear()
+
+    def record_new_checkpoint(self) -> None:
+        """Commit pending work and force the next local commit to open
+        a new undo item even inside the merge interval / a group
+        (reference: UndoManager::record_new_checkpoint)."""
+        self.doc.commit()
+        self._last_push_ms = float("-inf")
+        self._group_fresh = True
+
+    def _top_meta(self, stack: List[UndoItem]):
+        return stack[-1].meta if stack else None
+
+    def top_undo_meta(self):
+        return self._top_meta(self.undo_stack)
+
+    def top_redo_meta(self):
+        return self._top_meta(self.redo_stack)
+
+    def top_undo_value(self):
+        m = self.top_undo_meta()
+        return m.get("value") if isinstance(m, dict) else m
+
+    def top_redo_value(self):
+        m = self.top_redo_meta()
+        return m.get("value") if isinstance(m, dict) else m
 
     # -- grouping (reference: undo group_start/group_end) --------------
     def group_start(self) -> None:
@@ -145,12 +189,12 @@ class UndoManager:
                 self.redo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
                 cb = getattr(self, "_on_push", None)
                 if cb is not None:
-                    cb(False, (ev.from_frontiers, ev.to_frontiers))
+                    self.redo_stack[-1].meta = cb(False, (ev.from_frontiers, ev.to_frontiers))
             elif ev.origin == REDO_ORIGIN:
                 self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
                 cb = getattr(self, "_on_push", None)
                 if cb is not None:
-                    cb(True, (ev.from_frontiers, ev.to_frontiers))
+                    self.undo_stack[-1].meta = cb(True, (ev.from_frontiers, ev.to_frontiers))
             elif any(ev.origin.startswith(p) for p in self.exclude_origin_prefixes):
                 # excluded local work behaves like remote concurrency:
                 # it must transform the stacks, not become a step
@@ -170,8 +214,9 @@ class UndoManager:
                 mergeable = want_merge and self.undo_stack and not self.undo_stack[-1].post
                 if mergeable:
                     # extend the top item's span to cover this commit
+                    top = self.undo_stack[-1]
                     self.undo_stack[-1] = UndoItem(
-                        self.undo_stack[-1].from_f, ev.to_frontiers, self.undo_stack[-1].post
+                        top.from_f, ev.to_frontiers, top.post, top.meta
                     )
                 else:
                     self.undo_stack.append(UndoItem(ev.from_frontiers, ev.to_frontiers))
@@ -179,7 +224,7 @@ class UndoManager:
                         self.undo_stack.pop(0)
                     cb = getattr(self, "_on_push", None)
                     if cb is not None:
-                        cb(True, (ev.from_frontiers, ev.to_frontiers))
+                        self.undo_stack[-1].meta = cb(True, (ev.from_frontiers, ev.to_frontiers))
                 self._last_push_ms = now
                 self.redo_stack.clear()
             return
@@ -214,7 +259,18 @@ class UndoManager:
         item = stack.pop()
         cb = getattr(self, "_on_pop", None)
         if cb is not None:
-            cb(stack is self.undo_stack, (item.from_f, item.to_f))
+            # reference OnPop receives the popped item's meta (cursor
+            # restore); legacy 2-arg callbacks keep working
+            import inspect
+
+            try:
+                takes_meta = len(inspect.signature(cb).parameters) >= 3
+            except (TypeError, ValueError):
+                takes_meta = False
+            if takes_meta:
+                cb(stack is self.undo_stack, (item.from_f, item.to_f), item.meta)
+            else:
+                cb(stack is self.undo_stack, (item.from_f, item.to_f))
         inv = self.doc.diff(item.to_f, item.from_f)  # inverse of the span
         inv = _transform_batch(inv, item.post)
         if not inv:

@@ -1,0 +1,94 @@
+"""Packed u8 single-buffer transport (pack_chain_doc_into /
+chain_merge_docs_packed) must be bit-identical to the ChainColumns
+path — it is the e2e ingest wire onto the device."""
+import numpy as np
+import pytest
+
+import loro_tpu as lt
+from loro_tpu.core.ids import ContainerID, ContainerType
+from loro_tpu.ops.columnar import chain_columns, contract_chains, extract_seq_container
+from loro_tpu.ops.fugue_batch import (
+    ChainColumns,
+    chain_merge_docs,
+    chain_merge_docs_checksum,
+    chain_merge_docs_packed,
+    chain_merge_docs_packed_checksum,
+    pack_chain_doc_into,
+    packed_row_bytes,
+)
+
+CID = ContainerID.root("t", ContainerType.Text)
+
+
+def _fuzz_docs(seed: int, n_docs: int = 4, steps: int = 150):
+    rng = np.random.default_rng(seed)
+    docs = []
+    for _ in range(n_docs):
+        a, b = lt.LoroDoc(peer=1), lt.LoroDoc(peer=2)
+        for i in range(steps):
+            for d in (a, b):
+                t = d.get_text("t")
+                pos = int(rng.integers(0, len(t) + 1))
+                if len(t) > 2 and rng.random() < 0.3:
+                    t.delete(min(pos, len(t) - 1), 1)
+                else:
+                    t.insert(pos, chr(97 + int(rng.integers(0, 26))))
+            if rng.random() < 0.2:
+                b.import_(a.export_updates(b.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        a.import_(b.export_updates(a.oplog_vv()))
+        docs.append(a)
+    return docs
+
+
+def _batch(docs, pad_n, pad_c):
+    exs = [extract_seq_container(d.oplog.changes_in_causal_order(), CID) for d in docs]
+    cols = [chain_columns(e, pad_n=pad_n, pad_c=pad_c) for e in exs]
+    batched = ChainColumns(
+        *[np.stack([getattr(c, f) for c in cols]) for f in ChainColumns._fields]
+    )
+    packed = np.empty((len(docs), packed_row_bytes(pad_c, pad_n)), np.uint8)
+    for i, c in enumerate(cols):
+        pack_chain_doc_into(c, packed[i])
+    return batched, packed
+
+
+def test_packed_matches_chain_columns_path():
+    docs = _fuzz_docs(0)
+    exs = [extract_seq_container(d.oplog.changes_in_causal_order(), CID) for d in docs]
+    pad_n = max(e.n for e in exs) + 7  # deliberately unaligned pads
+    pad_c = max(contract_chains(e).n_chains for e in exs) + 3
+    batched, packed = _batch(docs, pad_n, pad_c)
+
+    codes_a, counts_a = map(np.asarray, chain_merge_docs(batched))
+    codes_b, counts_b = map(np.asarray, chain_merge_docs_packed(packed, pad_c, pad_n))
+    assert (counts_a == counts_b).all()
+    assert (codes_a == codes_b).all()
+
+    cs_a, cnt_a = map(np.asarray, chain_merge_docs_checksum(batched))
+    cs_b, cnt_b = map(np.asarray, chain_merge_docs_packed_checksum(packed, pad_c, pad_n))
+    assert (cs_a == cs_b).all() and (cnt_a == cnt_b).all()
+
+    # and the merged text matches the host engine
+    for i, d in enumerate(docs):
+        got = "".join(map(chr, codes_b[i][: counts_b[i]]))
+        assert got == d.get_text("t").to_string()
+
+
+def test_packed_u16_sentinels_roundtrip():
+    """-1 c_parent (0xFFFF on the wire) survives the u16 packing, with
+    generous pads so pad rows (chain_id 0, valid False) are exercised;
+    the dump remap to pad_c happens on-device via the valid mask."""
+    docs = _fuzz_docs(1, n_docs=2, steps=40)
+    exs = [extract_seq_container(d.oplog.changes_in_causal_order(), CID) for d in docs]
+    pad_n = max(e.n for e in exs) + 64
+    pad_c = max(contract_chains(e).n_chains for e in exs) + 64
+    batched, packed = _batch(docs, pad_n, pad_c)
+    codes_a, counts_a = map(np.asarray, chain_merge_docs(batched))
+    codes_b, counts_b = map(np.asarray, chain_merge_docs_packed(packed, pad_c, pad_n))
+    assert (codes_a == codes_b).all() and (counts_a == counts_b).all()
+
+
+def test_packed_rejects_oversized_pad_c():
+    with pytest.raises(AssertionError):
+        packed_row_bytes(0xFFFF, 16)

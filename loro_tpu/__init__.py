@@ -43,6 +43,7 @@ from .models.handlers import (
     TreeHandler,
 )
 from .awareness import Awareness, EphemeralStore
+from .codec.json_schema import RedactError, redact_json_updates
 from .cursor import AbsolutePosition, Cursor, CursorSide, get_cursor, get_cursor_pos
 from .undo import UndoManager
 
@@ -52,6 +53,8 @@ __all__ = [
     "LoroDoc",
     "LoroError",
     "DecodeError",
+    "RedactError",
+    "redact_json_updates",
     "ExportMode",
     "EncodeMode",
     "ImportStatus",

@@ -178,6 +178,107 @@ def export_json_updates(
     }
 
 
+REDACTED_CHAR = "�"
+
+
+class RedactError(ValueError):
+    """reference: json_schema.rs RedactError (InvalidSchema /
+    UnknownOperationType)."""
+
+
+def _op_json_len(d: Dict[str, Any]) -> int:
+    """Counter span of a JSON op (mirror of Op.atom_len)."""
+    if d["type"] == "insert":
+        if "text" in d:
+            return max(1, len(d["text"]))
+        if "values" in d:
+            return max(1, len(d["values"]))
+    return 1
+
+
+def _redact_value(v: Any) -> Any:
+    """Nulls a JSON value unless it is a child-container ref (child
+    creation must survive redaction — reference json_schema.rs
+    redact_value)."""
+    if isinstance(v, dict) and set(v.keys()) == {"__cid__"}:
+        return v
+    return None
+
+
+def redact_json_updates(doc_json: Dict[str, Any], rng) -> Dict[str, Any]:
+    """Redact sensitive content of ops inside `rng` (a VersionRange) in
+    place, preserving all CRDT structure so redacted and non-redacted
+    docs keep converging (reference: loro::json::redact,
+    json_schema.rs:1750-1880):
+
+    - text inserts: covered chars become U+FFFD (lengths preserved)
+    - list / movable-list insert values and movable set values: Null
+      (child-container refs kept)
+    - map insert values: Null (keys kept); deletes untouched
+    - text mark (anchor) values: Null (keys kept)
+    - counter increments: 0
+    - tree / move / delete ops: unchanged
+    - unknown future ops: RedactError (their counter span is opaque)
+    """
+    ranges = dict(rng.items())
+    i32_max = (1 << 31) - 1
+    errors: List[RedactError] = []
+    for change in doc_json.get("changes", []):
+        try:
+            cid = ID.parse(change["id"])
+        except (KeyError, ValueError) as e:
+            raise RedactError(f"invalid change id: {e}") from None
+        if cid.peer not in ranges:
+            continue
+        s, e = ranges[cid.peer]
+        for op in change["ops"]:
+            ctr = op.get("counter")
+            if not isinstance(ctr, int) or ctr < 0 or ctr > i32_max:
+                raise RedactError(f"op counter out of range: {ctr!r}")
+            length = _op_json_len(op)
+            if ctr + length > i32_max:
+                raise RedactError("op counter overflow")
+            if ctr >= e:
+                break
+            t = op["type"]
+            if t == "unknown":
+                # fail-closed: an unknown (future-format) op's counter
+                # span is opaque, so any such op starting before the
+                # range end may hold covered content
+                errors.append(RedactError("cannot redact unknown op type"))
+                continue
+            lo = max(s - ctr, 0)
+            hi = min(e - ctr, length)
+            if hi <= lo:
+                continue
+            if t == "insert":
+                if "text" in op:
+                    chars = list(op["text"])
+                    for i in range(lo, hi):
+                        chars[i] = REDACTED_CHAR
+                    op["text"] = "".join(chars)
+                elif "values" in op:
+                    vals = op["values"]
+                    for i in range(lo, hi):
+                        vals[i] = _redact_value(vals[i])
+                elif "anchor" in op:
+                    op["anchor"]["value"] = None
+            elif t == "map_set":
+                if not op.get("deleted"):
+                    op["value"] = _redact_value(op["value"])
+            elif t == "mset":
+                op["value"] = _redact_value(op["value"])
+            elif t == "counter":
+                op["delta"] = 0
+            elif t in ("delete", "tree", "mmove"):
+                pass  # structure ops carry no redactable content
+            else:
+                errors.append(RedactError(f"unrecognized op type {t!r}"))
+    if errors:
+        raise errors[-1]
+    return doc_json
+
+
 def import_json_updates(doc_json: Dict[str, Any]) -> List[Change]:
     if doc_json.get("schema_version", 1) > SCHEMA_VERSION:
         raise ValueError(f"unsupported schema version {doc_json.get('schema_version')}")

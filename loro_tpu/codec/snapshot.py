@@ -288,7 +288,13 @@ def encode_doc_state(doc_state, parents: Dict) -> bytes:
     cid refs register first — same trap as binary.encode_changes)."""
     d = _Dicts()
     scratch = Writer()
-    items = sorted(doc_state.states.items(), key=lambda kv: kv[0]._key())
+    # read-created ghost states (materialized=False) must not ship: the
+    # importer would hydrate them as real empty roots and diverge from
+    # replicas that never read them
+    items = sorted(
+        (kv for kv in doc_state.states.items() if kv[1].materialized),
+        key=lambda kv: kv[0]._key(),
+    )
     for cid, st in items:
         d.cid(cid)
     seg_lens = []

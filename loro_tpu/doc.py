@@ -1225,7 +1225,13 @@ class LoroDoc:
 
         out: Dict[str, Any] = {}
         for cid in list(self.state.states):
-            if cid.is_root and not is_internal_root_name(cid.name):
+            st = self.state.states.get(cid)
+            if (
+                cid.is_root
+                and not is_internal_root_name(cid.name)
+                and st is not None
+                and st.materialized
+            ):
                 out[cid.name] = wrap(cid)
         return out
 

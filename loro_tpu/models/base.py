@@ -20,7 +20,15 @@ if TYPE_CHECKING:  # pragma: no cover
 
 
 class ContainerState(ABC):
-    """Materialized state of one container."""
+    """Materialized state of one container.
+
+    `materialized` is False for states that exist only because a handler
+    READ them (reads must not make containers spring into existence in
+    doc values — reference: should_avoid_initialize_new_container_
+    accidentally); it flips True when an op applies or a snapshot
+    hydrates the state."""
+
+    materialized = False
 
     def __init__(self, cid: ContainerID):
         self.cid = cid

@@ -62,6 +62,7 @@ class StateTable(dict):
             raise DecodeError(f"malformed container state for {cid}: {e}") from e
         self._thunks.pop(cid, None)
         self.hydrated += 1
+        st.materialized = True  # snapshot-backed states carry content
         super().__setitem__(cid, st)
         return st
 
@@ -140,6 +141,7 @@ class DocState:
                 lamport = ch.lamport + (op.counter - ch.ctr_start)
                 self._register_children(op, ch.peer)
                 st = self.get_or_create(op.container)
+                st.materialized = True
                 d = st.apply_op(op, ch.peer, lamport, record=record)
                 if record and d is not None:
                     diffs.setdefault(op.container, []).append(d)
@@ -297,7 +299,7 @@ class DocState:
 
         out: Dict[str, Any] = {}
         for cid, st in self.states.items():
-            if cid.is_root and not is_internal_root_name(cid.name):
+            if cid.is_root and not is_internal_root_name(cid.name) and st.materialized:
                 out[cid.name] = st.get_value()  # type: ignore[index]
         return out
 
@@ -306,7 +308,7 @@ class DocState:
 
         out: Dict[str, Any] = {}
         for cid, st in sorted(self.states.items(), key=lambda kv: kv[0]._key()):
-            if cid.is_root and not is_internal_root_name(cid.name):
+            if cid.is_root and not is_internal_root_name(cid.name) and st.materialized:
                 out[cid.name] = self._deep(st)  # type: ignore[index]
         return out
 

@@ -51,6 +51,7 @@ class Transaction:
         lamport = self.start_lamport + (counter - self.start_counter)
         self.doc.state._register_children(op, self.peer)
         st = self.doc.state.get_or_create(cid)
+        st.materialized = True
         record = self.doc.observer.has_subscribers()
         d = st.apply_op(op, self.peer, lamport, record=record)
         # diff objects are only kept when someone will consume them

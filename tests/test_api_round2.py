@@ -326,6 +326,41 @@ def test_undo_on_pop_receives_meta():
     um.close()
 
 
+def test_reads_do_not_materialize_containers():
+    """reference: should_avoid_initialize_new_container_accidentally —
+    reading a never-written root must not make it appear in doc values
+    (it would break cross-replica deep-value equality)."""
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    a.get_map("m").set("k", 1)
+    a.commit()
+    b.import_(a.export_updates())
+    _ = b.get_text("accidental").get_value()
+    _ = b.get_list("also").is_empty()
+    assert a.get_deep_value() == b.get_deep_value()
+    assert "accidental" not in b.get_value()
+    assert "accidental" not in b.get_deep_value_with_id()
+    # an explicit write (even net-empty) does materialize
+    t = b.get_text("accidental")
+    t.insert(0, "x")
+    t.delete(0, 1)
+    b.commit()
+    assert "accidental" in b.get_deep_value()
+
+
+def test_ghost_states_do_not_ship_in_snapshots_or_forks():
+    from loro_tpu import ExportMode
+
+    a = LoroDoc(peer=1)
+    a.get_map("m").set("k", 1)
+    a.commit()
+    _ = a.get_text("ghost").get_value()  # pure read
+    b = LoroDoc.from_snapshot(a.export(ExportMode.Snapshot))
+    assert "ghost" not in b.get_deep_value()
+    assert a.get_deep_value() == b.get_deep_value()
+    f = a.fork()
+    assert "ghost" not in f.get_deep_value()
+
+
 def test_export_json_updates_without_peer_compression():
     doc = LoroDoc(peer=1)
     doc.get_map("m").set("k", 1)

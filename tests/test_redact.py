@@ -1,0 +1,133 @@
+"""History redaction (reference: loro::json::redact,
+crates/loro/tests/integration_test/redact_test.rs): null sensitive
+content inside a VersionRange while preserving all CRDT structure."""
+import pytest
+
+import loro_tpu as lt
+from loro_tpu import LoroDoc, RedactError, VersionRange, redact_json_updates
+from loro_tpu.core.ids import ContainerType
+
+
+def test_redact_text_doc():
+    doc = LoroDoc(peer=1)
+    text = doc.get_text("text")
+    text.insert(0, "Hello, world! This is a secret message.")
+    doc.commit()
+    json_obj = doc.export_json_updates()
+    redact_json_updates(json_obj, VersionRange({1: (24, 30)}))
+    red = LoroDoc(peer=2)
+    red.import_json_updates(json_obj)
+    assert red.get_text("text").to_string() == "Hello, world! This is a ������ message."
+    assert red.get_text("text").to_string() != text.to_string()
+
+
+def test_redact_rejects_overflowing_counters_without_crashing():
+    doc = LoroDoc(peer=1)
+    doc.get_text("text").insert(0, "secret")
+    doc.commit()
+    json_obj = doc.export_json_updates()
+    json_obj["changes"][0]["ops"][0]["counter"] = (1 << 31) - 1
+    with pytest.raises(RedactError):
+        redact_json_updates(json_obj, VersionRange({1: (0, (1 << 31) - 1)}))
+
+
+def test_redact_map_list_and_counter():
+    doc = LoroDoc(peer=1)
+    m = doc.get_map("map")
+    m.set("key1", "sensitive data")
+    child = m.set_container("child", ContainerType.Text)
+    child.insert(0, "nested secret")
+    lst = doc.get_list("list")
+    lst.insert(0, "a-secret", 42)
+    doc.get_counter("c").increment(7)
+    ml = doc.get_movable_list("ml")
+    ml.push("move-secret")
+    ml.set(0, "set-secret")
+    doc.commit()
+
+    json_obj = doc.export_json_updates()
+    redact_json_updates(json_obj, VersionRange({1: (0, 1 << 20)}))
+    red = LoroDoc(peer=2)
+    red.import_json_updates(json_obj)
+
+    v = red.get_deep_value()
+    assert v["map"]["key1"] is None
+    # child container creation survives; its text content was redacted
+    assert v["map"]["child"] == "�" * len("nested secret")
+    assert v["list"] == [None, None]
+    assert v["c"] == 0.0
+    assert v["ml"] == [None]
+
+
+def test_redact_fails_closed_on_unknown_ops():
+    """An unknown (future-format) op's span is opaque; any such op
+    starting before the range end must fail the redaction even when a
+    1-counter-length guess would place it outside the range."""
+    doc = LoroDoc(peer=1)
+    doc.get_text("t").insert(0, "abcdef")
+    doc.commit()
+    json_obj = doc.export_json_updates()
+    json_obj["changes"][0]["ops"].insert(
+        0, {"container": "cid:root-t:Text", "counter": 0, "type": "unknown", "kind": 9, "data": ""}
+    )
+    with pytest.raises(RedactError):
+        # range starts past the unknown op's assumed 1-length span
+        redact_json_updates(json_obj, VersionRange({1: (3, 5)}))
+
+
+def test_redact_partial_range_list():
+    doc = LoroDoc(peer=1)
+    lst = doc.get_list("list")
+    lst.insert(0, "a", "b", "c")  # counters 0..3 in one op
+    doc.commit()
+    json_obj = doc.export_json_updates()
+    redact_json_updates(json_obj, VersionRange({1: (1, 2)}))
+    red = LoroDoc(peer=2)
+    red.import_json_updates(json_obj)
+    assert red.get_list("list").get_value() == ["a", None, "c"]
+
+
+def test_redacted_and_original_keep_converging():
+    a = LoroDoc(peer=1)
+    a.get_text("t").insert(0, "public secret public")
+    a.commit()
+    json_obj = a.export_json_updates()
+    redact_json_updates(json_obj, VersionRange({1: (7, 13)}))
+    b = LoroDoc(peer=2)
+    b.import_json_updates(json_obj)
+    # both sides keep editing and exchanging updates
+    a.get_text("t").insert(0, "A:")
+    a.commit()
+    b.get_text("t").push("(B)")
+    b.commit()
+    a.import_(b.export_updates(a.oplog_vv()))
+    b.import_(a.export_updates(b.oplog_vv()))
+    ta, tb = a.get_text("t").to_string(), b.get_text("t").to_string()
+    # same structure; they differ exactly at the redacted chars
+    assert len(ta) == len(tb)
+    assert tb == ta.replace("secret", "�" * 6)
+    # a third replica importing from the redacted side converges with it
+    c = LoroDoc(peer=3)
+    c.import_(b.export_updates())
+    assert c.get_text("t").to_string() == tb
+
+
+def test_redact_mark_value_nulls_anchor_but_keeps_structure():
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("t")
+    t.insert(0, "hello")
+    t.mark(0, 5, "comment", "secret note")
+    doc.commit()
+    json_obj = doc.export_json_updates()
+    redact_json_updates(json_obj, VersionRange({1: (5, 7)}))  # the anchor ops
+    red = LoroDoc(peer=2)
+    red.import_json_updates(json_obj)
+    spans = red.get_text("t").get_richtext_value()
+    # a None style value reads as unstyled here (None == unmark), but
+    # the anchors themselves survive: both replicas keep converging
+    assert spans == [{"insert": "hello"}]
+    red.get_text("t").push("!")
+    red.commit()
+    doc.import_(red.export_updates(doc.oplog_vv()))
+    assert doc.get_text("t").to_string() == "hello!"
+    assert doc.len_ops() == red.len_ops()

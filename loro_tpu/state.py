@@ -263,12 +263,26 @@ class DocState:
             pst = self.states.get(parent_cid)
             if pst is None:
                 return False
-            v = pst.get_value()
-            if isinstance(v, dict):
-                if not (isinstance(key, str) and v.get(key) == cur):
+            if isinstance(key, str) and hasattr(pst, "get_entry"):
+                e = pst.get_entry(key)  # O(1) map lookup
+                if e is None or e.value != cur:
                     return False
-            elif isinstance(v, list):
-                if cur not in v:
+            elif isinstance(key, ID) and hasattr(pst, "elems"):
+                # movable list: key is the element id; the element must
+                # be live and its winning (set-rebindable) value == cur
+                entry = pst.elems.get(key)
+                if entry is None or entry.deleted or entry.value != cur:
+                    return False
+            elif isinstance(key, ID) and hasattr(pst, "seq"):
+                e = pst.seq.by_id.get((key.peer, key.counter))
+                if e is None or e.deleted or e.content != cur:
+                    return False
+            else:
+                v = pst.get_value()
+                if isinstance(v, dict):
+                    if not (isinstance(key, str) and v.get(key) == cur):
+                        return False
+                elif isinstance(v, list) and cur not in v:
                     return False
             cur = parent_cid
         return False

@@ -166,6 +166,39 @@ def test_handler_get_type_and_is_deleted():
     assert child.is_deleted()
 
 
+def test_is_deleted_list_movable_and_nested():
+    doc = LoroDoc(peer=1)
+    lst = doc.get_list("l")
+    lst.insert(0, "pad")
+    child = lst.insert_container(1, ContainerType.Text)
+    child.insert(0, "x")
+    doc.commit()
+    assert not child.is_deleted()
+    lst.delete(1, 1)
+    doc.commit()
+    assert child.is_deleted()
+
+    ml = doc.get_movable_list("ml")
+    mchild = ml.push_container(ContainerType.Counter)
+    mchild.increment(1)
+    doc.commit()
+    assert not mchild.is_deleted()
+    ml.set(0, "overwritten")  # rebinding the value kills the child
+    doc.commit()
+    assert mchild.is_deleted()
+
+    # deep nesting: deleting an ancestor deletes the whole subtree
+    m = doc.get_map("m")
+    mid = m.set_container("mid", ContainerType.Map)
+    leaf = mid.set_container("leaf", ContainerType.Text)
+    leaf.insert(0, "deep")
+    doc.commit()
+    assert not leaf.is_deleted()
+    m.delete("mid")
+    doc.commit()
+    assert leaf.is_deleted()
+
+
 def test_handler_get_cursor():
     doc = LoroDoc(peer=1)
     t = doc.get_text("t")

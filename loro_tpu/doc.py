@@ -160,7 +160,7 @@ class LoroDoc:
         return self.config.editable_detached_mode
 
     def detach(self) -> None:
-        self.commit()
+        self._barrier()
         self._detached = True
 
     def attach(self) -> None:
@@ -229,11 +229,25 @@ class LoroDoc:
             self._txn = Transaction(self)
         return self._txn.apply(cid, content)
 
-    def commit(self, origin: str = "", message: Optional[str] = None) -> None:
-        """Commit the implicit transaction (reference: txn.rs:426)."""
+    def _barrier(self) -> None:
+        """Implicit commit (reference: with_barrier): finalize pending
+        work, but an EMPTY implicit commit preserves next-commit options
+        for the next real commit — unlike an explicit empty commit(),
+        which swallows them."""
         txn = self._txn
         if txn is None or txn.is_empty():
             self._txn = None
+            return
+        self.commit()
+
+    def commit(self, origin: str = "", message: Optional[str] = None) -> None:
+        """Commit the implicit transaction (reference: txn.rs:426).
+        An explicit empty commit swallows pending next-commit options
+        (reference: explicit_empty_commit_swallow_options)."""
+        txn = self._txn
+        if txn is None or txn.is_empty():
+            self._txn = None
+            self.clear_next_commit_options()
             return
         pend_msg = getattr(self, "_next_commit_message", None)
         pend_origin = getattr(self, "_next_commit_origin", None)
@@ -326,7 +340,7 @@ class LoroDoc:
     def export(self, mode=None) -> bytes:
         """Export per ExportMode (reference: loro.rs:2096 dispatch)."""
         tracing.instant("doc.export", mode=type(mode).__name__ if mode is not None else "Snapshot")
-        self.commit()
+        self._barrier()
         if mode is None or isinstance(mode, ExportMode.Snapshot) or mode is ExportMode.Snapshot:
             return self._export_fast_snapshot()
         if isinstance(mode, ExportMode.Updates):
@@ -471,7 +485,7 @@ class LoroDoc:
         """reference: loro.rs:568 LoroDoc::import (header parse + mode
         dispatch, loro.rs:584-649)."""
         with tracing.span("doc.import", bytes=len(data)):
-            self.commit()
+            self._barrier()
             mode, payload = self._parse_envelope(data)
             if mode == EncodeMode.FastSnapshot:
                 return self._import_fast_snapshot(payload, origin)
@@ -488,7 +502,7 @@ class LoroDoc:
         loro.rs import_batch): decode everything first, then apply as
         one causally-sorted set so cross-blob dependencies resolve in
         one pass."""
-        self.commit()
+        self._barrier()
         all_changes: List[Change] = []
         snapshots: List[bytes] = []
         for blob in blobs:
@@ -861,7 +875,7 @@ class LoroDoc:
     def export_json_updates(
         self, start_vv: Optional[VersionVector] = None, end_vv: Optional[VersionVector] = None
     ):
-        self.commit()
+        self._barrier()
         start_vv = start_vv or VersionVector()
         end_vv = end_vv or self.oplog.vv.copy()
         chs = self.oplog.changes_between(start_vv, end_vv)
@@ -900,7 +914,7 @@ class LoroDoc:
     # time travel
     # ------------------------------------------------------------------
     def checkout_to_latest(self) -> None:
-        self.commit()
+        self._barrier()
         if not self._detached and self.state.frontiers == self.oplog.frontiers:
             return  # already attached at head (reference loro.rs:1543
             # early-returns and must not renew the peer id)
@@ -910,7 +924,7 @@ class LoroDoc:
     def checkout(self, frontiers: Frontiers) -> None:
         """reference: loro.rs:1625.  Sets detached mode unless the target
         is the latest version."""
-        self.commit()
+        self._barrier()
         try:
             target_vv = self.oplog.dag.frontiers_to_vv(frontiers)
         except KeyError as e:
@@ -1021,7 +1035,7 @@ class LoroDoc:
         (per-element deletion records); other containers diff by value.
         Endpoints equal to the live state reuse it instead of replaying
         the full history."""
-        self.commit()  # uncommitted ops would desync state vs frontiers
+        self._barrier()  # uncommitted ops would desync state vs frontiers
         va = self.oplog.dag.frontiers_to_vv(a)
         vb = self.oplog.dag.frontiers_to_vv(b)
         sa = self.state if a == self.state.frontiers else self._state_at(a)
@@ -1140,11 +1154,14 @@ class LoroDoc:
                             h.move(item.target, item.parent, item.index)  # type: ignore[attr-defined]
                     except (ValueError, LoroError):
                         continue  # target vanished concurrently
-        self.commit(origin=origin)
+        # commit only what this batch produced: an empty batch must not
+        # swallow pending next-commit options (it is an internal commit)
+        if self._txn is not None and not self._txn.is_empty():
+            self.commit(origin=origin)
 
     def revert_to(self, frontiers: Frontiers) -> None:
         """Generate new ops returning the doc to `frontiers`' state."""
-        self.commit()
+        self._barrier()
         batch = self.diff(self.oplog.frontiers, frontiers)
         self.apply_diff(batch, origin="revert")
 
@@ -1367,7 +1384,7 @@ class LoroDoc:
             h.clear()
         elif hasattr(h, "delete") and hasattr(h, "__len__"):
             h.delete(0, len(h))
-        self.commit()
+        self._barrier()
 
     # -- shallow introspection (reference: is_shallow / shallow_since) -
     def is_shallow(self) -> bool:
@@ -1526,7 +1543,7 @@ class LoroDoc:
         replay full history into a fresh doc and require identical deep
         values + identical frontiers; run structural invariant checkers
         on every sequence CRDT."""
-        self.commit()
+        self._barrier()
         if self.is_shallow():
             # replay floor is the frozen base; rebuild via snapshot
             fresh = LoroDoc.from_snapshot(self.export(ExportMode.Snapshot))
@@ -1566,7 +1583,7 @@ class LoroDoc:
         """Push hot decoded history back into sealed compressed blocks
         and free the Change objects (reference:
         LoroDoc::compact_change_store)."""
-        self.commit()
+        self._barrier()
         self.oplog.compact()
 
     @staticmethod
@@ -1631,7 +1648,7 @@ class LoroDoc:
     def export_json_in_id_span(self, span: IdSpan) -> List[Dict[str, Any]]:
         """JSON form of the changes covering one peer's id span
         (reference: LoroDoc::export_json_in_id_span)."""
-        self.commit()
+        self._barrier()
         chs = self.oplog.changes_between(
             VersionVector({span.peer: span.start}),
             VersionVector({span.peer: span.end}),

@@ -145,7 +145,7 @@ class UndoManager:
         """Commit pending work and force the next local commit to open
         a new undo item even inside the merge interval / a group
         (reference: UndoManager::record_new_checkpoint)."""
-        self.doc.commit()
+        self.doc._barrier()
         self._last_push_ms = float("-inf")
         self._group_fresh = True
 
@@ -168,12 +168,12 @@ class UndoManager:
 
     # -- grouping (reference: undo group_start/group_end) --------------
     def group_start(self) -> None:
-        self.doc.commit()
+        self.doc._barrier()
         self._grouping = True
         self._group_fresh = True  # first in-group commit opens a new item
 
     def group_end(self) -> None:
-        self.doc.commit()
+        self.doc._barrier()
         self._grouping = False
 
     # ------------------------------------------------------------------
@@ -253,7 +253,7 @@ class UndoManager:
         return self._pop_apply(self.redo_stack, REDO_ORIGIN)
 
     def _pop_apply(self, stack: List[UndoItem], origin: str) -> bool:
-        self.doc.commit()
+        self.doc._barrier()
         if not stack:
             return False
         item = stack.pop()

@@ -1,0 +1,57 @@
+"""Long multi-actor convergence soak (tests/test_fuzz.py machinery,
+many more seeds and longer traces + periodic snapshot rejoin)."""
+import random
+import sys
+import time
+
+sys.path.insert(0, "tests")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from test_fuzz import Actor, assert_converged, sync_all, sync_pair  # noqa: E402
+
+t0 = time.time()
+done = 0
+for seed in range(1000, 1600):
+    rng = random.Random(seed)
+    n_act = 3 + seed % 3
+    actors = [Actor(i + 1, rng, with_undo=(seed % 4 == 0 and i == 0)) for i in range(n_act)]
+    steps = 150 + (seed % 5) * 40
+    for step in range(steps):
+        for a in actors:
+            a.random_action()
+        if rng.random() < 0.18:
+            i, j = rng.sample(range(n_act), 2)
+            sync_pair(actors[i], actors[j])
+        if rng.random() < 0.02:
+            # snapshot rejoin: one actor restarts from another's snapshot
+            # (never the undo-managed actor: its manager tracks the old doc)
+            i, j = rng.sample(range(n_act), 2)
+            if actors[i].undo is not None:
+                i = (i + 1) % n_act if (i + 1) % n_act != j else (i + 2) % n_act
+            from loro_tpu import LoroDoc
+
+            # j must know ALL of i's ops first, or the restarted i would
+            # mint fresh ops reusing (peer, counter) ids it lost — id
+            # reuse is a protocol violation, not a merge case
+            sync_pair(actors[i], actors[j])
+            snap = actors[j].doc.export_snapshot()
+            fresh = LoroDoc.from_snapshot(snap)
+            fresh.set_peer_id(actors[i].doc.peer)
+            actors[i].doc = fresh
+        if rng.random() < 0.05 and actors[0].undo is not None:
+            if rng.random() < 0.5:
+                actors[0].undo.undo()
+            else:
+                actors[0].undo.redo()
+    for a in actors:
+        a.commit()
+    sync_all(actors)
+    assert_converged(actors)
+    if seed % 10 == 0:
+        actors[0].doc.check_state_correctness_slow()
+    done += 1
+    if done % 20 == 0:
+        print(f"{done} seeds clean ({time.time()-t0:.0f}s)", flush=True)
+print(f"SOAK CLEAN: {done} seeds in {time.time()-t0:.0f}s", flush=True)

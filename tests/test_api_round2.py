@@ -327,6 +327,48 @@ def test_deep_value_with_id_tree_meta_and_mergeable_roots_json_safe():
     assert set(v) == {"tr", "m"}  # no mangled mergeable-root keys
 
 
+def test_explicit_empty_commit_swallows_options():
+    """reference: commit_message_test.rs explicit_empty_commit_swallow_options."""
+    doc = LoroDoc(peer=1)
+    doc.set_next_commit_message("will be swallowed")
+    doc.set_next_commit_timestamp(123)
+    doc.commit()  # explicit, empty
+    doc.get_text("text").insert(0, "x")
+    doc.commit()
+    ch = doc.get_change(ID(1, 0))
+    assert ch["message"] is None
+    assert ch["timestamp"] == 0
+
+
+def test_implicit_empty_commit_preserves_options():
+    """reference: commit_message_test.rs implicit_empty_commit_preserves_options."""
+    from loro_tpu import ExportMode
+
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("text")
+    t.insert(0, "123")
+    doc.commit_with(message="first commit", timestamp=100)
+    doc.set_next_commit_message("second commit")
+    doc.set_next_commit_timestamp(200)
+    _ = doc.export(ExportMode.Snapshot)  # implicit empty commit inside
+    t.insert(3, "456")
+    doc.commit()
+    first, second = doc.get_change(ID(1, 0)), doc.get_change(ID(1, 3))
+    assert first["message"] == "first commit" and first["timestamp"] == 100
+    assert second["message"] == "second commit" and second["timestamp"] == 200
+
+
+def test_noop_revert_preserves_next_commit_options():
+    doc = LoroDoc(peer=1)
+    doc.get_text("t").insert(0, "a")
+    doc.commit()
+    doc.set_next_commit_message("kept")
+    doc.revert_to(doc.oplog_frontiers())  # no-op revert: empty diff batch
+    doc.get_text("t").insert(1, "b")
+    doc.commit()
+    assert doc.get_change(ID(1, 1))["message"] == "kept"
+
+
 def test_commit_with_empty_drops_timestamp():
     doc = LoroDoc(peer=1)
     doc.commit_with(timestamp=12345)  # nothing pending: dropped

@@ -1,10 +1,13 @@
-"""Long multi-actor convergence soak (tests/test_fuzz.py machinery,
-many more seeds and longer traces + periodic snapshot rejoin)."""
+"""Long multi-actor convergence soak — NOT collected by pytest.
+
+Run: python tests/soak_convergence.py  (~2.5 min for 600 seeds)
+Extends tests/test_fuzz.py machinery with more seeds, longer traces,
+snapshot rejoins, and periodic slow correctness checks."""
 import random
 import sys
 import time
 
-sys.path.insert(0, "tests")
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -3,11 +3,15 @@
 Run: python tests/soak_convergence.py  (~2.5 min for 600 seeds)
 Extends tests/test_fuzz.py machinery with more seeds, longer traces,
 snapshot rejoins, and periodic slow correctness checks."""
+import os
 import random
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+import os.path as _p
+_here = _p.dirname(_p.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, _p.dirname(_here))  # repo root for loro_tpu
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -16,7 +20,7 @@ from test_fuzz import Actor, assert_converged, sync_all, sync_pair  # noqa: E402
 
 t0 = time.time()
 done = 0
-for seed in range(1000, 1600):
+for seed in range(1000, 1000 + int(os.environ.get("SOAK_SEEDS", "600"))):
     rng = random.Random(seed)
     n_act = 3 + seed % 3
     actors = [Actor(i + 1, rng, with_undo=(seed % 4 == 0 and i == 0)) for i in range(n_act)]

@@ -795,8 +795,6 @@ class LoroDoc:
         from .codec import snapshot as scodec
         from .codec.binary import Reader
 
-        if not self.oplog.is_empty() or self.state.states:
-            raise LoroError("shallow snapshots can only be imported into an empty doc")
         try:
             r = Reader(payload)
             state_bytes = r.bytes_()
@@ -806,6 +804,17 @@ class LoroDoc:
             changes = bcodec.decode_changes(updates) if updates else []
         except Exception as e:
             raise DecodeError(f"malformed shallow snapshot: {e}") from e
+        if not self.oplog.is_empty() or self.state.states:
+            # non-empty doc: usable iff our history already covers the
+            # frozen base — then the retained ops import as plain
+            # updates and the base is redundant (reference:
+            # should_import_snapshot_before_shallow semantics)
+            if base_vv <= self.oplog.vv:
+                return self._import_changes(changes, origin)
+            raise LoroError(
+                "shallow snapshot into a non-empty doc requires the doc "
+                "to already contain the history below the shallow root"
+            )
         try:
             self._install_shallow_base(state_bytes, base_vv, base_f)
             try:
@@ -845,9 +854,18 @@ class LoroDoc:
         self.oplog.dag.set_shallow_root(vv, f)
 
     def _import_changes(self, changes: List[Change], origin: str) -> ImportStatus:
+        backfill = (
+            self.oplog.plan_backfill(changes) if self._shallow_base is not None else None
+        )
         with tracing.span("oplog.import", n_changes=len(changes)):
             plan = self.oplog.plan_import(changes)
             self._validate_planned(plan.inserts)
+            # everything validated: commit the shallow upgrade first
+            # (pre-floor splice), then the regular inserts — a failure
+            # above leaves oplog, dag, and shallow root untouched
+            if backfill is not None:
+                self.oplog.commit_backfill(backfill)
+                self._shallow_base = None
             applied, pending = self.oplog.commit_import(plan)
         success = VersionRange()
         for ch in applied:
@@ -1169,12 +1187,25 @@ class LoroDoc:
     # fork
     # ------------------------------------------------------------------
     def fork(self) -> "LoroDoc":
-        """Deep copy at current version (reference: fork.rs)."""
+        """Deep copy at the CURRENT version: a detached doc forks its
+        checked-out state, not the latest history (reference: fork.rs +
+        test_fork_when_detached)."""
+        if self._detached:
+            return self.fork_at(self.state_frontiers())
         new = LoroDoc()
         new.import_(self.export(ExportMode.Snapshot), origin="fork")
         return new
 
     def fork_at(self, frontiers: Frontiers) -> "LoroDoc":
+        # typed validation: vv membership is not enough on shallow docs
+        # (ids below the floor are in the vv but have no dag node); the
+        # floor frontiers themselves are the one representable exception
+        if frontiers != self.oplog.dag.shallow_since_frontiers:
+            if self.is_shallow() and frontiers.is_empty():
+                raise LoroError("fork_at below the shallow root")
+            for id_ in frontiers:
+                if self.oplog.dag.node_at(id_) is None:
+                    raise LoroError(f"fork_at frontiers not in history: {id_}")
         new = LoroDoc()
         new.import_(self.export(ExportMode.SnapshotAt(frontiers)), origin="fork")
         return new

@@ -122,6 +122,29 @@ class AppDag:
         new_heads.append(ID(peer, ctr_end - 1))
         self.frontiers = Frontiers(new_heads)
 
+    def backfill_and_unshallow(
+        self,
+        spans_by_peer: Dict[PeerID, List[Tuple[Counter, Counter, Lamport, Tuple[ID, ...]]]],
+    ) -> None:
+        """Shallow-history upgrade (OpLog.backfill_below_floor commits
+        through here): splice pre-floor spans below the existing
+        per-peer node lists, drop the shallow root, and invalidate every
+        memoized closure — cached node VVs were computed with the old
+        floor folded in and would over-approximate real causality."""
+        for p, spans in spans_by_peer.items():
+            new = [DagNode(p, cs, ce, lam, deps) for cs, ce, lam, deps in spans]
+            cur = self._nodes.get(p, [])
+            self._nodes[p] = new + cur
+            self._starts[p] = [n.ctr_start for n in self._nodes[p]]
+        self.shallow_since_vv = VersionVector()
+        self.shallow_since_frontiers = Frontiers()
+        for lst in self._nodes.values():
+            for n in lst:
+                n._vv = None
+        cache = getattr(self, "_f2vv_cache", None)
+        if cache:
+            cache.clear()
+
     def update_frontiers_on_new_change(self, change_last_id: ID, deps: Frontiers) -> None:
         heads = [i for i in self.frontiers if i not in set(deps)]
         heads.append(change_last_id)

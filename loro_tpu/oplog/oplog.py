@@ -196,6 +196,94 @@ class OpLog:
             return
         self._insert_change(change)
 
+    def plan_backfill(self, changes: Iterable[Change]) -> Optional[Dict[PeerID, List[Change]]]:
+        """Shallow-history upgrade, planning half (pure — no mutation):
+        when the incoming batch fully covers every peer's trimmed range
+        [0, floor_p) with structurally-valid changes, return the spliced
+        plan; else None (reference semantics:
+        should_import_snapshot_before_shallow — a full snapshot arriving
+        after a shallow one un-shallows the doc).  All-or-nothing."""
+        floor = self.dag.shallow_since_vv
+        if not len(floor):
+            return None
+        # collect pre-floor slices per peer
+        pieces: Dict[PeerID, Dict[Counter, Change]] = {}
+        for ch in changes:
+            fp = floor.get(ch.peer)
+            if fp <= 0 or ch.ctr_start >= fp:
+                continue
+            piece = _slice_change_end(ch, fp) if ch.ctr_end > fp else ch
+            pieces.setdefault(ch.peer, {})[piece.ctr_start] = piece
+        # coverage: every floor peer's [0, floor_p) must tile exactly
+        plan: Dict[PeerID, List[Change]] = {}
+        for p, fp in floor.items():
+            if fp <= 0:
+                continue
+            have = sorted(pieces.get(p, {}).values(), key=lambda c: c.ctr_start)
+            at = 0
+            for ch in have:
+                if ch.ctr_start != at:
+                    return None
+                at = ch.ctr_end
+            if at != fp:
+                return None
+            plan[p] = have
+        # structural validation — these changes bypass plan_import (the
+        # floor vv marks their span as known), so vet them here: deps
+        # inside the covered history, lamports monotone per peer and
+        # >= every dep's lamport end, and consistent with the existing
+        # floor nodes.  A violation means a malformed blob: no upgrade.
+        full_vv = self.vv.copy()
+
+        def lamport_end_of(d: ID) -> Optional[int]:
+            node = self.dag.node_at(d)
+            if node is not None:
+                return node.lamport_of(d.counter) + 1
+            lst = plan.get(d.peer)
+            if lst is None:
+                return None
+            for c in lst:
+                if c.ctr_start <= d.counter < c.ctr_end:
+                    return c.lamport + (d.counter - c.ctr_start) + 1
+            return None
+
+        for p, lst in plan.items():
+            prev_end = 0
+            for ch in lst:
+                if ch.lamport < prev_end:
+                    return None
+                prev_end = ch.lamport_end
+                for d in ch.deps:
+                    if not full_vv.includes(d):
+                        return None
+                    dl = lamport_end_of(d)
+                    if dl is None or ch.lamport < dl:
+                        return None
+            # the first retained (post-floor) node must sit at/after the
+            # backfilled lamport range
+            floor_node = self.dag.node_at(ID(p, floor.get(p)))
+            if floor_node is not None and floor_node.lamport < prev_end:
+                return None
+        return plan
+
+    def commit_backfill(self, plan: Dict[PeerID, List[Change]]) -> None:
+        """Commit a plan_backfill result: splice the pre-floor changes
+        below the per-peer lists, rebuild dag nodes, drop the shallow
+        root.  Call only after the rest of the import batch has been
+        validated (leave-untouched-on-failure contract)."""
+        for p, lst in plan.items():
+            self._hydrate_peer(p)
+            self._dirty_peers.add(p)
+            cur = self.changes.get(p, [])
+            self.changes[p] = lst + cur
+            self._starts[p] = [c.ctr_start for c in self.changes[p]]
+            for ch in lst:
+                if ch.lamport_end > self.next_lamport:
+                    self.next_lamport = ch.lamport_end
+        self.dag.backfill_and_unshallow(
+            {p: [(c.ctr_start, c.ctr_end, c.lamport, tuple(c.deps)) for c in lst] for p, lst in plan.items()}
+        )
+
     def _register_span(self, ch: Change) -> None:
         """DAG/lamport bookkeeping shared by fresh inserts and RLE-merges."""
         self.dag.add_node(ch.peer, ch.ctr_start, ch.ctr_end, ch.lamport, tuple(ch.deps))

@@ -1,0 +1,181 @@
+"""Shallow-history upgrade: full history arriving after a shallow
+snapshot un-shallows the doc (reference:
+should_import_snapshot_before_shallow + shallow sync semantics)."""
+import pytest
+
+from loro_tpu import ExportMode, Frontiers, ID, LoroDoc, LoroError
+
+
+def _history_doc(n=6):
+    a = LoroDoc(peer=1)
+    t = a.get_text("t")
+    for i in range(n):
+        t.insert(len(t), str(i))
+        a.commit()
+    f = a.oplog_frontiers()
+    t.push("z")
+    a.commit()
+    return a, f
+
+
+def test_full_snapshot_after_shallow_unshallows():
+    a, f = _history_doc()
+    shallow = a.export(ExportMode.ShallowSnapshot(f))
+    full = a.export(ExportMode.Snapshot)
+    b = LoroDoc(peer=2)
+    b.import_(shallow)
+    assert b.is_shallow()
+    b.import_(full)
+    assert not b.is_shallow()
+    assert b.get_text("t").to_string() == a.get_text("t").to_string()
+    # time travel below the old floor works now
+    b.checkout(Frontiers([ID(1, 1)]))
+    assert b.get_text("t").to_string() == "01"
+    b.checkout_to_latest()
+    assert b.get_deep_value() == a.get_deep_value()
+
+
+def test_import_batch_shallow_plus_full():
+    a, f = _history_doc()
+    blobs = [a.export(ExportMode.ShallowSnapshot(f)), a.export(ExportMode.Snapshot)]
+    b = LoroDoc(peer=2)
+    b.import_batch(blobs)
+    assert not b.is_shallow()
+    assert b.get_text("t").to_string() == a.get_text("t").to_string()
+
+
+def test_full_updates_after_shallow_unshallows():
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+    assert b.is_shallow()
+    b.import_(a.export_updates())  # complete history from counter 0
+    assert not b.is_shallow()
+    b.checkout(Frontiers([ID(1, 0)]))
+    assert b.get_text("t").to_string() == "0"
+
+
+def test_partial_prefloor_updates_keep_shallow():
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+    # an update blob covering only part of the trimmed range
+    partial = a.export_updates()  # full...
+    # craft partiality by re-exporting from counter 2 only
+    from loro_tpu.core.version import VersionVector
+
+    part = a.export_updates(VersionVector({1: 2}))
+    b2 = LoroDoc(peer=3)
+    b2.import_(a.export(ExportMode.ShallowSnapshot(f)))
+    b2.import_(part)
+    assert b2.is_shallow()  # [0,2) still missing: no upgrade
+    assert b2.get_text("t").to_string() == a.get_text("t").to_string()
+    del partial
+
+
+def test_shallow_into_nonempty_doc_with_full_history():
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.import_(a.export(ExportMode.Snapshot))  # full first
+    b.import_(a.export(ExportMode.ShallowSnapshot(f)))  # then shallow
+    assert not b.is_shallow()
+    assert b.get_text("t").to_string() == a.get_text("t").to_string()
+
+
+def test_shallow_into_unrelated_nonempty_doc_raises():
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.get_map("m").set("k", 1)
+    b.commit()
+    with pytest.raises(LoroError):
+        b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+
+
+def test_unshallowed_doc_exports_full_snapshots():
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+    b.import_(a.export(ExportMode.Snapshot))
+    c = LoroDoc.from_snapshot(b.export(ExportMode.Snapshot))
+    assert not c.is_shallow()
+    assert c.get_deep_value() == a.get_deep_value()
+    c.checkout(Frontiers([ID(1, 0)]))
+    assert c.get_text("t").to_string() == "0"
+
+
+def test_corrupt_postfloor_blob_does_not_unshallow():
+    """A blob that covers the trimmed range but whose post-floor part is
+    corrupt must fail typed and leave the doc shallow + untouched."""
+    from loro_tpu import DecodeError
+    from loro_tpu.codec import binary as bcodec
+    from loro_tpu.core.change import Change, Op, SeqInsert, Side
+
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+    full_changes = a.oplog.changes_in_causal_order()
+    # append a corrupt change: placement parent that can never exist
+    last = full_changes[-1]
+    bad = Change(
+        ID(7, 0),
+        lamport=last.lamport_end + 1,
+        deps=Frontiers([last.last_id()]),
+        ops=[Op(0, list(last.ops)[0].container, SeqInsert(ID(55, 999), Side.Right, "x"))],
+    )
+    blob = b._encode_changes(full_changes + [bad], __import__("loro_tpu.doc", fromlist=["EncodeMode"]).EncodeMode.ColumnarUpdates)
+    before = b.len_changes()
+    with pytest.raises(DecodeError):
+        b.import_(blob)
+    assert b.is_shallow()  # upgrade rolled together with the failure
+    assert b.len_changes() == before
+
+
+def test_fork_at_below_shallow_floor_raises():
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+    with pytest.raises(LoroError):
+        b.fork_at(Frontiers([ID(1, 0)]))
+    with pytest.raises(LoroError):
+        b.fork_at(Frontiers())
+    # the floor itself is representable
+    fk = b.fork_at(b.shallow_since_frontiers())
+    assert fk.get_text("t").to_string() == "012345"
+
+
+def test_fork_when_detached_forks_checked_out_state():
+    """reference: test_fork_when_detached."""
+    doc = LoroDoc(peer=0)
+    doc.get_text("text").insert(0, "Hello, world!")
+    doc.commit()
+    doc.checkout(Frontiers([ID(0, 5)]))
+    new_doc = doc.fork()
+    new_doc.set_peer_id(1)
+    new_doc.get_text("text").insert(6, " Alice!")
+    new_doc.commit()
+    doc.import_(new_doc.export_updates())
+    doc.checkout_to_latest()
+    assert doc.get_text("text").to_string() == "Hello, world! Alice!"
+
+
+def test_fork_at_invalid_frontiers_raises():
+    doc = LoroDoc(peer=1)
+    doc.get_text("t").insert(0, "x")
+    doc.commit()
+    with pytest.raises(LoroError):
+        doc.fork_at(Frontiers([ID(99, 5)]))
+
+
+def test_unshallow_then_continue_editing_and_sync():
+    a, f = _history_doc()
+    b = LoroDoc(peer=2)
+    b.import_(a.export(ExportMode.ShallowSnapshot(f)))
+    b.import_(a.export_updates())
+    assert not b.is_shallow()
+    b.get_text("t").push("B")
+    b.commit()
+    a.import_(b.export_updates(a.oplog_vv()))
+    b.import_(a.export_updates(b.oplog_vv()))
+    assert a.get_deep_value() == b.get_deep_value()
+    a.check_state_correctness_slow()
+    b.check_state_correctness_slow()

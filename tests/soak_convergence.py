@@ -20,7 +20,8 @@ from test_fuzz import Actor, assert_converged, sync_all, sync_pair  # noqa: E402
 
 t0 = time.time()
 done = 0
-for seed in range(1000, 1000 + int(os.environ.get("SOAK_SEEDS", "600"))):
+SOAK_BASE = int(os.environ.get("SOAK_BASE", "1000"))
+for seed in range(SOAK_BASE, SOAK_BASE + int(os.environ.get("SOAK_SEEDS", "600"))):
     rng = random.Random(seed)
     n_act = 3 + seed % 3
     actors = [Actor(i + 1, rng, with_undo=(seed % 4 == 0 and i == 0)) for i in range(n_act)]

@@ -29,6 +29,7 @@ layer (parallel/fleet.py) shards the doc axis over the device mesh.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -261,10 +262,17 @@ def _order_core(
     succ = succ.at[ENTER0 + root].set(succ_enter[root])
 
     # -- Wyllie list ranking: distance to terminal --------------------
-    from .pallas_rank import use_pallas_rank, wyllie_rank
+    from .pallas_rank import pallas_rank_applicable, wyllie_rank
 
-    if use_pallas_rank():
-        # VMEM-resident pointer doubling (opt-in until TPU-profiled)
+    # precedence: an explicit RANK_ALGO=ruling beats the auto-on pallas
+    # default (so algo comparisons stay honest), but an explicit
+    # PALLAS_RANK=1 beats everything
+    explicit_pallas = os.environ.get("PALLAS_RANK", "") not in ("", "0")
+    if pallas_rank_applicable(int(succ.shape[0])) and (
+        _rank_algo() != "ruling" or explicit_pallas
+    ):
+        # VMEM-resident pointer doubling (default on TPU; falls back to
+        # the XLA formulation for rings too long for the rotate loop)
         dist = wyllie_rank(succ)
     elif _rank_algo() == "ruling":
         dist = _ruling_dist(succ)

@@ -8,10 +8,19 @@ v5e showed that loop dominating merge time (random-access gathers at
 <=200KB) fits in VMEM (~16MB/core), so this kernel keeps both arrays
 on-chip for all ceil(log2(m)) rounds and only touches HBM twice.
 
-Status: semantics validated in interpreter mode (tests); real-TPU
-lowering of the in-kernel dynamic gather (jnp.take along lanes) is
-gated behind use_pallas_rank()/PALLAS_RANK=1 until profiled on
-hardware — the XLA path remains the default.
+Status: validated AND profiled on a real v5e (2026-07-29).  The
+deployed Mosaic toolchain only lowers dynamic_gather along lanes
+(axis=1, <=128 lanes; axis-0 gathers past one 8-sublane vreg fail
+remote compile), so the arbitrary gather is decomposed as an R-step
+row-rotate loop (see _vmem_gather).  Measured on the flagship ring
+shape (m=32896), amortized over distinct rings in one jit:
+  single ring: 5.0 ms vs 11.1 ms XLA textbook loop
+  vmap8 chunk: 15.2 ms vs 128.2 ms XLA  (8.4x on the bench shape;
+    grid programs pipeline, so per-ring cost drops to 1.9 ms)
+Default: ON when the backend is TPU and the ring fits
+PALLAS_RANK_MAX_M; force with PALLAS_RANK=1, disable with
+PALLAS_RANK=0.  Off-TPU the XLA path remains the default (the
+interpreter-mode kernel is for differential tests).
 """
 from __future__ import annotations
 
@@ -33,41 +42,169 @@ except Exception:  # pragma: no cover
 
 
 def use_pallas_rank() -> bool:
-    return HAVE_PALLAS and os.environ.get("PALLAS_RANK", "") not in ("", "0")
+    """PALLAS_RANK=1 forces on, =0 forces off; unset = auto (on iff the
+    backend is TPU — measured 8.4x over the XLA rank on v5e)."""
+    if not HAVE_PALLAS:
+        return False
+    flag = os.environ.get("PALLAS_RANK", "")
+    if flag == "0":
+        return False
+    if flag:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend init failure — stay on the XLA path
+        return False
+
+
+# Above this ring length the R-step rotate loop (R = m/128 iterations
+# per doubling round) loses to the HBM gather formulation; callers fall
+# back to the XLA path.
+PALLAS_RANK_MAX_M = 1 << 17
+
+
+def pallas_rank_applicable(m: int) -> bool:
+    return use_pallas_rank() and m <= PALLAS_RANK_MAX_M
+
+
+_LANES = 128
+
+
+def _vmem_gather(tbl, rows, cols):
+    """Full dynamic gather out[i,j] = tbl[rows[i,j], cols[i,j]] from the
+    one dynamic_gather form the deployed Mosaic accepts: within-row lane
+    gather (take_along_axis axis=1, <=128 lanes, any sublane count;
+    axis-0 gathers beyond one 8-sublane vreg fail to compile on this
+    libtpu).  Arbitrary (row, lane) addressing is decomposed as an
+    R-step row-rotate loop: after t rolls, rot[i, :] = tbl[(i+t) % R, :],
+    so a lane-gather with `cols` yields tbl[(i+t) % R, cols[i,j]], kept
+    wherever rows[i,j] == (i+t) % R.  All operands stay in
+    VMEM/registers; per-iteration work is ~5 VPU ops on a [R, 128]
+    tile, so the whole loop is ~1 ms — vs an HBM round-trip per
+    doubling round in the XLA formulation."""
+    shape = tbl.shape
+    n_rows = shape[0]
+    iota0 = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+
+    def body(t, carry):
+        acc, rot = carry
+        g = jnp.take_along_axis(rot, cols, axis=1, mode="promise_in_bounds")
+        src = iota0 + t
+        src = jnp.where(src >= n_rows, src - n_rows, src)
+        acc = jnp.where(rows == src, g, acc)
+        return acc, pltpu.roll(rot, n_rows - 1, axis=0)
+
+    acc = jnp.zeros(shape, tbl.dtype)
+    acc, _ = jax.lax.fori_loop(0, n_rows, body, (acc, tbl))
+    return acc
+
+
+def _vmem_gather2(tbl_a, tbl_b, rows, cols):
+    """Gather TWO same-shape tables at the same (rows, cols) addresses in
+    one rotate loop (shared hit masks; used when (dist, succ) cannot
+    pack into one u32)."""
+    shape = tbl_a.shape
+    n_rows = shape[0]
+    iota0 = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+
+    def body(t, carry):
+        acc_a, acc_b, rot_a, rot_b = carry
+        ga = jnp.take_along_axis(rot_a, cols, axis=1, mode="promise_in_bounds")
+        gb = jnp.take_along_axis(rot_b, cols, axis=1, mode="promise_in_bounds")
+        src = iota0 + t
+        src = jnp.where(src >= n_rows, src - n_rows, src)
+        hit = rows == src
+        return (
+            jnp.where(hit, ga, acc_a),
+            jnp.where(hit, gb, acc_b),
+            pltpu.roll(rot_a, n_rows - 1, axis=0),
+            pltpu.roll(rot_b, n_rows - 1, axis=0),
+        )
+
+    acc_a = jnp.zeros(shape, tbl_a.dtype)
+    acc_b = jnp.zeros(shape, tbl_b.dtype)
+    acc_a, acc_b, _, _ = jax.lax.fori_loop(
+        0, n_rows, body, (acc_a, acc_b, tbl_a, tbl_b)
+    )
+    return acc_a, acc_b
+
+
+def _rank_kernel_wide(succ_ref, dist_ref, n_steps: int):
+    """Dual-table variant for rings longer than 65536 tokens (dist no
+    longer fits 16 bits): carry (dist i32, succ i32) separately and
+    gather both per round with shared address masks."""
+    rows, cols = succ_ref.shape
+    succ = succ_ref[:, :]
+    flat_idx = (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) * _LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+    )
+    dist = jnp.where(succ == flat_idx, jnp.int32(0), jnp.int32(1))
+
+    def round_body(_, carry):
+        d, s = carry
+        gd, gs = _vmem_gather2(
+            d, s, jnp.right_shift(s, 7), jnp.bitwise_and(s, 0x7F)
+        )
+        return d + gd, gs
+
+    dist, _ = jax.lax.fori_loop(0, n_steps, round_body, (dist, succ))
+    dist_ref[:, :] = dist
 
 
 def _rank_kernel(succ_ref, dist_ref, n_steps: int):
-    m = succ_ref.shape[-1]
-    succ = succ_ref[0, :]
-    idx = jax.lax.broadcasted_iota(jnp.int32, (m,), 0)
-    dist = jnp.where(succ == idx, jnp.int32(0), jnp.int32(1))
+    """(dist, succ) packed as one u32 per element — dist in the high 16
+    bits, succ in the low 16 (legal while m <= 65536; dist-to-terminal
+    is < m so the high half never carries).  One packed gather per
+    Wyllie round: g = p[s];  p' = (p & 0xffff0000) + g  gives
+    dist' = dist + dist[s], succ' = succ[s] in two VPU ops."""
+    rows, cols = succ_ref.shape
+    succ = succ_ref[:, :]
+    flat_idx = (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) * _LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+    )
+    dist = jnp.where(succ == flat_idx, jnp.uint32(0), jnp.uint32(1))
+    packed = jnp.bitwise_or(
+        jnp.left_shift(dist, 16), succ.astype(jnp.uint32)
+    )
 
-    def body(_, carry):
-        d, s = carry
-        d = d + jnp.take(d, s, axis=0)
-        s = jnp.take(s, s, axis=0)
-        return d, s
+    def round_body(_, p):
+        s = jnp.bitwise_and(p, jnp.uint32(0xFFFF)).astype(jnp.int32)
+        g = _vmem_gather(p, jnp.right_shift(s, 7), jnp.bitwise_and(s, 0x7F))
+        return jnp.bitwise_and(p, jnp.uint32(0xFFFF0000)) + g
 
-    dist, _ = jax.lax.fori_loop(0, n_steps, body, (dist, succ))
-    dist_ref[0, :] = dist
+    packed = jax.lax.fori_loop(0, n_steps, round_body, packed)
+    dist_ref[:, :] = jnp.right_shift(packed, 16).astype(jnp.int32)
 
 
 def wyllie_rank(succ: jax.Array, interpret: Optional[bool] = None) -> jax.Array:
     """dist-to-terminal for a successor ring (terminal = self-loop).
     succ: i32[m]; returns i32[m].  `interpret=None` auto-selects the
-    interpreter off-TPU (CI / CPU mesh runs)."""
+    interpreter off-TPU (CI / CPU mesh runs).  Pads internally to a
+    multiple of 128 lanes (pad tokens are self-loop terminals, dist 0);
+    rings <= 65536 tokens use the packed-u32 kernel, longer rings the
+    dual-table one."""
     m = succ.shape[0]
+    mp = -(-m // _LANES) * _LANES
+    if mp > PALLAS_RANK_MAX_M:
+        raise ValueError(f"ring too long for VMEM ranking: {m}")
+    if mp != m:
+        pad_ids = jnp.arange(m, mp, dtype=jnp.int32)
+        succ = jnp.concatenate([succ.astype(jnp.int32), pad_ids])
     n_steps = max(1, int(np.ceil(np.log2(max(m, 2)))))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    rows = mp // _LANES
+    kernel = _rank_kernel if mp <= 65536 else _rank_kernel_wide
     fn = pl.pallas_call(
-        functools.partial(_rank_kernel, n_steps=n_steps),
-        out_shape=jax.ShapeDtypeStruct((1, m), jnp.int32),
+        functools.partial(kernel, n_steps=n_steps),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
     )
-    return fn(succ.reshape(1, m))[0]
+    return fn(succ.reshape(rows, _LANES)).reshape(mp)[:m]
 
 
 def wyllie_rank_xla(succ: jax.Array) -> jax.Array:

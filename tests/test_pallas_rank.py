@@ -27,6 +27,28 @@ def test_matches_xla(m):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("m", [65536, 65600])
+def test_packed_boundary_and_wide_kernel(m):
+    """m == 65536 is the last packed-u32 ring; m > 65536 selects the
+    dual-table wide kernel (_rank_kernel_wide / _vmem_gather2)."""
+    import jax.numpy as jnp
+
+    succ = jnp.asarray(_random_ring(m, m))
+    got = np.asarray(wyllie_rank(succ, interpret=True))
+    want = np.asarray(wyllie_rank_xla(succ))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_too_long_ring_raises():
+    import jax.numpy as jnp
+
+    from loro_tpu.ops.pallas_rank import PALLAS_RANK_MAX_M
+
+    succ = jnp.zeros(PALLAS_RANK_MAX_M + 1, jnp.int32)
+    with pytest.raises(ValueError):
+        wyllie_rank(succ, interpret=True)
+
+
 def test_distances_are_list_positions():
     import jax.numpy as jnp
 

@@ -384,6 +384,19 @@ class ChainColumns(NamedTuple):
     valid: jax.Array  # bool[N]
 
 
+def _place_algo() -> str:
+    """Element placement: "sort" (default — one stable sort; measured
+    ~2x the scatter formulation on v5e, where random HBM access costs
+    ~100M rows/s but a [8, 188k] sort is ~10 ms) or "scatter" (the
+    histogram + gather + positional-scatter formulation).  Read at
+    TRACE time: set it before the first merge call of the process
+    (already-jitted kernels do not retrace on env changes)."""
+    algo = os.environ.get("PLACE_ALGO", "sort")
+    if algo not in ("sort", "scatter"):
+        raise ValueError(f"PLACE_ALGO must be 'sort' or 'scatter', got {algo!r}")
+    return algo
+
+
 def _place_by_chain(
     crank: jax.Array,
     c_valid: jax.Array,
@@ -392,10 +405,23 @@ def _place_by_chain(
     visible: jax.Array,
     content: jax.Array,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Shared element placement for both chain paths: chain base
-    positions from a rank histogram + exclusive cumsum, within-chain
-    prefixes from row cumsums (chain rows are contiguous), then a
-    positional scatter of the content codes."""
+    """Shared element placement for both chain paths (PLACE_ALGO)."""
+    if _place_algo() == "sort":
+        return _place_by_chain_sort(crank, c_valid, head_row, visible, content)
+    return _place_by_chain_scatter(crank, c_valid, chain_id, head_row, visible, content)
+
+
+def _place_by_chain_scatter(
+    crank: jax.Array,
+    c_valid: jax.Array,
+    chain_id: jax.Array,
+    head_row: jax.Array,
+    visible: jax.Array,
+    content: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Histogram placement: chain base positions from a rank histogram +
+    exclusive cumsum, within-chain prefixes from row cumsums (chain rows
+    are contiguous), then a positional scatter of the content codes."""
     c = crank.shape[0]
     n = chain_id.shape[0]
     vis_i = visible.astype(jnp.int32)
@@ -419,16 +445,58 @@ def _place_by_chain(
     return codes, count
 
 
+def _place_by_chain_sort(
+    crank: jax.Array,
+    c_valid: jax.Array,
+    head_row: jax.Array,
+    visible: jax.Array,
+    content: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sort placement: expand chain ranks to elements with a C-scatter
+    of telescoping rank deltas at head rows + one N-cumsum (chain rows
+    are contiguous and chain ids ascend with row, so the cumsum
+    reconstructs crank[chain_id[row]] exactly, including int32
+    wraparound), then ONE stable sort of (key, content) realizes the
+    whole placement: ascending rank = document order, stability keeps
+    within-chain row order.  Every invisible row (deleted, pad,
+    overflow) gets the absolute max key so it sorts behind ALL visible
+    rows and the first `count` sorted codes are exactly the document."""
+    n = visible.shape[0]
+    vis_i = visible.astype(jnp.int32)
+    # invalid chains are trailing (both contraction paths), so the
+    # telescoping prev of any valid chain is valid (or the 0 seed)
+    prev = jnp.concatenate([jnp.zeros(1, crank.dtype), crank[:-1]])
+    delta = jnp.where(c_valid, crank - prev, 0)
+    seg = (
+        jnp.zeros(n + 1, jnp.int32)
+        .at[jnp.where(c_valid, head_row, n)]
+        .add(delta, mode="drop")[:n]
+    )
+    crank_elem = jnp.cumsum(seg)
+    key = jnp.where(
+        visible, crank_elem.astype(jnp.uint32), jnp.uint32(0xFFFFFFFF)
+    )
+    _, content_sorted = jax.lax.sort((key, content), num_keys=1, is_stable=True)
+    count = vis_i.sum().astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    codes = jnp.where(idx < count, content_sorted, jnp.int32(-1))
+    return codes, count
+
+
 def chain_materialize(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
     """Merge via chain contraction: rank C chains (C << N), then place
-    all N elements with pure vector ops (segment sums / cumsum / one
-    gather) — the gather-heavy ranking runs on the contracted tree only.
+    all N elements via _place_by_chain (default: rank expansion by
+    C-scatter + N-cumsum, then one stable N-row sort; PLACE_ALGO=scatter
+    selects the histogram + gather + positional-scatter formulation) —
+    the gather-heavy ranking runs on the contracted tree only.
     Returns (codes i32[N] padded with -1, visible count)."""
     c = cols.c_parent.shape[0]
     crank = _order_core(cols.c_parent, cols.c_side, cols.c_valid)  # i32[C]
     visible = cols.valid & ~cols.deleted
     chain_id = jnp.where(cols.valid, cols.chain_id, c)
-    return _place_by_chain(crank, cols.c_valid, chain_id, cols.head_row, visible, cols.content)
+    return _place_by_chain(
+        crank, cols.c_valid, chain_id, cols.head_row, visible, cols.content
+    )
 
 
 chain_materialize_batch = jax.vmap(chain_materialize)
@@ -616,7 +684,9 @@ def chain_contract_materialize_u(
     )  # [c_pad]
 
     visible = valid & ~cols.deleted & (cols.content >= 0)
-    codes, count = _place_by_chain(crank, c_valid, chain_id, head_row, visible, cols.content)
+    codes, count = _place_by_chain(
+        crank, c_valid, chain_id, head_row, visible, cols.content
+    )
     return codes, count, n_chains
 
 

@@ -29,7 +29,7 @@ def _build() -> bool:
     tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process: concurrent builds don't race
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
             timeout=120,

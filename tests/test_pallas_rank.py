@@ -56,3 +56,34 @@ def test_distances_are_list_positions():
     dist = np.asarray(wyllie_rank(succ, interpret=True))
     # unique distances 0..m-1, strictly decreasing along the ring
     assert sorted(dist.tolist()) == list(range(512))
+
+
+@pytest.mark.parametrize("m", [128, 1024, 32770])
+def test_ruling_kernel_matches_xla(m, monkeypatch):
+    """PALLAS_RANK_ALGO=ruling selects the ruling-set kernel (phase-1
+    freeze at index%8 rulers + dense ring + sink row)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PALLAS_RANK_ALGO", "ruling")
+    succ = jnp.asarray(_random_ring(m, m))
+    got = np.asarray(wyllie_rank(succ, interpret=True))
+    want = np.asarray(wyllie_rank_xla(succ))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ruling_kernel_adversarial_gap(monkeypatch):
+    """All non-rulers consecutive along the ring: the phase-1 round cap
+    must still produce exact distances (cap-hit pointers rest on the
+    terminal)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PALLAS_RANK_ALGO", "ruling")
+    m, k = 2048, 8
+    order = [i for i in range(m) if i % k != 0] + [i for i in range(m) if i % k == 0]
+    succ = np.arange(m, dtype=np.int32)
+    for a, b in zip(order[:-1], order[1:]):
+        succ[a] = b
+    s = jnp.asarray(succ)
+    got = np.asarray(wyllie_rank(s, interpret=True))
+    want = np.asarray(wyllie_rank_xla(s))
+    np.testing.assert_array_equal(got, want)

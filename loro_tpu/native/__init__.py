@@ -66,6 +66,8 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong,
             ctypes.c_int,
         ]
+        lib.loro_set_rowtable_budget.restype = None
+        lib.loro_set_rowtable_budget.argtypes = [ctypes.c_longlong]
         lib.loro_explode_seq.restype = ctypes.c_longlong
         lib.loro_explode_seq.argtypes = [
             ctypes.c_char_p,

@@ -94,6 +94,8 @@ bool skip_value(Reader& r) {
   }
 }
 
+struct ChangeMeta;
+
 // open-addressing hash map: (peer_idx, counter) -> element row
 struct IdMap {
   std::vector<uint64_t> keys;
@@ -106,6 +108,8 @@ struct IdMap {
     vals.assign(cap, -1);
     mask = cap - 1;
   }
+  IdMap(uint64_t, const std::vector<ChangeMeta>&, size_t n)
+      : IdMap(n > 16 ? n : 16) {}
   static uint64_t mix(uint64_t k) {
     k ^= k >> 33; k *= 0xff51afd7ed558ccdULL; k ^= k >> 33;
     k *= 0xc4ceb9fe1a85ec53ULL; k ^= k >> 33; return k;
@@ -123,10 +127,76 @@ struct IdMap {
     }
     return -1;
   }
+  bool overflow() const { return false; }
 };
 
 inline uint64_t idkey(uint32_t peer_idx, int64_t counter) {
   return ((uint64_t)peer_idx << 40) | (uint64_t)(counter & 0xffffffffffLL);
+}
+
+struct ChangeMeta {
+  uint32_t peer_idx;
+  int64_t ctr;
+  int64_t lamport;
+  uint64_t n_ops;
+};
+
+// Direct-address (peer, counter) -> row table: causal payloads have
+// near-dense insert counters per peer, so idkey lookups become plain
+// array loads (~2x on the 182k-row trace vs the open-addressing map,
+// whose random probes miss cache).  Per-peer vectors grow on demand;
+// a global entry budget guards against adversarial sparse counters
+// (huge delete spans between inserts) — on overflow the caller falls
+// back to the IdMap path, so behavior is identical on any input.
+struct RowTable {
+  std::vector<std::vector<int32_t>> t;
+  std::vector<uint64_t> base;  // 40-bit masked, matching idkey()
+  size_t total = 0, budget;
+  bool over = false;
+  RowTable(uint64_t n_peers, const std::vector<ChangeMeta>& metas,
+           size_t n_elems);
+  // index math in uint64: crafted payloads can carry counters anywhere
+  // in the zigzag range, and signed subtraction would be UB; a wrapped
+  // huge index simply trips the budget -> IdMap fallback
+  void put(uint64_t key, int32_t row) {
+    uint32_t p = (uint32_t)(key >> 40);
+    uint64_t i = (key & 0xffffffffffULL) - base[p];
+    auto& v = t[p];
+    if (i >= v.size()) {
+      if (i >= budget) { over = true; return; }
+      size_t ns = (size_t)i + 1 + ((size_t)i >> 1) + 64;
+      if (total + (ns - v.size()) > budget) { over = true; return; }
+      total += ns - v.size();
+      v.resize(ns, -1);
+    }
+    v[(size_t)i] = row;
+  }
+  int32_t get(uint64_t key) const {
+    uint32_t p = (uint32_t)(key >> 40);
+    if (p >= t.size()) return -1;
+    uint64_t i = (key & 0xffffffffffULL) - base[p];
+    if (i >= t[p].size()) return -1;
+    return t[p][(size_t)i];
+  }
+  bool overflow() const { return over; }
+};
+
+// test hook: force a tiny budget so the IdMap fallback path is
+// exercisable from the differential suite (0 = no override)
+long long g_rowtable_budget_override = 0;
+
+inline RowTable::RowTable(uint64_t n_peers,
+                          const std::vector<ChangeMeta>& metas,
+                          size_t n_elems)
+    : budget(g_rowtable_budget_override > 0
+                 ? (size_t)g_rowtable_budget_override
+                 : n_elems * 8 + (1u << 20)) {
+  t.resize(n_peers);
+  base.assign(n_peers, ~0ull);
+  for (auto& m : metas) {
+    uint64_t c = (uint64_t)m.ctr & 0xffffffffffULL;
+    if (c < base[m.peer_idx]) base[m.peer_idx] = c;
+  }
 }
 
 // Strict UTF-8: validates continuation prefixes, rejects overlong
@@ -153,13 +223,6 @@ inline int decode_utf8_cp(const uint8_t* s, uint64_t nb, uint64_t i, uint32_t* o
   *out = cp;
   return extra + 1;
 }
-
-struct ChangeMeta {
-  uint32_t peer_idx;
-  int64_t ctr;
-  int64_t lamport;
-  uint64_t n_ops;
-};
 
 // Parse header tables + change meta.  Returns false on malformed input.
 bool parse_prelude(Reader& r, uint64_t* n_peers, std::vector<int32_t>& cid_types,
@@ -272,47 +335,17 @@ struct DelSpan { uint32_t peer_idx; int64_t start, end; };
 
 }  // namespace
 
-extern "C" {
-
-// Pass 1: count elements of the target container (by cid index).
-// Returns element count, or -1 on malformed input.
-long long loro_count_seq_elements(const uint8_t* buf, long long len,
-                                  int target_cid) {
+template <class MapT>
+static long long explode_seq_impl(const uint8_t* buf, long long len,
+                                  int target_cid,
+                                  int32_t* out_parent, int32_t* out_side,
+                                  int32_t* out_peer, int32_t* out_counter,
+                                  uint8_t* out_deleted, int32_t* out_content,
+                                  long long n_elems) {
   Reader r{buf, buf + len};
   uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
   if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
-  long long total = 0;
-  for (auto& m : metas) {
-    for (uint64_t k = 0; k < m.n_ops; k++) {
-      uint64_t cidx = r.varint();
-      uint8_t kind = r.u8();
-      if (!r.ok) return -1;
-      int64_t atoms = 1;
-      if (!skip_op(r, kind, &atoms)) return -1;
-      if ((long long)cidx == target_cid &&
-          (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES)) {
-        total += atoms;
-      }
-    }
-  }
-  return total;
-}
-
-// Pass 2: fill element columns for the target container.
-// out_* arrays must hold n_elems entries (from pass 1).
-// out_content: codepoints for text inserts; value ops get ascending ids
-// starting at `value_base` (caller resolves values Python-side).
-// Returns number of elements written, or -1 on malformed input /
-// unresolvable parent reference.
-long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
-                           int32_t* out_parent, int32_t* out_side,
-                           int32_t* out_peer, int32_t* out_counter,
-                           uint8_t* out_deleted, int32_t* out_content,
-                           long long n_elems) {
-  Reader r{buf, buf + len};
-  uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
-  if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
-  IdMap map((size_t)(n_elems > 16 ? n_elems : 16));
+  MapT map(n_peers, metas, (size_t)(n_elems > 0 ? n_elems : 0));
   std::vector<DelSpan> dels;
   long long row = 0;
   int32_t value_base = 0;
@@ -342,10 +375,10 @@ long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
         if (ptag == PT_NONE) parent_row = -1;
         else if (ptag == PT_RUNCONT) {
           parent_row = map.get(idkey(m.peer_idx, ctr - 1));
-          if (parent_row < 0) return -1;
+          if (parent_row < 0) return map.overflow() ? -2 : -1;
         } else {
           parent_row = map.get(idkey(p_peer, p_ctr));
-          if (parent_row < 0) return -1;
+          if (parent_row < 0) return map.overflow() ? -2 : -1;
         }
         if (kind == K_INSERT_TEXT) {
           uint64_t nb; const uint8_t* s = r.bytes(&nb);
@@ -410,7 +443,62 @@ long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
       if (i >= 0) out_deleted[i] = 1;
     }
   }
+  if (map.overflow()) return -2;  // direct table blew its budget
   return row;
+}
+
+
+extern "C" {
+
+// test-only: force a tiny RowTable budget (0 = default) so the
+// IdMap fallback is exercisable from the differential suite
+void loro_set_rowtable_budget(long long b) { g_rowtable_budget_override = b; }
+
+
+// Pass 1: count elements of the target container (by cid index).
+// Returns element count, or -1 on malformed input.
+long long loro_count_seq_elements(const uint8_t* buf, long long len,
+                                  int target_cid) {
+  Reader r{buf, buf + len};
+  uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
+  if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
+  long long total = 0;
+  for (auto& m : metas) {
+    for (uint64_t k = 0; k < m.n_ops; k++) {
+      uint64_t cidx = r.varint();
+      uint8_t kind = r.u8();
+      if (!r.ok) return -1;
+      int64_t atoms = 1;
+      if (!skip_op(r, kind, &atoms)) return -1;
+      if ((long long)cidx == target_cid &&
+          (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES)) {
+        total += atoms;
+      }
+    }
+  }
+  return total;
+}
+
+// Pass 2: fill element columns for the target container.
+// out_* arrays must hold n_elems entries (from pass 1).
+// out_content: codepoints for text inserts; value ops get ascending ids
+// starting at `value_base` (caller resolves values Python-side).
+// Returns number of elements written, or -1 on malformed input /
+// unresolvable parent reference.
+long long loro_explode_seq(const uint8_t* buf, long long len, int target_cid,
+                           int32_t* out_parent, int32_t* out_side,
+                           int32_t* out_peer, int32_t* out_counter,
+                           uint8_t* out_deleted, int32_t* out_content,
+                           long long n_elems) {
+  long long rc = explode_seq_impl<RowTable>(
+      buf, len, target_cid, out_parent, out_side, out_peer, out_counter,
+      out_deleted, out_content, n_elems);
+  if (rc != -2) return rc;
+  // sparse-counter payload blew the direct table's budget: redo with
+  // the open-addressing map — outputs are fully rewritten
+  return explode_seq_impl<IdMap>(
+      buf, len, target_cid, out_parent, out_side, out_peer, out_counter,
+      out_deleted, out_content, n_elems);
 }
 
 // Count rows the DELTA explode will emit (chars/values AND style

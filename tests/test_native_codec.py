@@ -351,3 +351,45 @@ class TestNativeTreeMovable:
         for i, d in enumerate(docs):
             want = d.get_movable_list("ml").get_value()
             assert got_native[i] == want, f"seed {seed} doc {i}"
+
+
+class TestRowTableFallback:
+    """The direct-address RowTable fast path falls back to the
+    open-addressing IdMap when counters are too sparse for its budget;
+    force a tiny budget so that (otherwise dead in dense tests) path
+    runs against the Python oracle."""
+
+    def test_forced_fallback_matches(self):
+        from loro_tpu.native import _load
+
+        lib = _load()
+        rng = random.Random(7)
+        docs = [LoroDoc(peer=i + 1) for i in range(3)]
+        for _ in range(60):
+            d = rng.choice(docs)
+            t = d.get_text("t")
+            if len(t) and rng.random() < 0.35:
+                pos = rng.randint(0, len(t) - 1)
+                t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+            else:
+                t.insert(rng.randint(0, len(t)), rng.choice(["ab", "ç", "☃x"]))
+            if rng.random() < 0.3:
+                src, dst = rng.sample(docs, 2)
+                dst.import_(src.export_updates(dst.oplog_vv()))
+        for src in docs:
+            for dst in docs:
+                if src is not dst:
+                    dst.import_(src.export_updates(dst.oplog_vv()))
+        doc = docs[0]
+        cid = doc.get_text("t").id
+        pl = _payload(doc)
+        ex_py = extract_seq_container(doc.oplog.changes_in_causal_order(), cid)
+        lib.loro_set_rowtable_budget(1)  # every put overflows -> IdMap rerun
+        try:
+            ex_forced = extract_seq_from_payload(pl, cid)
+        finally:
+            lib.loro_set_rowtable_budget(0)
+        ex_fast = extract_seq_from_payload(pl, cid)
+        _assert_same(ex_py, ex_forced)
+        _assert_same(ex_py, ex_fast)
+        np.testing.assert_array_equal(ex_forced.content, ex_fast.content)

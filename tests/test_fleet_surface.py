@@ -162,3 +162,22 @@ def test_fleet_map_sharded_falls_back_on_1d_mesh(fleet):
     a.commit()
     ex = [extract_map_ops(a.oplog.changes_in_causal_order())]
     assert fleet.merge_map_docs_sharded(ex) == fleet.merge_map_docs(ex)
+
+
+def test_global_mesh_single_process():
+    """make_global_mesh == all-process devices; in a single-process CPU
+    run that is just every virtual device, and a fleet over it merges
+    correctly (the multi-host path differs only in device enumeration)."""
+    import jax
+
+    from loro_tpu.parallel.mesh import DOC_AXIS, make_global_mesh
+
+    mesh = make_global_mesh()
+    assert mesh.shape[DOC_AXIS] == len(jax.devices())
+    f = Fleet(mesh)
+    doc = LoroDoc(peer=1)
+    doc.get_text("t").insert(0, "global mesh")
+    doc.commit()
+    cid = doc.get_text("t").id
+    res = f.merge_text_changes([doc.oplog.changes_in_causal_order()], cid)
+    assert res.texts[0] == "global mesh"

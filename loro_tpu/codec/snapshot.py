@@ -112,6 +112,8 @@ def _read_seq(r: Reader, peers: List[int], keys: List[str], cids: List[Container
         # in traversal order (L-children precede their parent)
         e = SeqElem(peer, counter, content, None, Side(flags & 1), lamport)
         e.deleted_by = deleted_by
+        for x in deleted_by:
+            seq.deleter_index.setdefault((x.peer, x.counter), []).append(e)
         if flags & 2:
             e.deleted = True
         invisible = bool(flags & 6) or e.is_anchor

@@ -1084,9 +1084,9 @@ class LoroDoc:
                 if getattr(st, "n_anchors", 0):
                     d = st.styled_delta_between(va, vb)
                 else:
-                    d = st.seq.delta_between(va, vb, as_text=True)
+                    d = st.seq.delta_between(va, vb, as_text=True, vc=u_state.vv)
             elif cid.ctype == ContainerType.List:
-                d = st.seq.delta_between(va, vb, as_text=False)
+                d = st.seq.delta_between(va, vb, as_text=False, vc=u_state.vv)
             else:
                 continue
             if not d.is_empty():

@@ -36,6 +36,13 @@ from ..utils.treap import Treap, TreapNode
 ROOT = None  # fugue-parent sentinel for root children
 
 
+def visible_at(e: "SeqElem", v) -> bool:
+    """Element visibility at version v: inserted (id in v) and not
+    deleted by any delete-op in v.  THE visibility predicate — all
+    version-filtered walks (diffs, styled diffs) must share it."""
+    return v.includes(e.id) and not any(v.includes(x) for x in e.deleted_by)
+
+
 class SeqElem(TreapNode):
     """One sequence element (char / list value / anchor / position)."""
 
@@ -101,6 +108,9 @@ class FugueSeq:
         self.treap = Treap()
         self.by_id: Dict[Tuple[PeerID, Counter], SeqElem] = {}
         self.root_children: List[SeqElem] = []  # sorted by sib_key; side=Right
+        # delete-op id -> elements it tombstoned (inverse of deleted_by;
+        # lets version diffs find visibility flips by id range)
+        self.deleter_index: Dict[Tuple[PeerID, Counter], List[SeqElem]] = {}
 
     # ------------------------------------------------------------------
     # tree navigation
@@ -235,6 +245,9 @@ class FugueSeq:
                     continue
                 if deleter is not None:
                     e.deleted_by.append(deleter)
+                    self.deleter_index.setdefault(
+                        (deleter.peer, deleter.counter), []
+                    ).append(e)
                 if e.deleted:
                     continue
                 if compute_pos:
@@ -245,24 +258,73 @@ class FugueSeq:
                 self.treap.set_visible(e, 0)
         return _merge_removed(removed)
 
-    def delta_between(self, va, vb, as_text: bool):
+    def delta_between(self, va, vb, as_text: bool, vc=None):
         """Exact delta turning the visible sequence at version `va` into
         the one at `vb` (both must be within this seq's history).
         Element visibility at V: inserted (id in V) and not deleted by
-        any delete-op in V."""
+        any delete-op in V.
+
+        When `vc` — the version this structure's treap CURRENTLY
+        reflects — is given, the scan is O(delta): only elements whose
+        visibility can differ among {va, vb, vc} (derived from the
+        per-peer counter ranges of the symmetric differences va^vc and
+        vb^vc, resolved through by_id / deleter_index) are evaluated;
+        every other element has vis_va == vis_vb == its live treap
+        width, so the retain gaps between affected elements come from
+        visible-rank arithmetic instead of a full walk.  Reference
+        extracts diffs by walking only changed subtrees
+        (crates/loro-internal/src/container/richtext/tracker/
+        crdt_rope.rs:383-451); this is the rank-query analog.
+        """
         from ..event import Delta
 
         d = Delta()
-        for e in self.all_elems():
-            if e.is_anchor:
-                continue
-            in_a = va.includes(e.id) and not any(va.includes(x) for x in e.deleted_by)
-            in_b = vb.includes(e.id) and not any(vb.includes(x) for x in e.deleted_by)
+        if vc is None:
+            for e in self.all_elems():
+                if e.is_anchor:
+                    continue
+                in_a = visible_at(e, va)
+                in_b = visible_at(e, vb)
+                if in_a and in_b:
+                    d.retain(1)
+                elif in_a:
+                    d.delete(1)
+                elif in_b:
+                    d.insert(e.content if as_text else (e.content,))
+            return d.chop()
+
+        cand: Dict[int, SeqElem] = {}
+        for hi, lo in ((va, vc), (vc, va), (vb, vc), (vc, vb)):
+            for span in hi.diff_spans(lo):
+                for c in range(span.start, span.end):
+                    e = self.by_id.get((span.peer, c))
+                    if e is not None and not e.is_anchor:
+                        cand[id(e)] = e
+                    hit = self.deleter_index.get((span.peer, c))
+                    if hit:
+                        for e2 in hit:
+                            if not e2.is_anchor:
+                                cand[id(e2)] = e2
+        elems = sorted(cand.values(), key=self.treap.total_rank)
+        pending = 0  # retains accumulated since the last emitted op
+        prev = 0  # live-visible rank consumed so far
+        for e in elems:
+            r = self.treap.visible_rank(e)
+            pending += r - prev
+            prev = r + e.vis_w  # skip e's own live width; handled below
+            in_a = visible_at(e, va)
+            in_b = visible_at(e, vb)
             if in_a and in_b:
-                d.retain(1)
+                pending += 1
             elif in_a:
+                if pending:
+                    d.retain(pending)
+                    pending = 0
                 d.delete(1)
             elif in_b:
+                if pending:
+                    d.retain(pending)
+                    pending = 0
                 d.insert(e.content if as_text else (e.content,))
         return d.chop()
 

@@ -160,8 +160,10 @@ class TextState(ContainerState):
         """(elem, attrs) for every char VISIBLE at version v — the
         shared walk with version-filtered liveness predicates."""
 
+        from .seq_crdt import visible_at
+
         def live(e):
-            return v.includes(e.id) and not any(v.includes(x) for x in e.deleted_by)
+            return visible_at(e, v)
 
         return self._iter_char_attrs(live, live)
 

@@ -136,3 +136,69 @@ def test_undo_deep_history_soak():
     assert sum(counts) < 3 * n, f"undo soak replayed {sum(counts)} changes"
     assert dt < 5.0, f"20 undos on deep history took {dt:.2f}s"
     assert t.to_string().count("w") == n - 20
+
+
+def test_diff_cost_scales_with_delta():
+    """delta_between is O(delta), not O(doc): on a large doc, a 1-commit
+    diff near the tip must touch a bounded number of elements, however
+    long the history (reference: changed-subtree-only diff walk,
+    crdt_rope.rs:383-451).  Counted structurally via visible_rank calls,
+    not timing."""
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("t")
+    n = 3000
+    fs = []
+    for i in range(n):
+        t.insert(len(t), "word ")
+        doc.commit(message=f"c{i}")
+        fs.append(doc.oplog_frontiers())
+    from loro_tpu.utils.treap import Treap
+
+    calls = []
+    orig = Treap.visible_rank
+
+    def wrapper(self, e):
+        calls.append(1)
+        return orig(self, e)
+
+    Treap.visible_rank = wrapper
+    try:
+        d = doc.diff(fs[-2], fs[-1])
+    finally:
+        Treap.visible_rank = orig
+    assert sum(calls) <= 64, f"1-commit diff did {sum(calls)} rank queries on a {n}-commit doc"
+    assert t.cid in d and d[t.cid].insert_len() == 5
+
+
+def test_diff_delta_vs_fullscan_equivalence():
+    """Randomized oracle: the ranged O(delta) path must produce the
+    exact delta of the legacy full-table scan for random version pairs
+    on a multi-peer doc with deletes."""
+    import random as _random
+
+    from loro_tpu import LoroDoc as _Doc
+
+    rng = _random.Random(7)
+    doc = _Doc(peer=1)
+    t = doc.get_text("t")
+    fs = []
+    for i in range(120):
+        L = len(t)
+        if L and rng.random() < 0.35:
+            p = rng.randrange(L)
+            t.delete(p, min(3, L - p))
+        else:
+            t.insert(rng.randrange(L + 1) if L else 0, f"x{i}")
+        doc.commit()
+        fs.append(doc.oplog_frontiers())
+    dag = doc.oplog.dag
+    st = doc.state.states[t.cid]
+    vc = doc.state.vv
+    for _ in range(40):
+        va = dag.frontiers_to_vv(fs[rng.randrange(len(fs))])
+        vb = dag.frontiers_to_vv(fs[rng.randrange(len(fs))])
+        fast = st.seq.delta_between(va, vb, as_text=True, vc=vc)
+        slow = st.seq.delta_between(va, vb, as_text=True)
+        assert fast.items == slow.items, (
+            f"ranged diff mismatch: {fast.items} vs {slow.items}"
+        )

@@ -175,6 +175,7 @@ def _order_core(
     side_in: jax.Array,
     valid_in: jax.Array,
     sib_keys: Optional[Tuple[jax.Array, ...]] = None,
+    rank_impl: Optional[str] = None,
 ) -> jax.Array:
     """Euler-tour in-order ranking over generic node arrays (element- or
     chain-level).  Without `sib_keys`, rows must obey the (peer, counter)
@@ -264,11 +265,19 @@ def _order_core(
     # -- Wyllie list ranking: distance to terminal --------------------
     from .pallas_rank import pallas_rank_applicable, wyllie_rank
 
-    # precedence: an explicit RANK_ALGO=ruling beats the auto-on pallas
-    # default (so algo comparisons stay honest), but an explicit
+    # precedence: an explicit rank_impl argument (phased bench runs need
+    # both paths jitted in one process — env knobs bake at trace time)
+    # beats env; then an explicit RANK_ALGO=ruling beats the auto-on
+    # pallas default (so algo comparisons stay honest), but an explicit
     # PALLAS_RANK=1 beats everything
     explicit_pallas = os.environ.get("PALLAS_RANK", "") not in ("", "0")
-    if pallas_rank_applicable(int(succ.shape[0])) and (
+    if rank_impl == "pallas":
+        dist = wyllie_rank(succ)
+    elif rank_impl == "xla":
+        dist = _ruling_dist(succ) if _rank_algo() == "ruling" else _wyllie_dist(succ)
+    elif rank_impl is not None:
+        raise ValueError(f"rank_impl must be pallas|xla|None, got {rank_impl!r}")
+    elif pallas_rank_applicable(int(succ.shape[0])) and (
         _rank_algo() != "ruling" or explicit_pallas
     ):
         # VMEM-resident pointer doubling (default on TPU; falls back to
@@ -483,7 +492,9 @@ def _place_by_chain_sort(
     return codes, count
 
 
-def chain_materialize(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
+def chain_materialize(
+    cols: ChainColumns, rank_impl: Optional[str] = None
+) -> Tuple[jax.Array, jax.Array]:
     """Merge via chain contraction: rank C chains (C << N), then place
     all N elements via _place_by_chain (default: rank expansion by
     C-scatter + N-cumsum, then one stable N-row sort; PLACE_ALGO=scatter
@@ -491,7 +502,9 @@ def chain_materialize(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
     the gather-heavy ranking runs on the contracted tree only.
     Returns (codes i32[N] padded with -1, visible count)."""
     c = cols.c_parent.shape[0]
-    crank = _order_core(cols.c_parent, cols.c_side, cols.c_valid)  # i32[C]
+    crank = _order_core(
+        cols.c_parent, cols.c_side, cols.c_valid, rank_impl=rank_impl
+    )  # i32[C]
     visible = cols.valid & ~cols.deleted
     chain_id = jnp.where(cols.valid, cols.chain_id, c)
     return _place_by_chain(
@@ -520,6 +533,25 @@ def _weighted_checksum(codes: jax.Array) -> jax.Array:
 @jax.jit
 def chain_merge_docs_checksum(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
     codes, counts = chain_materialize_batch(cols)
+    return _weighted_checksum(codes), counts
+
+
+@functools.partial(jax.jit, static_argnames=("rank_impl",))
+def chain_merge_docs_v(
+    cols: ChainColumns, rank_impl: Optional[str] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """chain_merge_docs with an explicit ranking implementation —
+    phased bench runs measure the XLA path first (banking a safe device
+    number), then the pallas path, inside ONE process (env knobs bake
+    at trace time, so this must be a static argument)."""
+    return jax.vmap(lambda c: chain_materialize(c, rank_impl))(cols)
+
+
+@functools.partial(jax.jit, static_argnames=("rank_impl",))
+def chain_merge_docs_checksum_v(
+    cols: ChainColumns, rank_impl: Optional[str] = None
+) -> Tuple[jax.Array, jax.Array]:
+    codes, counts = jax.vmap(lambda c: chain_materialize(c, rank_impl))(cols)
     return _weighted_checksum(codes), counts
 
 

@@ -105,6 +105,34 @@ def richtext_merge_batch(cols: RichtextCols, n_keys: int):
     return jax.vmap(lambda c: richtext_merge_doc(c, n_keys))(cols)
 
 
+def segments_from_device(codes, count, bounds, win, keys, values):
+    """Reconstruct Quill-style [{insert, attributes?}] segments from one
+    doc's device outputs — the comparison form against the host's
+    TextState.get_richtext_value() (differential tests + bench gates)."""
+    count = int(count)
+    text = "".join(chr(c) for c in np.asarray(codes)[:count])
+    bounds = np.asarray(bounds)
+    win = np.asarray(win)
+    segs = []
+    for r in range(len(bounds) - 1):
+        lo, hi = int(bounds[r]), int(bounds[r + 1])
+        if lo >= hi:
+            continue
+        attrs = {}
+        for k in range(len(keys)):
+            vi = int(win[r, k])
+            if vi >= 0:
+                attrs[keys[k]] = values[vi]
+        seg = {"insert": text[lo:hi]}
+        if attrs:
+            seg["attributes"] = attrs
+        if segs and segs[-1].get("attributes") == seg.get("attributes"):
+            segs[-1]["insert"] += seg["insert"]
+        else:
+            segs.append(seg)
+    return segs
+
+
 def extract_richtext(changes, cid):
     """Host: explode a Text container (chars + anchors) into
     RichtextCols (numpy) + (keys list, values list).  Pairing invariant:

@@ -138,6 +138,9 @@ def assemble_record(ck: dict) -> dict:
     ):
         if k in ck and ck[k] is not None:
             rec[k] = ck[k]
+    wi = os.environ.get("BENCH_WEDGE_INFO")
+    if wi:
+        rec["wedge_info"] = wi
     return rec
 
 
@@ -323,36 +326,36 @@ def bench_richtext(emit: bool = True) -> float:
 
     from loro_tpu.bench_utils import RICHTEXT_KEYS, richtext_bench_docs
     from loro_tpu.ops.richtext_batch import (
-        RichtextCols,
-        richtext_merge_batch,
+        RichtextChainCols,
+        richtext_chain_merge_batch,
         segments_from_device,
     )
 
     docs_total = int(os.environ.get("BENCH_RT_DOCS", "512"))
     chunk = int(os.environ.get("BENCH_RT_CHUNK", "16"))
     n_distinct = int(os.environ.get("BENCH_RT_DISTINCT", "8"))
-    distinct, pad_n, pad_p = richtext_bench_docs(n_distinct=n_distinct)
+    distinct, pad_n, pad_p, pad_c = richtext_bench_docs(n_distinct=n_distinct)
     n_keys = len(RICHTEXT_KEYS)
-    note(f"richtext: {n_distinct} distinct docs, pad_n={pad_n} pad_p={pad_p}")
-    from loro_tpu.ops.fugue_batch import SeqColumns
+    note(f"richtext: {n_distinct} distinct docs, pad_n={pad_n} pad_p={pad_p} pad_c={pad_c}")
+    from loro_tpu.ops.fugue_batch import ChainColumns
 
     idx0 = [j % n_distinct for j in range(chunk)]
     chunk_cols = [distinct[i]["cols"] for i in idx0]
-    batch = RichtextCols(
-        seq=SeqColumns(
+    batch = RichtextChainCols(
+        chain=ChainColumns(
             *[
-                jax.device_put(np.stack([getattr(c.seq, f) for c in chunk_cols]))
-                for f in SeqColumns._fields
+                jax.device_put(np.stack([getattr(c.chain, f) for c in chunk_cols]))
+                for f in ChainColumns._fields
             ]
         ),
         **{
             f: jax.device_put(np.stack([getattr(c, f) for c in chunk_cols]))
-            for f in RichtextCols._fields
-            if f != "seq"
+            for f in RichtextChainCols._fields
+            if f != "chain"
         },
     )
-    codes, counts, bounds, win = richtext_merge_batch(batch, n_keys)
-    for j in (0, 1 % chunk):
+    codes, counts, bounds, win = richtext_chain_merge_batch(batch, n_keys)
+    for j in range(min(chunk, n_distinct)):  # one slot per distinct doc
         d = distinct[idx0[j]]
         segs = segments_from_device(
             np.asarray(codes[j]), counts[j], bounds[j], win[j], d["keys"], d["values"]
@@ -364,7 +367,7 @@ def bench_richtext(emit: bool = True) -> float:
     t0 = time.perf_counter()
     out = None
     for i in range(n_chunks):
-        out = richtext_merge_batch(batch, n_keys)
+        out = richtext_chain_merge_batch(batch, n_keys)
     np.asarray(out[1])
     dt = time.perf_counter() - t0
     ops_s = ops_per_chunk * n_chunks / dt
@@ -419,7 +422,7 @@ def main() -> None:
     chunk = int(os.environ.get("BENCH_CHUNK", "8"))
     budget_s = float(os.environ.get("BENCH_BUDGET", "240"))  # flagship loop
     xla_budget_s = float(os.environ.get("BENCH_XLA_BUDGET", "75"))
-    lat_budget_s = float(os.environ.get("BENCH_LAT_BUDGET", "90"))
+    lat_budget_s = float(os.environ.get("BENCH_LAT_BUDGET", "150"))
     e2e_docs_req = int(os.environ.get("BENCH_E2E_DOCS", "64"))
     e2e_budget_s = float(os.environ.get("BENCH_E2E_BUDGET", "90"))
     n_variants = int(os.environ.get("BENCH_VARIANTS", "8"))
@@ -430,6 +433,29 @@ def main() -> None:
     def remaining() -> float:
         return child_deadline - time.time()
 
+    # ---- phase 0: device contact (banked BEFORE anything else) -------
+    # A wedged axon tunnel hangs on the FIRST device op; banking a
+    # device-provenance record immediately lets the parent distinguish
+    # "tunnel dead at first contact" from "wedged after N phases".
+    note("phase-0: device contact (jax.devices() + tiny fetch)...")
+    dev0 = jax.devices()[0]
+    platform = dev0.platform
+    device_kind = getattr(dev0, "device_kind", platform)
+    on_tpu = platform == "tpu" or "TPU" in str(device_kind)
+    bank("device_contact", device=f"{platform}:{device_kind}")
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda v: v + 1)
+    np.asarray(tiny(jnp.zeros(8, jnp.int32)))
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(tiny(jnp.zeros(8, jnp.int32)))
+        rtts.append(time.perf_counter() - t0)
+    rtt = sorted(rtts)[1]
+    note(f"device: platform={platform} kind={device_kind}, tunnel RTT ~{rtt * 1e3:.0f}ms")
+    bank("device_fetch", tunnel_rtt_ms=round(rtt * 1e3, 1))
+
     # ---- phase: extraction (seconds — caches are committed) ----------
     note("extracting trace + concurrent variants (committed caches)...")
     ex0, n_ops = automerge_seq_extract(limit=limit)
@@ -438,7 +464,7 @@ def main() -> None:
     per_doc_ops = [n_ops] + [v["n_ops"] for v in variants]
     want0 = automerge_final_text(limit=limit)
     note(f"extraction done ({len(extracts)} distinct traces)")
-    bank("extraction")  # parent starts its device-init deadline here
+    bank("extraction")
 
     # the trace set is fixed for the whole run, so pad to the batch max
     # on a fine quantum instead of power-of-two buckets: ranking cost is
@@ -460,30 +486,6 @@ def main() -> None:
         host_batches.append(
             ChainColumns(*[np.stack([getattr(c, f) for c in docs]) for f in ChainColumns._fields])
         )
-
-    # ---- phase: device init (first tunnel contact) -------------------
-    note("initializing device (first tunnel contact can take ~30s cold)...")
-    dev0 = jax.devices()[0]
-    platform = dev0.platform
-    device_kind = getattr(dev0, "device_kind", platform)
-    note(f"device: platform={platform} kind={device_kind}")
-    on_tpu = platform == "tpu" or "TPU" in str(device_kind)
-    bank("device_init", device=f"{platform}:{device_kind}")
-
-    # tunnel RTT estimate: median of 3 tiny fetch round trips
-    import jax.numpy as jnp
-
-    tiny = jax.jit(lambda v: v + 1)
-    x = tiny(jnp.zeros(8, jnp.int32))
-    np.asarray(x)
-    rtts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(tiny(jnp.zeros(8, jnp.int32)))
-        rtts.append(time.perf_counter() - t0)
-    rtt = sorted(rtts)[1]
-    note(f"tunnel RTT ~{rtt * 1e3:.0f}ms")
-    bank("rtt", tunnel_rtt_ms=round(rtt * 1e3, 1))
 
     def sync(o) -> None:
         # jax.block_until_ready does NOT synchronize under the axon
@@ -560,7 +562,7 @@ def main() -> None:
 
     # ---- phase: XLA budget loop (banked device number, low risk) -----
     note(f"XLA budget loop ({xla_budget_s:.0f}s)...")
-    xla_ops_s, xla_docs, _ = budget_loop(
+    xla_ops_s, xla_docs, xla_flights = budget_loop(
         lambda b: chain_merge_docs_checksum_v(b, rank_impl="xla"), xla_budget_s, "xla"
     )
     note(f"XLA kernel: {xla_ops_s / 1e6:.1f}M ops/s over {xla_docs} docs")
@@ -571,6 +573,8 @@ def main() -> None:
         metric=metric.format(docs=xla_docs),
         partial="XLA rank kernel (pallas phase not yet run)",
         xla_rank_value=round(xla_ops_s),
+        # per-flight wall times (8 launches each): postmortem time series
+        xla_flight_ms=[round(t * 1e3, 1) for t in xla_flights],
     )
 
     # ---- phase: pallas compile + budget loop (the flagship) ----------
@@ -603,7 +607,7 @@ def main() -> None:
             bank("pallas_pilot", partial="pallas pilot done, budget loop pending")
             secs = min(budget_s, max(remaining() - 150, 30))
             note(f"pallas budget loop ({secs:.0f}s)...")
-            p_ops_s, p_docs, _ = budget_loop(
+            p_ops_s, p_docs, p_flights = budget_loop(
                 lambda b: chain_merge_docs_checksum_v(b, rank_impl="pallas"),
                 secs,
                 "pallas",
@@ -620,6 +624,7 @@ def main() -> None:
                 kernel=kernel_name,
                 metric=metric.format(docs=kernel_docs),
                 partial=None,
+                pallas_flight_ms=[round(t * 1e3, 1) for t in p_flights],
             )
         except Exception as e:  # pallas is an upgrade, never a downgrade
             note(f"pallas phase failed ({type(e).__name__}: {e}); keeping XLA numbers")
@@ -662,6 +667,9 @@ def main() -> None:
                     f"trip (tunnel RTT ~{rtt * 1e3:.0f}ms), full trace per doc, "
                     f"{n_lat} samples"
                 ),
+                # full sorted series lives in the checkpoint only (the
+                # emitted record carries the percentiles)
+                latency_series_ms=[round(v * 1e3, 1) for v in lat],
             )
             note(
                 f"latency: p50 {p50 * 1e3:.0f}ms p99 {p99 * 1e3:.0f}ms over {n_lat} samples"
@@ -838,15 +846,15 @@ def _tunnel_alive(timeout_s: float = 75.0) -> bool:
         [sys.executable, "-c", code],
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
+        start_new_session=True,  # abandonable: never signaled
     )
     try:
         return proc.wait(timeout=timeout_s) == 0
     except subprocess.TimeoutExpired:
-        proc.terminate()  # tiny op in flight; nothing big to wedge
-        try:
-            proc.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            pass
+        # Do NOT signal it: even a tiny op can be mid-launch, and a
+        # SIGTERM mid-launch is what wedges the tunnel (CLAUDE.md) —
+        # the probe must not cause the wedge it detects.  Abandon the
+        # child (own session); it exits on its own when the op resolves.
         return False
 
 
@@ -893,7 +901,9 @@ def main_guarded() -> None:
     probe_wanted = not os.environ.get("BENCH_SKIP_PROBE") and not os.environ.get(
         "JAX_PLATFORMS"
     )
+    fallback_reason = None
     if probe_wanted and not _tunnel_alive():
+        fallback_reason = "ambient device failed the 75s liveness probe (wedged tunnel?)"
         print(
             "bench: ambient device failed the 75s liveness probe "
             "(wedged tunnel?); cpu fallback without burning the watchdog",
@@ -937,16 +947,19 @@ def main_guarded() -> None:
                 )
                 print(json.dumps(assemble_record(ck)), flush=True)
                 return
+            where = (
+                f"after phase {ck.get('last_phase')}" if ck
+                else "before first contact (no phase banked: tunnel dead at first device op?)"
+            )
+            fallback_reason = f"device run exceeded {timeout_s}s, wedged {where}"
             print(
-                f"bench: device run exceeded {timeout_s}s with nothing banked "
-                "(wedged tunnel?); cpu fallback",
+                f"bench: device run exceeded {timeout_s}s with nothing banked, "
+                f"wedged {where}; cpu fallback",
                 file=sys.stderr,
             )
-            proc.terminate()
-            try:
-                proc.wait(timeout=60)
-            except subprocess.TimeoutExpired:
-                pass  # abandoned; it is in its own session
+            # abandon WITHOUT signals: the child may be mid-TPU-launch,
+            # and SIGTERM mid-launch wedges the tunnel (CLAUDE.md); it
+            # is in its own session and exits on its own if it unwedges
         elif rc == 0 and ck:
             # finished but didn't reach "done" (deadline-skipped phases)
             print(json.dumps(assemble_record(ck)), flush=True)
@@ -961,8 +974,14 @@ def main_guarded() -> None:
                 ck.setdefault("partial", f"child failed rc={rc} after {ck.get('last_phase')}")
                 print(json.dumps(assemble_record(ck)), flush=True)
                 return
+            fallback_reason = (
+                f"device child failed rc={rc} after phase "
+                f"{ck.get('last_phase') if ck else None}"
+            )
             print(f"bench: device run failed rc={rc}; cpu fallback", file=sys.stderr)
     env_cpu = dict(env, JAX_PLATFORMS="cpu", BENCH_LABEL="cpu_fallback")
+    if fallback_reason:
+        env_cpu["BENCH_WEDGE_INFO"] = fallback_reason
     env_cpu["BENCH_CHECKPOINT"] = ckpt + ".cpu"
     env_cpu.setdefault("BENCH_BUDGET", "180")
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)], env=env_cpu)

@@ -214,8 +214,8 @@ def richtext_bench_docs(
     built by n_peers replicas interleaving insert/delete/mark/unmark in
     randomized windows with periodic syncs, converged at the end.
 
-    Returns (docs, pad_n, pad_p): per distinct doc a dict with
-      cols: padded numpy RichtextCols (uniform pad across docs)
+    Returns (docs, pad_n, pad_p, pad_c): per distinct doc a dict with
+      cols: padded numpy RichtextChainCols (uniform pads across docs)
       keys/values: style dictionaries for segment reconstruction
       oracle: host get_richtext_value() segments (the correctness gate)
       n_ops: chars + deletes + 2*mark-anchors integrated
@@ -224,10 +224,9 @@ def richtext_bench_docs(
     import random
 
     from .doc import LoroDoc
-    from .ops.fugue_batch import SeqColumns, pad_seq_columns
-    from .ops.richtext_batch import RichtextCols, extract_richtext
+    from .ops.richtext_batch import extract_richtext_chain, pad_richtext_chain_cols
 
-    tag = f"rt{n_distinct}_c{n_chars}_m{n_marks}_p{n_peers}_s{sync_every}_n1"
+    tag = f"rt{n_distinct}_c{n_chars}_m{n_marks}_p{n_peers}_s{sync_every}_n2"
     cache = os.path.join(VARIANT_CACHE_DIR, tag + ".pkl.gz") if use_cache else None
     if cache and os.path.exists(cache):
         with gzip.open(cache, "rb") as f:
@@ -289,7 +288,7 @@ def richtext_bench_docs(
         for t in texts[1:]:
             assert t.get_richtext_value() == oracle, "richtext replicas diverged"
         ref = docs[0]
-        cols, keys, values = extract_richtext(
+        cols, keys, values = extract_richtext_chain(
             ref.oplog.changes_in_causal_order(), texts[0].id
         )
         raw.append((cols, keys, values, oracle, n_ops))
@@ -297,29 +296,16 @@ def richtext_bench_docs(
     def pad_to(n: int, q: int) -> int:
         return -(-max(n, 1) // q) * q
 
-    pad_n = pad_to(max(c[0].seq.parent.shape[0] for c in raw), 1024)
+    pad_n = pad_to(max(c[0].chain.chain_id.shape[0] for c in raw), 1024)
+    pad_c = pad_to(max(c[0].chain.c_parent.shape[0] for c in raw), 256)
     pad_p = pad_to(max(c[0].pair_start.shape[0] for c in raw), 128)
     out = []
     for cols, keys, values, oracle, n_ops in raw:
-        def padp(a, fill):
-            b = np.full(pad_p, fill, a.dtype)
-            b[: a.shape[0]] = a
-            return b
-
-        padded = RichtextCols(
-            seq=SeqColumns(*pad_seq_columns(cols.seq, pad_n)),
-            pair_start=padp(cols.pair_start, 0),
-            pair_end=padp(cols.pair_end, 0),
-            pair_key=padp(cols.pair_key, 0),
-            pair_value=padp(cols.pair_value, -1),
-            pair_lamport=padp(cols.pair_lamport, 0),
-            pair_peer=padp(cols.pair_peer, 0),
-            pair_valid=padp(cols.pair_valid, False),
-        )
+        padded = pad_richtext_chain_cols(cols, pad_n=pad_n, pad_c=pad_c, pad_p=pad_p)
         out.append(
             {"cols": padded, "keys": keys, "values": values, "oracle": oracle, "n_ops": n_ops}
         )
-    result = (out, pad_n, pad_p)
+    result = (out, pad_n, pad_p, pad_c)
     if cache:
         os.makedirs(VARIANT_CACHE_DIR, exist_ok=True)
         tmp = cache + ".tmp"

@@ -223,8 +223,8 @@ def peer_counter_perm(peer: np.ndarray, counter: np.ndarray, parent: np.ndarray)
     else:
         perm = np.argsort(peer, kind="stable")
         if n > 1:
-            ctr_s = counter[perm]
-            peer_s = peer[perm]
+            ctr_s = counter[perm].astype(np.int64)
+            peer_s = peer[perm].astype(np.int64)
             if not ((np.diff(ctr_s) > 0) | (np.diff(peer_s) != 0)).all():
                 perm = np.lexsort((counter, peer))
     inv = np.empty(n, np.int64)

@@ -420,17 +420,19 @@ def _place_by_chain(
     return _place_by_chain_scatter(crank, c_valid, chain_id, head_row, visible, content)
 
 
-def _place_by_chain_scatter(
+def chain_positions(
     crank: jax.Array,
     c_valid: jax.Array,
     chain_id: jax.Array,
     head_row: jax.Array,
     visible: jax.Array,
-    content: jax.Array,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Histogram placement: chain base positions from a rank histogram +
-    exclusive cumsum, within-chain prefixes from row cumsums (chain rows
-    are contiguous), then a positional scatter of the content codes."""
+    """Histogram placement core: (pos i32[N], count) where pos[row] =
+    number of visible rows strictly before the row in final document
+    order — defined for EVERY row (zero-width/deleted rows included;
+    the richtext anchors need exactly that).  Chain base positions from
+    a rank histogram + exclusive cumsum, within-chain offsets from row
+    cumsums (chain rows are contiguous)."""
     c = crank.shape[0]
     n = chain_id.shape[0]
     vis_i = visible.astype(jnp.int32)
@@ -448,6 +450,21 @@ def _place_by_chain_scatter(
     within = row_excl - head_excl[jnp.clip(chain_id, 0, c - 1)]
     pos = base[jnp.clip(chain_id, 0, c - 1)] + within
     count = vis_i.sum().astype(jnp.int32)
+    return pos, count
+
+
+def _place_by_chain_scatter(
+    crank: jax.Array,
+    c_valid: jax.Array,
+    chain_id: jax.Array,
+    head_row: jax.Array,
+    visible: jax.Array,
+    content: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Histogram placement (see chain_positions) + positional scatter of
+    the content codes."""
+    n = chain_id.shape[0]
+    pos, count = chain_positions(crank, c_valid, chain_id, head_row, visible)
     codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos, n)].set(
         content, mode="drop"
     )

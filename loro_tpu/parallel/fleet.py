@@ -171,44 +171,67 @@ class Fleet:
     def merge_richtext_changes(self, docs_changes: Sequence[Sequence[Change]], cid) -> List[list]:
         """Batched rich-text merge: per-doc change lists -> Quill-style
         segment lists with resolved styles (one vmapped launch)."""
-        from ..ops.fugue_batch import pad_bucket, pad_seq_columns
-        from ..ops.richtext_batch import RichtextCols, extract_richtext, richtext_merge_batch
+        from ..ops.fugue_batch import ChainColumns, pad_bucket
+        from ..ops.richtext_batch import (
+            RichtextChainCols,
+            extract_richtext_chain,
+            pad_richtext_chain_cols,
+            richtext_chain_merge_batch,
+        )
 
-        extracts = [extract_richtext(chs, cid) for chs in docs_changes]
-        n = pad_bucket(max(1, max(c.seq.parent.shape[0] for c, _, _ in extracts)))
+        extracts = [extract_richtext_chain(chs, cid) for chs in docs_changes]
+        n = pad_bucket(max(1, max(c.chain.chain_id.shape[0] for c, _, _ in extracts)))
+        cpad = pad_bucket(max(1, max(c.chain.c_parent.shape[0] for c, _, _ in extracts)))
         p = pad_bucket(max(1, max(c.pair_start.shape[0] for c, _, _ in extracts)), floor=16)
         n_keys = pad_bucket(max(1, max(len(k) for _, k, _ in extracts)), floor=4)
         d = len(extracts)
         d_pad = _mesh_pad(self.mesh, d)
 
-        def padp(a, fill, dtype):
-            out = np.full(p, fill, dtype)
-            out[: a.shape[0]] = a
-            return out
-
-        from ..ops.fugue_batch import SeqColumns
-
-        seqs, fields = [], {f: [] for f in RichtextCols._fields if f != "seq"}
-        for c, _, _ in extracts:
-            seqs.append(pad_seq_columns(c.seq, n))
-            for f in fields:
-                a = getattr(c, f)
-                fields[f].append(padp(a, False if f == "pair_valid" else 0, a.dtype))
-        empty_seq = _empty_seq_np(n)
-        while len(seqs) < d_pad:
-            seqs.append(empty_seq)
-            for f in fields:
-                fields[f].append(
-                    np.zeros(p, bool) if f == "pair_valid" else np.zeros(p, np.int32)
-                )
+        padded = [
+            pad_richtext_chain_cols(c, pad_n=n, pad_c=cpad, pad_p=p)
+            for c, _, _ in extracts
+        ]
+        if len(padded) < d_pad:  # doc-axis pad: one shared all-pad doc
+            empty = pad_richtext_chain_cols(
+                RichtextChainCols(
+                    chain=ChainColumns(
+                        c_parent=np.zeros(0, np.int32),
+                        c_side=np.zeros(0, np.int32),
+                        c_valid=np.zeros(0, bool),
+                        head_row=np.zeros(0, np.int32),
+                        chain_id=np.zeros(0, np.int32),
+                        deleted=np.zeros(0, bool),
+                        content=np.zeros(0, np.int32),
+                        valid=np.zeros(0, bool),
+                    ),
+                    pair_start=np.zeros(0, np.int32),
+                    pair_end=np.zeros(0, np.int32),
+                    pair_key=np.zeros(0, np.int32),
+                    pair_value=np.zeros(0, np.int32),
+                    pair_lamport=np.zeros(0, np.int32),
+                    pair_peer=np.zeros(0, np.int32),
+                    pair_valid=np.zeros(0, bool),
+                ),
+                pad_n=n,
+                pad_c=cpad,
+                pad_p=p,
+            )
+            padded.extend([empty] * (d_pad - len(padded)))
         sh = doc_sharding(self.mesh)
-        cols = RichtextCols(
-            seq=SeqColumns(
-                *[jax.device_put(np.stack([getattr(q, f) for q in seqs]), sh) for f in SeqColumns._fields]
+        cols = RichtextChainCols(
+            chain=ChainColumns(
+                *[
+                    jax.device_put(np.stack([getattr(q.chain, f) for q in padded]), sh)
+                    for f in ChainColumns._fields
+                ]
             ),
-            **{f: jax.device_put(np.stack(v), sh) for f, v in fields.items()},
+            **{
+                f: jax.device_put(np.stack([getattr(q, f) for q in padded]), sh)
+                for f in RichtextChainCols._fields
+                if f != "chain"
+            },
         )
-        codes, counts, bounds, win = richtext_merge_batch(cols, n_keys)
+        codes, counts, bounds, win = richtext_chain_merge_batch(cols, n_keys)
         codes = np.asarray(codes)
         counts = np.asarray(counts)
         bounds = np.asarray(bounds)

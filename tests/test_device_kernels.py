@@ -254,3 +254,33 @@ class TestLwwKernel:
                 if idx >= 0:
                     got[key] = ex.values[idx]
             assert got == host, f"doc {d}"
+
+
+class TestPeerCounterPerm:
+    def test_int32_counter_wrap_cannot_fake_sortedness(self):
+        """Adversarial payload: counters within one peer spanning >=2^31
+        make the int32 np.diff wrap positive, which (pre-fix) validated
+        the single-key argsort fast path and broke the (peer, counter)
+        ordered-kernel contract.  The check must difference in int64."""
+        from loro_tpu.ops.columnar import peer_counter_perm
+
+        peer = np.array([5, 5], np.int32)
+        # true order is descending: 2^31-1 then -2; int32 diff wraps to
+        # +(2^31 - 1) which looks ascending
+        counter = np.array([2**31 - 1, -2], np.int32)
+        parent = np.array([-1, -1], np.int32)
+        perm, inv, _ = peer_counter_perm(peer, counter, parent)
+        ctr_sorted = counter[perm].astype(np.int64)
+        assert list(perm) == [1, 0]
+        assert (np.diff(ctr_sorted) > 0).all()
+        assert list(inv[perm]) == [0, 1]
+
+    def test_fast_path_still_taken_for_causal_orders(self):
+        from loro_tpu.ops.columnar import peer_counter_perm
+
+        peer = np.array([1, 1, 2, 2, 2], np.int32)
+        counter = np.array([0, 1, 5, 6, 7], np.int32)
+        parent = np.array([-1, 0, -1, 2, 3], np.int32)
+        perm, inv, out_parent = peer_counter_perm(peer, counter, parent)
+        assert list(perm) == [0, 1, 2, 3, 4]
+        assert list(out_parent) == [-1, 0, -1, 2, 3]

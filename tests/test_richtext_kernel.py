@@ -179,3 +179,114 @@ class TestRichtextKernel:
         host = docs[0].get_text("t").get_richtext_value()
         assert docs[1].get_text("t").get_richtext_value() == host
         assert _device_richtext(docs[0]) == host, f"seed {seed}"
+
+
+def _device_richtext_chain(doc):
+    import jax.numpy as jnp
+
+    from loro_tpu.ops.fugue_batch import ChainColumns, pad_bucket
+    from loro_tpu.ops.richtext_batch import (
+        RichtextChainCols,
+        extract_richtext_chain,
+        pad_richtext_chain_cols,
+        richtext_chain_merge_doc,
+        segments_from_device,
+    )
+
+    doc.commit()
+    cid = doc.get_text("t").id
+    cols, keys, values = extract_richtext_chain(doc.oplog.changes_in_causal_order(), cid)
+    if cols.chain.chain_id.shape[0] == 0:
+        return []
+    n_keys = 4  # fixed for jit-cache sharing across seeds
+    assert len(keys) <= n_keys
+    cols = pad_richtext_chain_cols(
+        cols,
+        pad_n=pad_bucket(max(1, cols.chain.chain_id.shape[0])),
+        pad_c=pad_bucket(max(1, cols.chain.c_parent.shape[0])),
+        pad_p=pad_bucket(max(1, cols.pair_start.shape[0]), floor=16),
+    )
+    dc = RichtextChainCols(
+        chain=ChainColumns(*[jnp.asarray(a) for a in cols.chain]),
+        **{
+            f: jnp.asarray(getattr(cols, f))
+            for f in RichtextChainCols._fields
+            if f != "chain"
+        },
+    )
+    codes, count, bounds, win = richtext_chain_merge_doc(dc, n_keys)
+    return segments_from_device(codes, count, bounds, win, keys, values)
+
+
+class TestRichtextChainKernel:
+    """Differential: the chain-contracted richtext kernel must match the
+    host oracle on the same traces as the element-level kernel."""
+
+    def test_basic_mark(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(0, 5, "bold", True)
+        assert _device_richtext_chain(doc) == t.get_richtext_value()
+
+    def test_unmark_and_overlap(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "abcdefgh")
+        t.mark(0, 6, "bold", True)
+        t.unmark(2, 4, "bold")
+        t.mark(3, 8, "color", "red")
+        assert _device_richtext_chain(doc) == t.get_richtext_value()
+
+    def test_edits_inside_marks(self):
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(0, 5, "bold", True)
+        t.insert(3, "XX")
+        t.delete(8, 2)
+        assert _device_richtext_chain(doc) == t.get_richtext_value()
+
+    def test_concurrent_marks(self):
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        a.get_text("t").insert(0, "shared text here")
+        b.import_(a.export_snapshot())
+        a.get_text("t").mark(0, 10, "color", "red")
+        b.get_text("t").mark(5, 16, "color", "blue")
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        assert _device_richtext_chain(a) == a.get_text("t").get_richtext_value()
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_differential(self, seed):
+        rng = random.Random(1000 + seed)
+        docs = [LoroDoc(peer=i + 1) for i in range(3)]
+        keys = ["bold", "italic", "color"]
+        for _ in range(80):
+            d = rng.choice(docs)
+            t = d.get_text("t")
+            r = rng.random()
+            if len(t) == 0 or r < 0.45:
+                t.insert(rng.randint(0, len(t)), rng.choice(["ab", "xyz", "m", "longerrun"]))
+            elif r < 0.6:
+                pos = rng.randint(0, len(t) - 1)
+                t.delete(pos, min(rng.randint(1, 3), len(t) - pos))
+            elif len(t) >= 2:
+                s = rng.randint(0, len(t) - 2)
+                e = rng.randint(s + 1, len(t))
+                k = rng.choice(keys)
+                if rng.random() < 0.3:
+                    t.unmark(s, e, k)
+                else:
+                    t.mark(s, e, k, rng.choice([True, "red", 7]))
+            if rng.random() < 0.3:
+                s, d2 = rng.sample(docs, 2)
+                d2.import_(s.export_updates(d2.oplog_vv()))
+        for _ in range(2):
+            for s in docs:
+                for d2 in docs:
+                    if s is not d2:
+                        d2.import_(s.export_updates(d2.oplog_vv()))
+        host = docs[0].get_text("t").get_richtext_value()
+        assert docs[1].get_text("t").get_richtext_value() == host
+        assert _device_richtext_chain(docs[0]) == host, f"seed {seed}"

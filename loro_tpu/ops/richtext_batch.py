@@ -14,7 +14,7 @@ constant-style regions; winners resolve as masked maxima over the
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -381,11 +381,7 @@ def _explode_richtext(changes, cid):
     return ex, parr, keys, values
 
 
-def _pair_fields(parr: np.ndarray, pad_p: Optional[int] = None) -> dict:
-    pp = parr.shape[0]
-    if pad_p is not None and pad_p > pp:
-        pad = np.zeros((pad_p - pp, 7), np.int64)
-        parr = np.concatenate([parr, pad], axis=0)
+def _pair_fields(parr: np.ndarray) -> dict:
     return dict(
         pair_start=parr[:, 0].astype(np.int32),
         pair_end=parr[:, 1].astype(np.int32),
@@ -393,9 +389,7 @@ def _pair_fields(parr: np.ndarray, pad_p: Optional[int] = None) -> dict:
         pair_value=parr[:, 3].astype(np.int32),
         pair_lamport=parr[:, 4].astype(np.int32),
         pair_peer=parr[:, 5].astype(np.int32),
-        pair_valid=np.concatenate(
-            [parr[:pp, 6].astype(bool), np.zeros(max(0, (pad_p or pp) - pp), bool)]
-        ),
+        pair_valid=parr[:, 6].astype(bool),
     )
 
 
@@ -446,21 +440,15 @@ def pad_richtext_chain_cols(
     )
 
 
-def extract_richtext_chain(
-    changes,
-    cid,
-    pad_n: Optional[int] = None,
-    pad_c: Optional[int] = None,
-    pad_p: Optional[int] = None,
-):
+def extract_richtext_chain(changes, cid):
     """Host: chain-contracted RichtextChainCols (numpy) + (keys, values)
-    — ranking cost scales with chain count C, not element count N."""
+    — ranking cost scales with chain count C, not element count N.
+    Pad to device shapes with pad_richtext_chain_cols."""
     from .columnar import chain_columns
 
     ex, parr, keys, values = _explode_richtext(changes, cid)
-    chain = chain_columns(ex, pad_n=pad_n, pad_c=pad_c)
     return (
-        RichtextChainCols(chain=chain, **_pair_fields(parr, pad_p=pad_p)),
+        RichtextChainCols(chain=chain_columns(ex), **_pair_fields(parr)),
         keys,
         values,
     )

@@ -14,6 +14,7 @@ across varying doc sizes.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -792,9 +793,14 @@ class DeviceDocBatch:
             key_blk_lo = np.full(blk_shape, 0xFFFFFFFF, np.uint32)
             offsets = np.zeros(self.d, np.int32)
             renumbered: List[int] = []
-            for di, rows in enumerate(rows_per_doc):
-                if not rows:
-                    continue
+
+            def _ingest_doc(di: int) -> bool:
+                """Per-doc host work (block fill + order append): writes
+                touch doc-disjoint slices/state only, and the native
+                order engine's ctypes call releases the GIL, so docs
+                shard across threads.  Returns True when the doc's keys
+                were renumbered (caller re-uploads the whole key row)."""
+                rows = rows_per_doc[di]
                 k = len(rows)
                 base = int(self.counts[di])
                 arr = np.asarray([(r[0], r[1], r[2], r[3]) for r in rows], np.int64)
@@ -810,14 +816,31 @@ class DeviceDocBatch:
                 keys = self.order[di].append_rows(
                     [(r[0], r[1], int(r[4]), r[2]) for r in rows], base
                 )
-                if keys is None:
-                    renumbered.append(di)
-                else:
+                renum = keys is None
+                if not renum:
                     kh, kl = split_keys(np.asarray(keys, np.int64))
                     key_blk_hi[di, :k] = kh
                     key_blk_lo[di, :k] = kl
                 offsets[di] = base
                 self.counts[di] += k
+                return renum
+
+            active = [di for di, rows in enumerate(rows_per_doc) if rows]
+            n_threads = min(
+                int(os.environ.get("LORO_ORDER_THREADS") or (os.cpu_count() or 1)),
+                max(1, len(active)),
+            )
+            if n_threads > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=n_threads) as pool:
+                    for di, renum in zip(active, pool.map(_ingest_doc, active)):
+                        if renum:
+                            renumbered.append(di)
+            else:
+                for di in active:
+                    if _ingest_doc(di):
+                        renumbered.append(di)
             sh = doc_sharding(self.mesh)
             blk_dev = {f: jax.device_put(v, sh) for f, v in blk.items()}
             blk_dev["key_hi"] = jax.device_put(key_blk_hi, sh)
@@ -828,12 +851,18 @@ class DeviceDocBatch:
                 jax.device_put(offsets, replicated(self.mesh)),
             )
             self.cols, self.key_hi, self.key_lo = packed
-            # renumbered docs: re-upload the whole key row (rare)
+            # renumbered docs: re-upload the whole key row (rare).
+            # Fixed [cap]-shaped row updates — a :n slice set would
+            # compile a fresh scatter per distinct n (measured as a
+            # compile storm in tests/soak_fleet.py)
             for di in renumbered:
                 kh, kl = split_keys(self.order[di].all_keys())
-                n = len(kh)
-                self.key_hi = self.key_hi.at[di, :n].set(jnp.asarray(kh))
-                self.key_lo = self.key_lo.at[di, :n].set(jnp.asarray(kl))
+                kh_full = np.full(self.cap, 0xFFFFFFFF, np.uint32)
+                kl_full = np.full(self.cap, 0xFFFFFFFF, np.uint32)
+                kh_full[: len(kh)] = kh
+                kl_full[: len(kl)] = kl
+                self.key_hi = self.key_hi.at[di].set(jnp.asarray(kh_full))
+                self.key_lo = self.key_lo.at[di].set(jnp.asarray(kl_full))
         self.mark_deleted(del_pairs)
 
     def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]], cid) -> None:

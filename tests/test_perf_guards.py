@@ -202,3 +202,35 @@ def test_diff_delta_vs_fullscan_equivalence():
         assert fast.items == slow.items, (
             f"ranged diff mismatch: {fast.items} vs {slow.items}"
         )
+
+
+def test_native_order_engine_floor():
+    """Resident-fleet host ceiling guard (tests/soak_fleet.py measures
+    ~3M rows/s/core isolated): the native order engine must stay above
+    a conservative floor so a regression in the C++ splice path can't
+    silently starve thousands-of-docs resident fleets."""
+    import random as _random
+
+    from loro_tpu.native import native_order
+
+    eng_factory = native_order
+    if eng_factory() is None:
+        pytest.skip("native library unavailable")
+    rng = _random.Random(1)
+    k = 4096
+    rows = []
+    for i in range(k):
+        if i and rng.random() < 0.7:
+            rows.append((i - 1, 1, 7, i))
+        else:
+            rows.append((rng.randrange(i) if i else -1, rng.choice([0, 1]), 7, i))
+
+    def one(_n):
+        eng = eng_factory()
+        t0 = time.perf_counter()
+        eng.append_rows(rows, 0)
+        return time.perf_counter() - t0
+
+    best = _best_of(one, k, reps=5)
+    rate = k / best
+    assert rate > 500_000, f"native order engine at {rate/1e6:.2f}M rows/s (< 0.5M floor)"

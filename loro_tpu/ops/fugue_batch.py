@@ -101,6 +101,58 @@ def _wyllie_dist(succ: jax.Array) -> jax.Array:
     return T[:, 0]
 
 
+def make_ring_rank_sharded(mesh, m: int):
+    """Op-axis-sharded Wyllie ranking (SURVEY.md §2.4 item 2 for the
+    sequence kernel): succ [D, m] sharded P(docs, ops) -> dist [D, m].
+
+    Each op-shard owns m/S contiguous ring rows; every doubling round
+    all_gathers the (dist, succ) row table along the op axis and updates
+    only its local rows — the random-row gathers (the measured ~all of
+    the merge cost on v5e) divide by S while each round moves m*8B per
+    doc over ICI.  Communication-optimal doubling would need an
+    all-to-all of exactly the requested rows; the all_gather variant is
+    the XLA-collective formulation of the same plan and is already
+    latency-bound, not bandwidth-bound, at CRDT ring sizes (m*8B =
+    ~260KB at the flagship m=32896).  Doc-axis sharding stays the
+    default — see ARCHITECTURE.md §"Op-axis ranking verdict"."""
+    from jax.sharding import PartitionSpec as P
+
+    try:  # jax >= 0.8
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..parallel.mesh import DOC_AXIS, OP_AXIS
+
+    n_steps = max(1, int(np.ceil(np.log2(max(m, 2)))))
+
+    def local(succ_sh: jax.Array) -> jax.Array:  # [d_local, ms] global ids
+        ms = succ_sh.shape[1]
+        tok0 = jax.lax.axis_index(OP_AXIS).astype(jnp.int32) * ms
+        tok = tok0 + jnp.arange(ms, dtype=jnp.int32)[None, :]
+        dist0 = jnp.where(succ_sh == tok, 0, 1).astype(jnp.int32)
+        T = jnp.stack([dist0, succ_sh], axis=-1)  # [d, ms, 2]
+
+        def body(_, T):
+            T_full = jax.lax.all_gather(T, OP_AXIS, axis=1, tiled=True)  # [d, m, 2]
+            g = jax.vmap(lambda full, t: jnp.take(full, t, axis=0))(
+                T_full, T[:, :, 1]
+            )  # [d, ms, 2]: (dist[t], succ[t])
+            return jnp.stack([T[:, :, 0] + g[:, :, 0], g[:, :, 1]], axis=-1)
+
+        T = jax.lax.fori_loop(0, n_steps, body, T)
+        return T[:, :, 0]
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(DOC_AXIS, OP_AXIS),),
+            out_specs=P(DOC_AXIS, OP_AXIS),
+        )
+    )
+
+
 def _ruling_dist(succ: jax.Array, k: int = 8) -> jax.Array:
     """Distance-to-terminal via a two-level ruling set.
 

@@ -826,8 +826,19 @@ class DeviceDocBatch:
                 return renum
 
             active = [di for di, rows in enumerate(rows_per_doc) if rows]
+            # thread fan-out only pays when the order engine is the
+            # native one (ctypes releases the GIL); the Python
+            # ShadowOrder fallback would serialize through the GIL and
+            # eat pool-spawn overhead on the hot path
+            from ..native import NativeShadowOrder
+
+            native_engine = bool(self.order) and isinstance(
+                self.order[0], NativeShadowOrder
+            )
             n_threads = min(
-                int(os.environ.get("LORO_ORDER_THREADS") or (os.cpu_count() or 1)),
+                int(os.environ.get("LORO_ORDER_THREADS") or (os.cpu_count() or 1))
+                if native_engine
+                else 1,
                 max(1, len(active)),
             )
             if n_threads > 1:

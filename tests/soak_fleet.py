@@ -104,7 +104,9 @@ def run_fleet(label: str, use_payloads: bool):
         total_rows += r
     ingest_dt = time.perf_counter() - t0
     # steady state = per-epoch rates once the scatter buckets are warm
-    steady = sorted((r / dt for r, dt in zip(epoch_rows[2:], epoch_dts[2:])))
+    # (falls back to all epochs when there are too few to skip warmup)
+    skip = 2 if EPOCHS > 2 else 0
+    steady = sorted((r / dt for r, dt in zip(epoch_rows[skip:], epoch_dts[skip:])))
     # correctness gate: device texts == host oracle texts
     texts = batch.texts()
     for di in range(N_DOCS):
@@ -135,8 +137,7 @@ print(f"correctness: {N_DOCS} resident docs match host oracles (both paths)")
 # ---- isolated native order-engine ceiling ---------------------------
 from loro_tpu.native import native_order  # noqa: E402
 
-eng = native_order()
-if eng is None:
+if native_order() is None:
     print("native order engine unavailable; skipping isolated ceiling")
 else:
     rng = random.Random(1)
@@ -144,7 +145,7 @@ else:
     reps = 6
     best = None
     for _ in range(reps):
-        eng = native_order.__call__()
+        eng = native_order()
         rows = []
         n = 0
         # realistic mix: 70% run-extend (parent = prev row), 30% random

@@ -1,0 +1,42 @@
+"""Op-axis-sharded ring ranking must match single-device Wyllie on the
+same rings (2D docs x ops mesh; SURVEY.md §2.4 item 2)."""
+import numpy as np
+import pytest
+
+import jax
+
+from loro_tpu.ops.fugue_batch import _wyllie_dist, make_ring_rank_sharded
+from loro_tpu.parallel.mesh import make_mesh
+
+
+def _ring(rng, m):
+    live = rng.choice(m, size=rng.integers(2, m + 1), replace=False)
+    p = rng.permutation(live).astype(np.int32)
+    succ = np.arange(m, dtype=np.int32)
+    succ[p[:-1]] = p[1:]
+    return succ
+
+
+@pytest.mark.parametrize("op_parallel", [2, 4, 8])
+def test_sharded_matches_wyllie(op_parallel):
+    mesh = make_mesh(op_parallel=op_parallel)
+    d = mesh.shape["docs"] * 2
+    m = 512
+    rng = np.random.default_rng(3)
+    succ = np.stack([_ring(rng, m) for _ in range(d)])
+    fn = make_ring_rank_sharded(mesh, m)
+    got = np.asarray(fn(jax.device_put(succ)))
+    want = np.stack([np.asarray(jax.jit(_wyllie_dist)(s)) for s in succ])
+    assert (got == want).all()
+
+
+def test_sharded_flagship_shape_runs():
+    mesh = make_mesh(op_parallel=4)
+    d = mesh.shape["docs"]
+    m = 4096
+    rng = np.random.default_rng(11)
+    succ = np.stack([_ring(rng, m) for _ in range(d)])
+    fn = make_ring_rank_sharded(mesh, m)
+    got = np.asarray(fn(jax.device_put(succ)))
+    want = np.stack([np.asarray(jax.jit(_wyllie_dist)(s)) for s in succ])
+    assert (got == want).all()

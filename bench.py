@@ -127,6 +127,12 @@ def assemble_record(ck: dict) -> dict:
         "achieved_hbm_gbps_model",
         "hbm_frac_model",
         "roofline_note",
+        "rank_ms_measured",
+        "place_ms_measured",
+        "gather_rows_per_sec_measured",
+        "achieved_hbm_gbps_measured",
+        "hbm_frac",
+        "roofline_measured_note",
         "e2e_value",
         "e2e_unit",
         "e2e_vs_baseline",
@@ -675,8 +681,8 @@ def main() -> None:
                 f"latency: p50 {p50 * 1e3:.0f}ms p99 {p99 * 1e3:.0f}ms over {n_lat} samples"
             )
 
-    # ---- phase: roofline / bytes-moved accounting --------------------
-    # Model (documented lower bound, per doc):
+    # shared roofline constants (both the measured and the model phase
+    # read the SAME byte model — keep them from drifting apart):
     #   ranking ring: m = 2*(pad_c+1) u32 tokens; XLA path gathers the
     #     [m, 2] row table log2(m) times from HBM (8B/row/round);
     #     pallas path loads/stores the ring once (VMEM-resident loop)
@@ -686,15 +692,74 @@ def main() -> None:
     #   unpack/stream: content + flags ~ 10B/row read, 4B/row write
     m_ring = 2 * (pad_c + 1)
     rank_rounds = int(np.ceil(np.log2(max(m_ring, 2))))
+    place_bytes = 3 * pad_n * 8 + pad_n * 14
+    peak = next((v for k, v in HBM_PEAK.items() if k in str(device_kind).lower()), None)
+
+    # ---- phase: MEASURED roofline (on-chip phase split) --------------
+    # fetch-synced per-phase timings: rank-only vs full merge on one
+    # chunk; placement = difference.  Combined with the byte model this
+    # yields achieved HBM GB/s and a non-null hbm_frac with device
+    # provenance (VERDICT r3 item 4: a measured number, not a model)
+    if remaining() > 30 and os.environ.get("BENCH_SKIP_ROOFLINE") != "1":
+        from loro_tpu.ops.fugue_batch import chain_rank_checksum_v
+
+        impl = "pallas" if kernel_name == "pallas" else "xla"
+
+        def timed(fn, reps=5):
+            def fetch(o):
+                np.asarray(o[0] if isinstance(o, tuple) else o)
+
+            fetch(fn(batches[0]))
+            ts = []
+            for _ in range(reps):
+                t1 = time.perf_counter()
+                fetch(fn(batches[0]))
+                ts.append(time.perf_counter() - t1)
+            ts.sort()
+            return ts[len(ts) // 2]
+
+        try:
+            t_rank_m = timed(lambda b: chain_rank_checksum_v(b, rank_impl=impl))
+            t_full_m = timed(flagship_fn)
+        except Exception as e:
+            note(f"measured-roofline phase failed ({type(e).__name__}: {e})")
+        else:
+            t_rank_net = max(t_rank_m - rtt, 1e-4)
+            t_full_net = max(t_full_m - rtt, 1e-4)
+            t_place_net = max(t_full_net - t_rank_net, 1e-4)
+            gather_rows_meas = rank_rounds * m_ring * chunk / t_rank_net
+            ach_gbps = place_bytes * chunk / t_place_net / 1e9
+            bank(
+                "roofline_measured",
+                rank_ms_measured=round(t_rank_net * 1e3, 1),
+                place_ms_measured=round(t_place_net * 1e3, 1),
+                gather_rows_per_sec_measured=round(gather_rows_meas),
+                achieved_hbm_gbps_measured=round(ach_gbps, 1),
+                hbm_frac=round(ach_gbps * 1e9 / peak, 4) if peak else None,
+                roofline_measured_note=(
+                    f"fetch-synced medians net of RTT on {platform}: rank-only vs "
+                    "full merge per chunk; placement bytes from the documented "
+                    "floor model (3 sort passes x 8B + 14B stream per row); "
+                    "hbm_frac = placement-phase achieved/peak (ranking rides "
+                    "VMEM on the pallas path); gather_rows_per_sec_measured vs "
+                    "the ~80-100M rows/s v5e random-gather ceiling"
+                ),
+            )
+            note(
+                f"measured roofline: rank {t_rank_net*1e3:.0f}ms place "
+                f"{t_place_net*1e3:.0f}ms -> {ach_gbps:.1f} GB/s"
+                + (f" ({ach_gbps*1e9/peak:.1%} of peak)" if peak else "")
+            )
+
+    # ---- phase: roofline / bytes-moved accounting (model) ------------
+    # (byte-model constants shared with the measured phase above)
     if kernel_name == "pallas":
         rank_bytes = 2 * m_ring * 4  # HBM load + store; rounds ride VMEM
     else:
         rank_bytes = rank_rounds * m_ring * 8
-    place_bytes = 3 * pad_n * 8 + pad_n * 14
     ops_per_doc = float(np.mean(per_doc_ops))
     bytes_per_op = (rank_bytes + place_bytes) / ops_per_doc
     achieved = bytes_per_op * kernel_ops_s
-    peak = next((v for k, v in HBM_PEAK.items() if k in str(device_kind).lower()), None)
     gather_rows = None
     if kernel_ops_s:
         # every ranking round gathers m rows; chunk docs per launch

@@ -624,6 +624,21 @@ def chain_merge_docs_checksum_v(
     return _weighted_checksum(codes), counts
 
 
+@functools.partial(jax.jit, static_argnames=("rank_impl",))
+def chain_rank_checksum_v(
+    cols: ChainColumns, rank_impl: Optional[str] = None
+) -> jax.Array:
+    """Ranking phase ONLY (scalar-reduced for cheap fetches): the
+    measured-roofline bench phase times this against the full merge to
+    split rank vs placement cost on chip."""
+
+    def one(c: ChainColumns) -> jax.Array:
+        crank = _order_core(c.c_parent, c.c_side, c.c_valid, rank_impl=rank_impl)
+        return crank.astype(jnp.uint32).sum(dtype=jnp.uint32)
+
+    return jax.vmap(one)(cols)
+
+
 # ---- packed single-buffer transport (ingest pipeline) ----------------
 # The e2e pipeline ships one chunk as ONE contiguous u8 buffer instead
 # of 8 separate device_puts with loose dtypes: per-put tunnel overhead

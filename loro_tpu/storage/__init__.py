@@ -1,0 +1,6 @@
+"""Reusable storage layer: the general ordered-KV store + SSTable
+format (reference: crates/kv-store — MemKvStore over prefix-compressed
+SSTable blocks, lib.rs:1-143)."""
+from .kv import CompressionType, MemKvStore
+
+__all__ = ["MemKvStore", "CompressionType"]

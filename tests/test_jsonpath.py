@@ -81,3 +81,128 @@ class TestQuery:
         doc.commit()
         assert len(seen) == 1
         unsub()
+
+
+class TestFullGrammar:
+    """Round-4 grammar completion: logical exprs, functions, unions of
+    arbitrary selectors, nested/root queries in filters, contains/in
+    (reference: jsonpath.pest + jsonpath_impl.rs eval_function)."""
+
+    def test_filter_no_parens(self):
+        doc = store_doc()
+        assert query(doc, "$.store.book[?@.price < 9].title") == ["Sayings", "Moby Dick"]
+
+    def test_logical_and_or_not(self):
+        doc = store_doc()
+        got = query(doc, "$.store.book[?(@.price < 9 && @.category == 'fiction')].title")
+        assert got == ["Moby Dick"]
+        got = query(doc, "$.store.book[?(@.price < 9 || @.category == 'fiction')].title")
+        assert got == ["Sayings", "Sword", "Moby Dick"]
+        got = query(doc, "$.store.book[?(!(@.category == 'fiction'))].title")
+        assert got == ["Sayings"]
+
+    def test_existence_test(self):
+        doc = store_doc()
+        b = doc.get_map("store").get("book").get(0)
+        b.set("isbn", "0-553-21311-3")
+        doc.commit()
+        assert query(doc, "$.store.book[?@.isbn].title") == ["Sayings"]
+        assert query(doc, "$.store.book[?(!@.isbn)].title") == ["Sword", "Moby Dick"]
+
+    def test_nested_rel_query(self):
+        doc = LoroDoc(peer=1)
+        m = doc.get_map("m")
+        m.set("rows", [{"meta": {"ok": True}, "v": 1}, {"meta": {"ok": False}, "v": 2}])
+        doc.commit()
+        # bare query = existence (reference to_logical: non-empty
+        # nodelist), so truthiness needs the explicit comparison
+        assert query(doc, "$.m.rows[?@.meta.ok].v") == [1, 2]
+        assert query(doc, "$.m.rows[?@.meta.ok == true].v") == [1]
+
+    def test_root_query_in_filter(self):
+        doc = store_doc()
+        doc.get_map("store").set("maxprice", 9)
+        doc.commit()
+        got = query(doc, "$.store.book[?(@.price < $.store.maxprice)].title")
+        assert got == ["Sayings", "Moby Dick"]
+
+    def test_functions_length_count_value(self):
+        doc = store_doc()
+        assert query(doc, "$.store.book[?(length(@.title) > 5)].title") == ["Sayings", "Moby Dick"]
+        assert query(doc, "$.store.book[?(count(@.*) == 3)].title") == [
+            "Sayings", "Sword", "Moby Dick",
+        ]
+        assert query(doc, "$.store.book[?(value(@.price) == 12.99)].title") == ["Sword"]
+
+    def test_functions_match_search(self):
+        doc = store_doc()
+        assert query(doc, "$.store.book[?(match(@.title, 'S.*'))].title") == ["Sayings", "Sword"]
+        # match is a FULL match: 'Dick' alone must not match 'Moby Dick'
+        assert query(doc, "$.store.book[?(match(@.title, 'Dick'))].title") == []
+        assert query(doc, "$.store.book[?(search(@.title, 'Dick'))].title") == ["Moby Dick"]
+
+    def test_contains_and_in(self):
+        doc = LoroDoc(peer=1)
+        m = doc.get_map("m")
+        m.set("rows", [{"tags": ["a", "b"], "n": 1}, {"tags": ["c"], "n": 2}])
+        doc.commit()
+        assert query(doc, "$.m.rows[?(@.tags contains 'b')].n") == [1]
+        assert query(doc, "$.m.rows[?('c' in @.tags)].n") == [2]
+        assert query(doc, "$.m.rows[?(@.n in [1, 3])].n") == [1]
+
+    def test_union_of_mixed_selectors(self):
+        doc = store_doc()
+        got = query(doc, "$.store.book[0, 2].title")
+        assert got == ["Sayings", "Moby Dick"]
+        got = query(doc, "$.store.book[0, 1:3].title")
+        assert got == ["Sayings", "Sword", "Moby Dick"]
+        got = query(doc, "$.store.book[?(@.price > 10), 0].title")
+        assert got == ["Sword", "Sayings"]
+
+    def test_negative_slice_step(self):
+        doc = store_doc()
+        assert query(doc, "$.store.book[::-1].title") == ["Moby Dick", "Sword", "Sayings"]
+
+    def test_recursive_bracket(self):
+        doc = store_doc()
+        prices = query(doc, "$..['price']")
+        assert sorted(prices) == [8.95, 8.99, 12.99, 19.95]
+        assert query(doc, "$..book[0].title") == ["Sayings"]
+
+    def test_string_escapes(self):
+        doc = LoroDoc(peer=1)
+        doc.get_map("m").set('we"ird\nkey', 42)
+        doc.commit()
+        assert query(doc, '$.m["we\\"ird\\nkey"]') == [42]
+        doc.get_map("m").set("é", "acc")
+        doc.commit()
+        assert query(doc, '$.m["\\u00e9"]') == ["acc"]
+
+    def test_filter_on_strings_comparison(self):
+        doc = store_doc()
+        got = query(doc, "$.store.book[?(@.category != 'fiction')].title")
+        assert got == ["Sayings"]
+        got = query(doc, "$.store.book[?(8.95 <= @.price)].title")
+        assert got == ["Sayings", "Sword", "Moby Dick"]
+
+    def test_errors(self):
+        doc = store_doc()
+        for bad in (
+            "$.store.book[?]",
+            "$.store.book[?(@.price <)]",
+            "$.store.book[?nosuchfn(@.a)]",
+            "$.store.book[0",
+            "$.a[1:2:0]",
+            "$x",
+        ):
+            with pytest.raises(JsonPathError):
+                query(doc, bad)
+
+    def test_subscription_still_works(self):
+        doc = store_doc()
+        seen = []
+        unsub = subscribe_jsonpath(doc, "$.store.book[?(@.price < 9)].title", seen.append)
+        doc.get_map("store").get("book").get(1).set("price", 5.0)
+        doc.commit()
+        assert seen and seen[-1] == ["Sayings", "Sword", "Moby Dick"]
+        unsub()

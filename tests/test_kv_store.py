@@ -1,0 +1,167 @@
+"""General ordered-KV store + SSTable format (reference:
+crates/kv-store mem_store.rs behavior tests)."""
+import random
+import zlib
+
+import pytest
+
+from loro_tpu.errors import DecodeError
+from loro_tpu.storage import CompressionType, MemKvStore
+
+
+def _fill(kv, n=500, seed=0, prefix=b"key/"):
+    rng = random.Random(seed)
+    items = {}
+    for i in range(n):
+        k = prefix + f"{rng.randrange(10**9):09d}".encode()
+        v = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+        kv.set(k, v)
+        items[k] = v
+    return items
+
+
+class TestMemKvStore:
+    def test_point_ops(self):
+        kv = MemKvStore()
+        assert kv.get(b"a") is None
+        kv.set(b"a", b"1")
+        kv.set(b"b", b"2")
+        assert kv.get(b"a") == b"1"
+        assert kv.contains_key(b"b")
+        kv.remove(b"a")
+        assert kv.get(b"a") is None
+        assert len(kv) == 1
+        assert not kv.is_empty()
+
+    def test_compare_and_swap(self):
+        kv = MemKvStore()
+        assert kv.compare_and_swap(b"k", None, b"v1")
+        assert not kv.compare_and_swap(b"k", None, b"v2")
+        assert kv.compare_and_swap(b"k", b"v1", b"v2")
+        assert kv.get(b"k") == b"v2"
+
+    def test_scan_order_and_ranges(self):
+        kv = MemKvStore()
+        items = _fill(kv, 300)
+        ks = sorted(items)
+        got = list(kv.scan())
+        assert [k for k, _ in got] == ks
+        assert dict(got) == items
+        lo, hi = ks[50], ks[200]
+        sub = list(kv.scan(start=lo, end=hi))
+        assert [k for k, _ in sub] == ks[50:200]
+        rev = list(kv.scan(start=lo, end=hi, reverse=True))
+        assert rev == sub[::-1]
+
+    def test_export_import_roundtrip(self):
+        kv = MemKvStore()
+        items = _fill(kv, 800)
+        blob = kv.export_all()
+        kv2 = MemKvStore()
+        kv2.import_all(blob)
+        assert dict(kv2.scan()) == items
+        assert len(kv2) == len(items)
+        # point reads after import
+        some = sorted(items)[123]
+        assert kv2.get(some) == items[some]
+        assert kv2.get(b"missing") is None
+
+    def test_lazy_block_hydration(self):
+        kv = MemKvStore(block_size=512)
+        items = _fill(kv, 2000)
+        kv2 = MemKvStore()
+        kv2.import_all(kv.export_all())
+        assert kv2.n_blocks > 4
+        assert kv2.decoded_blocks == 0  # metas only
+        some = sorted(items)[1000]
+        assert kv2.get(some) == items[some]
+        assert kv2.decoded_blocks == 1  # exactly one block touched
+
+    def test_prefix_compression_helps(self):
+        kv_c = MemKvStore()
+        kv_n = MemKvStore(compression=CompressionType.NONE)
+        for kv in (kv_c, kv_n):
+            for i in range(1000):
+                kv.set(f"container/text/elem/{i:08d}".encode(), b"v" * 8)
+        raw = sum(len(f"container/text/elem/{i:08d}") + 8 for i in range(1000))
+        blob_n = kv_n.export_all()
+        # shared prefixes collapse even without zlib
+        assert len(blob_n) < raw * 0.7
+        assert len(kv_c.export_all()) < len(blob_n)
+
+    def test_memtable_shadows_imported(self):
+        kv = MemKvStore()
+        kv.set(b"a", b"old")
+        kv.set(b"b", b"keep")
+        kv2 = MemKvStore()
+        kv2.import_all(kv.export_all())
+        kv2.set(b"a", b"new")
+        kv2.remove(b"b")
+        kv2.set(b"c", b"fresh")
+        assert kv2.get(b"a") == b"new"
+        assert kv2.get(b"b") is None
+        assert dict(kv2.scan()) == {b"a": b"new", b"c": b"fresh"}
+        # re-export merges the views
+        kv3 = MemKvStore()
+        kv3.import_all(kv2.export_all())
+        assert dict(kv3.scan()) == {b"a": b"new", b"c": b"fresh"}
+
+    def test_large_value_block(self):
+        kv = MemKvStore(block_size=256)
+        big = bytes(range(256)) * 40  # 10KB
+        kv.set(b"big", big)
+        kv.set(b"a", b"small")
+        kv.set(b"z", b"small2")
+        kv2 = MemKvStore()
+        kv2.import_all(kv.export_all())
+        assert kv2.get(b"big") == big
+        assert dict(kv2.scan()) == {b"a": b"small", b"big": big, b"z": b"small2"}
+
+    def test_corruption_detected(self):
+        kv = MemKvStore()
+        _fill(kv, 200)
+        blob = bytearray(kv.export_all())
+        # flip a byte inside the first block's body
+        blob[10] ^= 0xFF
+        kv2 = MemKvStore()
+        kv2.import_all(bytes(blob))  # metas may still parse
+        with pytest.raises(DecodeError):
+            list(kv2.scan())
+
+    def test_not_a_store(self):
+        kv = MemKvStore()
+        for junk in (b"", b"LTKV", b"nope" * 10, bytes(64)):
+            with pytest.raises(DecodeError):
+                kv.import_all(junk)
+
+    def test_random_fuzz_vs_dict(self):
+        rng = random.Random(42)
+        kv = MemKvStore(block_size=512)
+        model = {}
+        for round_ in range(6):
+            for _ in range(300):
+                op = rng.random()
+                k = f"k{rng.randrange(200):03d}".encode()
+                if op < 0.55:
+                    v = f"v{rng.randrange(10**6)}".encode()
+                    kv.set(k, v)
+                    model[k] = v
+                elif op < 0.8:
+                    kv.remove(k)
+                    model.pop(k, None)
+                else:
+                    assert kv.get(k) == model.get(k)
+            assert dict(kv.scan()) == model
+            # periodically roundtrip through the SSTable
+            if round_ % 2 == 1:
+                kv2 = MemKvStore(block_size=512)
+                kv2.import_all(kv.export_all())
+                kv = kv2
+                assert dict(kv.scan()) == model
+
+    def test_compression_none_roundtrip(self):
+        kv = MemKvStore(compression=CompressionType.NONE)
+        items = _fill(kv, 300, seed=9)
+        kv2 = MemKvStore()
+        kv2.import_all(kv.export_all())
+        assert dict(kv2.scan()) == items

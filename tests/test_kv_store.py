@@ -165,3 +165,35 @@ class TestMemKvStore:
         kv2 = MemKvStore()
         kv2.import_all(kv.export_all())
         assert dict(kv2.scan()) == items
+
+    def test_arbitrary_bytes_never_crash(self):
+        """random_import.rs / mem_kv_fuzzer analog: arbitrary bytes into
+        import_all (and subsequent reads) raise DecodeError or succeed —
+        never crash, never corrupt the store silently past its checks."""
+        rng = random.Random(7)
+        kv_full = MemKvStore(block_size=128)
+        items = _fill(kv_full, 120, seed=3)
+        full = bytearray(kv_full.export_all())
+        probe = sorted(items)[60]
+        # the pristine blobs MUST import (outside the try/except)
+        for pristine in (MemKvStore(block_size=128).export_all(), bytes(full)):
+            kv = MemKvStore()
+            kv.import_all(pristine)
+            list(kv.scan())
+        blobs = []
+        for _ in range(200):
+            b = bytearray(full)
+            for _ in range(rng.randrange(1, 6)):
+                i = rng.randrange(len(b))
+                b[i] = rng.randrange(256)
+            blobs.append(bytes(b))
+        for _ in range(50):
+            blobs.append(bytes(rng.randrange(256) for _ in range(rng.randrange(0, 200))))
+        for blob in blobs:
+            kv = MemKvStore()
+            try:
+                kv.import_all(blob)
+                kv.get(probe)  # point lookup decodes one block cold
+                list(kv.scan())
+            except DecodeError:
+                pass

@@ -329,8 +329,21 @@ def wyllie_rank(succ: jax.Array, interpret: Optional[bool] = None) -> jax.Array:
     algo = os.environ.get("PALLAS_RANK_ALGO", "ruling")
     if algo not in ("wyllie", "ruling"):
         raise ValueError(f"PALLAS_RANK_ALGO must be wyllie|ruling, got {algo!r}")
-    # ruling needs the dense ruler ring 128-aligned: pad to 128*k tokens
-    quantum = _LANES * 8 if algo == "ruling" else _LANES
+    # ruler spacing: phase-1 rounds grow ~log2(k*ln m) while the dense
+    # phase-2 ring shrinks k-fold — PALLAS_RULING_K exposes the
+    # tradeoff for on-chip sweeps (power of two; read at trace time;
+    # capped at 512 so the 128*k pad quantum stays within the packed
+    # kernel's 65536-token domain)
+    if algo == "ruling":
+        k = int(os.environ.get("PALLAS_RULING_K", "8"))
+        if not 2 <= k <= 512 or (k & (k - 1)) != 0:
+            raise ValueError(
+                f"PALLAS_RULING_K must be a power of two in [2, 512], got {k}"
+            )
+        quantum = _LANES * k  # dense ruler ring must be 128-aligned
+    else:
+        k = 8  # unused off the ruling path
+        quantum = _LANES
     mp = -(-m // quantum) * quantum
     if mp > PALLAS_RANK_MAX_M:
         raise ValueError(f"ring too long for VMEM ranking: {m}")
@@ -342,7 +355,11 @@ def wyllie_rank(succ: jax.Array, interpret: Optional[bool] = None) -> jax.Array:
         interpret = jax.default_backend() != "tpu"
     rows = mp // _LANES
     if mp <= 65536:
-        kernel = _rank_kernel_ruling if algo == "ruling" else _rank_kernel
+        kernel = (
+            functools.partial(_rank_kernel_ruling, k=k)
+            if algo == "ruling"
+            else _rank_kernel
+        )
     else:
         kernel = _rank_kernel_wide
     fn = pl.pallas_call(

@@ -87,3 +87,37 @@ def test_ruling_kernel_adversarial_gap(monkeypatch):
     got = np.asarray(wyllie_rank(s, interpret=True))
     want = np.asarray(wyllie_rank_xla(s))
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("k", [2, 4, 16, 128])
+def test_ruling_k_sweep_differential(k, monkeypatch):
+    """PALLAS_RULING_K sweep: the ruling kernel must stay bit-identical
+    to the XLA reference at every legal ruler spacing (the env is read
+    per wyllie_rank call, so one process covers the sweep)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PALLAS_RANK_ALGO", "ruling")
+    monkeypatch.setenv("PALLAS_RULING_K", str(k))
+    for m in (64, 257, 1500):
+        succ = jnp.asarray(_random_ring(m, 31 * m + k))
+        got = np.asarray(wyllie_rank(succ, interpret=True))
+        want = np.asarray(wyllie_rank_xla(succ))
+        np.testing.assert_array_equal(got, want, err_msg=f"k={k} m={m}")
+
+
+def test_ruling_k_validation(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PALLAS_RANK_ALGO", "ruling")
+    for bad in ("6", "1", "1024", "0"):
+        monkeypatch.setenv("PALLAS_RULING_K", bad)
+        with pytest.raises(ValueError):
+            wyllie_rank(jnp.asarray(_random_ring(64, 1)), interpret=True)
+    # a stale invalid k must NOT break the wyllie path (k unused there)
+    monkeypatch.setenv("PALLAS_RULING_K", "6")
+    monkeypatch.setenv("PALLAS_RANK_ALGO", "wyllie")
+    succ = jnp.asarray(_random_ring(64, 2))
+    np.testing.assert_array_equal(
+        np.asarray(wyllie_rank(succ, interpret=True)),
+        np.asarray(wyllie_rank_xla(succ)),
+    )

@@ -341,6 +341,13 @@ def wyllie_rank(succ: jax.Array, interpret: Optional[bool] = None) -> jax.Array:
                 f"PALLAS_RULING_K must be a power of two in [2, 512], got {k}"
             )
         quantum = _LANES * k  # dense ruler ring must be 128-aligned
+        if -(-m // quantum) * quantum > 65536 >= m:
+            # the k-aligned pad would leave the packed-kernel domain
+            # (and the wide kernel ignores k anyway, with up to 2x pad
+            # waste) — fall back to the plain packed wyllie kernel,
+            # which only needs lane alignment
+            algo = "wyllie"
+            quantum = _LANES
     else:
         k = 8  # unused off the ruling path
         quantum = _LANES

@@ -147,11 +147,13 @@ def richtext_merge_doc(
         seq.content, mode="drop"
     )
 
-    # anchor char-positions (chars before the anchor in final order)
+    # anchor char-positions (chars before the anchor in final order).
+    # pair_end < 0 = end anchor deleted while the start lives: the host
+    # walk never pops the active entry, so the style runs to EOF
     ps = jnp.clip(cols.pair_start, 0, n - 1)
     pe = jnp.clip(cols.pair_end, 0, n - 1)
     a_start = jnp.where(cols.pair_valid, pos[ps], count)
-    a_end = jnp.where(cols.pair_valid, pos[pe], count)
+    a_end = jnp.where(cols.pair_valid & (cols.pair_end >= 0), pos[pe], count)
 
     bounds, win_value = _resolve_styles(
         cols.pair_valid,
@@ -209,10 +211,11 @@ def richtext_chain_merge_doc(
     codes = jnp.full(n, -1, jnp.int32).at[jnp.where(visible, pos_row, n)].set(
         ch.content, mode="drop"
     )
+    # pair_end < 0 = deleted end anchor -> style runs to EOF (host walk)
     ps = jnp.clip(cols.pair_start, 0, n - 1)
     pe = jnp.clip(cols.pair_end, 0, n - 1)
     a_start = jnp.where(cols.pair_valid, pos_row[ps], count)
-    a_end = jnp.where(cols.pair_valid, pos_row[pe], count)
+    a_end = jnp.where(cols.pair_valid & (cols.pair_end >= 0), pos_row[pe], count)
     bounds, win_value = _resolve_styles(
         cols.pair_valid,
         cols.pair_key,
@@ -230,6 +233,68 @@ def richtext_chain_merge_doc(
 @functools.partial(jax.jit, static_argnums=(1,))
 def richtext_chain_merge_batch(cols: RichtextChainCols, n_keys: int):
     return jax.vmap(lambda c: richtext_chain_merge_doc(c, n_keys))(cols)
+
+
+class RichtextPairs(NamedTuple):
+    """Anchor-pair table for the RESIDENT richtext path ([D, P] device
+    rows into a SeqColumnsU buffer; see DeviceDocBatch.richtexts)."""
+
+    start: jax.Array  # i32[P] device row of the start anchor
+    end: jax.Array
+    key: jax.Array  # i32[P] batch-uniform style-key index
+    value: jax.Array  # i32[P] per-doc value ordinal; -1 = null (unmark)
+    lamport: jax.Array
+    peer: jax.Array  # i32[P] per-doc peer rank (order-isomorphic to id)
+    valid: jax.Array
+
+
+def _richtext_by_key_doc(cols, key_hi, key_lo, pairs: RichtextPairs, n_keys: int):
+    """Resident richtext materialization: ONE stable multi-key sort by
+    the standing ShadowOrder keys realizes the text AND every row's
+    char-position (anchors are zero-width rows needing positions), then
+    styles resolve on the segment forest.  The incremental analog of
+    richtext_chain_merge_doc — no rank solve, order work happened on
+    ingest (O(delta))."""
+    n = cols.content.shape[0]
+    inf = jnp.uint32(0xFFFFFFFF)
+    hi = jnp.where(cols.valid, key_hi, inf)
+    lo = jnp.where(cols.valid, key_lo, inf)
+    visible = cols.valid & ~cols.deleted & (cols.content >= 0)
+    vis_i = visible.astype(jnp.int32)
+    row_idx = jnp.arange(n, dtype=jnp.int32)
+    _, _, vis_s, row_s, content_s = jax.lax.sort(
+        (hi, lo, vis_i, row_idx, cols.content), num_keys=2, is_stable=True
+    )
+    pos_s = jnp.cumsum(vis_s) - vis_s
+    count = vis_i.sum().astype(jnp.int32)
+    codes = jnp.full(n, -1, jnp.int32).at[jnp.where(vis_s == 1, pos_s, n)].set(
+        content_s, mode="drop"
+    )
+    pos_row = jnp.zeros(n, jnp.int32).at[row_s].set(pos_s)
+    # end < 0 = deleted end anchor -> style runs to EOF (host walk)
+    ps = jnp.clip(pairs.start, 0, n - 1)
+    pe = jnp.clip(pairs.end, 0, n - 1)
+    a_start = jnp.where(pairs.valid, pos_row[ps], count)
+    a_end = jnp.where(pairs.valid & (pairs.end >= 0), pos_row[pe], count)
+    bounds, win_value = _resolve_styles(
+        pairs.valid,
+        pairs.key,
+        pairs.value,
+        pairs.lamport,
+        pairs.peer,
+        a_start,
+        a_end,
+        count,
+        n_keys,
+    )
+    return codes, count, bounds, win_value
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def richtext_by_key_batch(cols, key_hi, key_lo, pairs: RichtextPairs, n_keys: int):
+    return jax.vmap(
+        lambda c, h, lo_, p: _richtext_by_key_doc(c, h, lo_, p, n_keys)
+    )(cols, key_hi, key_lo, pairs)
 
 
 def segments_from_device(codes, count, bounds, win, keys, values):
@@ -356,7 +421,10 @@ def _explode_richtext(changes, cid):
         valid=np.ones(n, bool),
         peers=peers_seen,
     )
-    # pairs: start anchor (p,c) + end anchor (p,c+1)
+    # pairs: start anchor (p,c) + end anchor (p,c+1).  Host-walk
+    # semantics (_iter_char_attrs): a pair is active iff its START
+    # anchor is live; a deleted END anchor never pops the active entry,
+    # so the style runs to EOF — encoded as end row -1
     pairs = []
     for (peer, ctr), a in anchors.items():
         if not a["start"]:
@@ -364,16 +432,15 @@ def _explode_richtext(changes, cid):
         end = anchors.get((peer, ctr + 1))
         if end is None or end["start"]:
             continue  # unpaired (mid-transfer); inactive
-        active = not a["deleted"] and not end["deleted"]
         pairs.append(
             (
                 inv[a["row"]],
-                inv[end["row"]],
+                -1 if end["deleted"] else inv[end["row"]],
                 a["key"],
                 a["value"],
                 a["lamport"],
                 a["peer"],
-                active,
+                not a["deleted"],
             )
         )
     pp = len(pairs)

@@ -474,3 +474,98 @@ class TestIncrementalOrder:
             f"per-sync ingest grew: early {sum(early):.4f}s late {sum(late):.4f}s"
         )
         assert batch.texts() == [t.to_string()]
+
+
+class TestResidentRichtext:
+    """richtexts(): resident style resolution on device vs the host
+    oracle (the incremental sibling of the one-shot richtext kernels)."""
+
+    def test_basic_marks(self):
+        doc = LoroDoc(peer=1)
+        cid = doc.get_text("t").id
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(0, 5, "bold", True)
+        t.mark(3, 8, "color", "red")
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=256)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        assert batch.richtexts() == [t.get_richtext_value()]
+
+    def test_incremental_marks_and_unmark(self):
+        doc = LoroDoc(peer=1)
+        cid = doc.get_text("t").id
+        t = doc.get_text("t")
+        t.insert(0, "abcdefgh")
+        t.mark(0, 6, "bold", True)
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=512)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        mark = doc.oplog_vv()
+        t.unmark(2, 4, "bold")
+        t.insert(3, "XY")  # inside the formerly-bold range
+        t.delete(0, 1)
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], cid)
+        assert batch.richtexts() == [t.get_richtext_value()]
+        assert batch.texts() == [t.to_string()]
+
+    def test_concurrent_multi_doc_epochs(self):
+        pairs = []
+        for i in range(3):
+            a, b = LoroDoc(peer=2 * i + 1), LoroDoc(peer=2 * i + 2)
+            a.get_text("t").insert(0, "the quick brown fox")
+            b.import_(a.export_updates(b.oplog_vv()))
+            pairs.append((a, b))
+        cid = pairs[0][0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=3, capacity=1024)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        # epoch 0: initial import of the shared base
+        batch.append_changes(
+            [a.oplog.changes_in_causal_order() for a, _ in pairs], cid
+        )
+        rng = random.Random(5)
+        for epoch in range(3):
+            for a, b in pairs:
+                for d in (a, b):
+                    t = d.get_text("t")
+                    L = len(t)
+                    r = rng.random()
+                    if L >= 2 and r < 0.5:
+                        s = rng.randrange(L - 1)
+                        e = rng.randint(s + 1, L)
+                        k = rng.choice(["bold", "color"])
+                        if rng.random() < 0.3:
+                            t.unmark(s, e, k)
+                        else:
+                            t.mark(s, e, k, rng.choice([True, "red", 7]))
+                    elif L > 4 and r < 0.7:
+                        p = rng.randrange(L - 1)
+                        t.delete(p, min(2, L - p))
+                    else:
+                        t.insert(rng.randint(0, L), rng.choice(["zz", "q"]))
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+            ups = []
+            for i, (a, _) in enumerate(pairs):
+                ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
+                marks[i] = a.oplog_vv()
+            batch.append_changes(ups, cid)
+            got = batch.richtexts()
+            for i, (a, _) in enumerate(pairs):
+                want = a.get_text("t").get_richtext_value()
+                assert got[i] == want, f"epoch {epoch} doc {i}:\n{got[i]}\nvs\n{want}"
+
+    def test_payload_ingest_with_marks(self):
+        from loro_tpu.doc import strip_envelope
+
+        doc = LoroDoc(peer=3)
+        cid = doc.get_text("t").id
+        t = doc.get_text("t")
+        t.insert(0, "styled text here")
+        t.mark(0, 6, "bold", True)
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=256)
+        batch.append_payloads([strip_envelope(doc.export_updates(None))], cid)
+        assert batch.richtexts() == [t.get_richtext_value()]

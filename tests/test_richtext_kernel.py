@@ -290,3 +290,63 @@ class TestRichtextChainKernel:
         host = docs[0].get_text("t").get_richtext_value()
         assert docs[1].get_text("t").get_richtext_value() == host
         assert _device_richtext_chain(docs[0]) == host, f"seed {seed}"
+
+
+class TestHalfDeletedPair:
+    """A deleted END anchor with a live START must style to end of
+    document — the host walk never pops the active entry
+    (text_state._iter_char_attrs); every device path must match."""
+
+    def test_host_and_device_paths_agree(self):
+        from loro_tpu.core.change import Change, Op, SeqDelete, SeqInsert, StyleAnchor
+        from loro_tpu.core.ids import ID, IdSpan
+        from loro_tpu.doc import EncodeMode
+        from loro_tpu.parallel.fleet import DeviceDocBatch, Fleet
+        from loro_tpu.parallel.mesh import make_mesh
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "hello world")
+        t.mark(2, 6, "bold", True)
+        doc.commit()
+        end_id = None
+        for ch in doc.oplog.changes_in_causal_order():
+            for op in ch.ops:
+                c = op.content
+                if isinstance(c, SeqInsert) and isinstance(c.content, StyleAnchor):
+                    if not c.content.is_start:
+                        end_id = (ch.peer, op.counter)
+        assert end_id is not None
+        kill_end = Change(
+            id=ID(2, 0),
+            lamport=100,
+            deps=doc.oplog_frontiers(),
+            ops=[
+                Op(
+                    counter=0,
+                    container=t.id,
+                    content=SeqDelete(
+                        spans=(IdSpan(end_id[0], end_id[1], end_id[1] + 1),)
+                    ),
+                )
+            ],
+        )
+        # ship it through the public wire (enveloped columnar updates)
+        blob = doc._encode_changes([kill_end], EncodeMode.ColumnarUpdates)
+        doc.import_(blob)
+        host = t.get_richtext_value()
+        # style must now run from position 2 to EOF
+        assert host == [
+            {"insert": "he"},
+            {"insert": "llo world", "attributes": {"bold": True}},
+        ], host
+        changes = doc.oplog.changes_in_causal_order()
+        # one-shot fleet path (chain kernel)
+        fleet = Fleet(make_mesh())
+        assert fleet.merge_richtext_changes([changes], t.id) == [host]
+        # element-level kernel path
+        assert _device_richtext(doc) == host
+        # resident path
+        batch = DeviceDocBatch(n_docs=1, capacity=256)
+        batch.append_changes([changes], t.id)
+        assert batch.richtexts() == [host]

@@ -95,6 +95,59 @@ def tree_merge_batch(
     return jax.vmap(lambda c: tree_merge_doc(c, n_nodes, d_max))(cols)
 
 
+class TreeLogCols(NamedTuple):
+    """[M] UNSORTED device-resident move log (append order; the
+    resident path's buffer — DeviceTreeBatch).  Peers ship as u64
+    halves; the global move key (lamport, peer, counter) is sorted on
+    device at materialization."""
+
+    lamport: jax.Array  # i32[M]
+    peer_hi: jax.Array  # u32[M]
+    peer_lo: jax.Array  # u32[M]
+    counter: jax.Array  # i32[M]
+    target: jax.Array  # i32[M] node ordinal
+    parent: jax.Array  # i32[M] node ordinal, ROOT, or TRASH
+    valid: jax.Array  # bool[M]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def tree_replay_log_batch(
+    cols: TreeLogCols, n_nodes: int, d_max: Optional[int] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Sort each doc's standing move log by the global move key and
+    replay the scan.  Returns ([D, n_nodes] parents, [D, M] effected in
+    ROW (append) order — the host resolves sibling positions from the
+    last effected non-delete move per node in key order)."""
+
+    def per_doc(c: TreeLogCols):
+        m = c.lamport.shape[0]
+        big = jnp.int32(2**31 - 1)
+        lam = jnp.where(c.valid, c.lamport, big)  # pads sort last
+        row_idx = jnp.arange(m, dtype=jnp.int32)
+        _, _, _, _, t_s, p_s, v_s, row_s = jax.lax.sort(
+            (
+                lam,
+                c.peer_hi,
+                c.peer_lo,
+                c.counter,
+                c.target,
+                c.parent,
+                c.valid.astype(jnp.int32),
+                row_idx,
+            ),
+            num_keys=4,
+        )
+        parents, eff = tree_merge_doc(
+            TreeOpCols(target=t_s, parent=p_s, valid=v_s.astype(bool)),
+            n_nodes,
+            d_max,
+        )
+        eff_rows = jnp.zeros(m, bool).at[row_s].set(eff)
+        return parents, eff_rows
+
+    return jax.vmap(per_doc)(cols)
+
+
 def is_deleted_batch(parents: jax.Array) -> jax.Array:
     """bool[D, N]: node is trash-reachable (pointer-doubling ancestor
     resolution — log-depth, fully parallel)."""

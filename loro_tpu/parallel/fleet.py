@@ -1349,24 +1349,258 @@ class DeviceMapBatch:
         return out
 
 
+class DeviceTreeBatch:
+    """Device-resident movable-tree move logs for a doc batch (the tree
+    member of the resident family next to DeviceDocBatch/DeviceMapBatch).
+
+    Appends ship only NEW moves (one block scatter); materialization
+    sorts each standing log by the global move key (lamport, peer,
+    counter) on device and replays the cycle-checked scan
+    (ops/tree_batch.tree_replay_log_batch).  Unlike LWW folds, tree
+    moves do not commute — a late-arriving concurrent move with a lower
+    lamport must replay BEFORE already-applied moves — so the resident
+    state is the log, not the folded parents (the reference's
+    TreeCacheForDiff keeps the same per-node move sets and re-walks
+    them, diff_calc/tree.rs:230-396)."""
+
+    def __init__(self, n_docs: int, move_capacity: int, node_capacity: int, mesh=None):
+        from ..ops.tree_batch import ROOT, TreeLogCols
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_docs = n_docs
+        self.d = _mesh_pad(self.mesh, n_docs)
+        self.cap = move_capacity
+        self.node_cap = node_capacity
+        self.counts = np.zeros(self.d, np.int64)
+        # per-doc node dictionaries + host move metadata for sibling
+        # positions: (lamport, peer, counter, target_ord, is_delete, pos)
+        self.node_ids: List[Dict] = [dict() for _ in range(self.d)]
+        self.nodes: List[list] = [[] for _ in range(self.d)]
+        self.move_meta: List[list] = [[] for _ in range(self.d)]
+        sh = doc_sharding(self.mesh)
+        z = lambda dt, fill: jax.device_put(np.full((self.d, move_capacity), fill, dt), sh)
+        self.cols = TreeLogCols(
+            lamport=z(np.int32, 0),
+            peer_hi=z(np.uint32, 0),
+            peer_lo=z(np.uint32, 0),
+            counter=z(np.int32, 0),
+            target=z(np.int32, 0),
+            parent=z(np.int32, ROOT),
+            valid=z(bool, False),
+        )
+
+    def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
+        """Incremental ingest: each doc's new causally-ordered changes
+        (None = no update); TreeMove ops become appended log rows.  All
+        node registration and rows are STAGED before any validation, so
+        a capacity error leaves the batch untouched (the DeviceDocBatch
+        atomicity contract)."""
+        from ..core.change import TreeMove
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.tree_batch import ROOT, TRASH
+
+        per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
+        rows_per_doc: List[list] = []
+        staged_nodes: List[list] = []
+        for di, changes in enumerate(per_doc_changes):
+            rows: list = []
+            staged: Dict = {}
+            staged_order: list = []
+            rows_per_doc.append(rows)
+            staged_nodes.append(staged_order)
+            if not changes:
+                continue
+            ids = self.node_ids[di]
+            n_committed = len(self.nodes[di])
+
+            def node_idx(tid):
+                i = ids.get(tid)
+                if i is None:
+                    i = staged.get(tid)
+                if i is None:
+                    i = n_committed + len(staged_order)
+                    staged[tid] = i
+                    staged_order.append(tid)
+                return i
+
+            for ch in changes:
+                for op in ch.ops:
+                    if op.container != cid or not isinstance(op.content, TreeMove):
+                        continue
+                    c = op.content
+                    lam = ch.lamport + (op.counter - ch.ctr_start)
+                    t = node_idx(c.target)
+                    if c.is_delete:
+                        p = TRASH
+                    elif c.parent is None:
+                        p = ROOT
+                    else:
+                        p = node_idx(c.parent)
+                    rows.append((lam, ch.peer, op.counter, t, p, c.is_delete, c.position))
+        max_new = (
+            pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16)
+            if any(rows_per_doc)
+            else 0
+        )
+        # validate BEFORE mutating anything
+        for di, rows in enumerate(rows_per_doc):
+            if rows and int(self.counts[di]) + max_new > self.cap:
+                raise RuntimeError(
+                    f"DeviceTreeBatch move capacity exceeded for doc {di}: "
+                    f"{self.counts[di]} + {max_new} > {self.cap}"
+                )
+            if len(self.nodes[di]) + len(staged_nodes[di]) > self.node_cap:
+                raise RuntimeError(
+                    f"DeviceTreeBatch node capacity exceeded for doc {di}: "
+                    f"{len(self.nodes[di])} + {len(staged_nodes[di])} > {self.node_cap}"
+                )
+        if not max_new:
+            return
+        # commit staged node registrations
+        for di, staged_order in enumerate(staged_nodes):
+            for tid in staged_order:
+                self.node_ids[di][tid] = len(self.nodes[di])
+                self.nodes[di].append(tid)
+        blk_shape = (self.d, max_new)
+        blk = {
+            "lamport": np.zeros(blk_shape, np.int32),
+            "peer_hi": np.zeros(blk_shape, np.uint32),
+            "peer_lo": np.zeros(blk_shape, np.uint32),
+            "counter": np.zeros(blk_shape, np.int32),
+            "target": np.zeros(blk_shape, np.int32),
+            "parent": np.full(blk_shape, ROOT, np.int32),
+            "valid": np.zeros(blk_shape, bool),
+        }
+        offsets = np.zeros(self.d, np.int32)
+        for di, rows in enumerate(rows_per_doc):
+            if not rows:
+                continue
+            k = len(rows)
+            arr = np.asarray([(r[0], r[2], r[3], r[4]) for r in rows], np.int64)
+            pu = np.asarray([r[1] for r in rows], np.uint64)
+            blk["lamport"][di, :k] = arr[:, 0]
+            blk["peer_hi"][di, :k] = (pu >> np.uint64(32)).astype(np.uint32)
+            blk["peer_lo"][di, :k] = (pu & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            blk["counter"][di, :k] = arr[:, 1]
+            blk["target"][di, :k] = arr[:, 2]
+            blk["parent"][di, :k] = arr[:, 3]
+            blk["valid"][di, :k] = True
+            offsets[di] = int(self.counts[di])
+            self.counts[di] += k
+            self.move_meta[di].extend(
+                (r[0], r[1], r[2], r[3], r[5], r[6]) for r in rows
+            )
+        sh = doc_sharding(self.mesh)
+        self.cols = _scatter_tree_rows(
+            self.cols,
+            {f: jax.device_put(v, sh) for f, v in blk.items()},
+            jax.device_put(offsets, replicated(self.mesh)),
+        )
+
+    def _replay(self):
+        from ..ops.tree_batch import tree_replay_log_batch
+
+        return tree_replay_log_batch(self.cols, self.node_cap)
+
+    def parent_maps(self) -> List[dict]:
+        """{TreeID: parent TreeID | None} of alive nodes per doc (one
+        launch; same contract as Fleet.merge_tree_changes)."""
+        from ..ops.tree_batch import ABSENT, ROOT, is_deleted_batch
+
+        parents, _eff = self._replay()
+        deleted = np.asarray(is_deleted_batch(parents))
+        parents = np.asarray(parents)
+        out = []
+        for di in range(self.n_docs):
+            res = {}
+            nodes = self.nodes[di]
+            for j, tid in enumerate(nodes):
+                p = int(parents[di, j])
+                if p == ABSENT or deleted[di, j]:
+                    continue
+                res[tid] = None if p == ROOT else nodes[p]
+            out.append(res)
+        return out
+
+    def children_maps(self) -> List[dict]:
+        """{parent | None: [children in (fractional-index, move-key)
+        order]} per doc — the materialized tree shape (same contract as
+        Fleet.merge_tree_children)."""
+        from ..ops.tree_batch import ABSENT, ROOT, is_deleted_batch
+
+        parents, eff = self._replay()
+        deleted = np.asarray(is_deleted_batch(parents))
+        parents = np.asarray(parents)
+        eff = np.asarray(eff)
+        out = []
+        for di in range(self.n_docs):
+            nodes = self.nodes[di]
+            # winning position = last effected non-delete move per node
+            # in key order; sibling tiebreak = the winning move's key
+            # order (exactly merge_tree_children's host walk)
+            meta = self.move_meta[di]
+            order = sorted(range(len(meta)), key=lambda i: meta[i][:3])
+            pos: Dict[int, object] = {}
+            last_eff: Dict[int, int] = {}
+            for oi, i in enumerate(order):
+                _lam, _peer, _ctr, t, is_del, p_ = meta[i]
+                if eff[di, i]:
+                    last_eff[t] = oi
+                    if not is_del:
+                        pos[t] = p_
+            kids: Dict = {}
+            for j, tid in enumerate(nodes):
+                p = int(parents[di, j])
+                if p == ABSENT or deleted[di, j]:
+                    continue
+                key = None if p == ROOT else nodes[p]
+                kids.setdefault(key, []).append(
+                    (pos.get(j) or b"", last_eff.get(j, 0), tid)
+                )
+            out.append(
+                {
+                    k: [t for _, _, t in sorted(v, key=lambda x: (x[0], x[1]))]
+                    for k, v in kids.items()
+                }
+            )
+        return out
+
+
+def _windowed_scatter_field(col, nbl, vbl, off):
+    """One doc-row of the block scatter: padding rows of a block restore
+    the window's previous values so short updates don't clobber
+    neighbors (shared by the seq and tree resident ingest paths)."""
+    window = jax.lax.dynamic_slice(col, (off,), (nbl.shape[0],))
+    return jax.lax.dynamic_update_slice(col, jnp.where(vbl, nbl, window), (off,))
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows(state, blk, offsets):
     """Write each doc's new-row block at its per-doc offset (donated
-    update — the old buffer is reused, no [D, N] copy).  Padding rows of
-    a block restore the window's previous values so short updates don't
-    clobber neighbors.  `state` is (SeqColumnsU, key_hi, key_lo)."""
+    update — the old buffer is reused, no [D, N] copy).  `state` is
+    (SeqColumnsU, key_hi, key_lo)."""
     cols, key_hi, key_lo = state
-
-    def per_field(col, nbl, vbl, off):
-        window = jax.lax.dynamic_slice(col, (off,), (nbl.shape[0],))
-        return jax.lax.dynamic_update_slice(col, jnp.where(vbl, nbl, window), (off,))
-
     out = {}
     for f in cols._fields:
-        out[f] = jax.vmap(per_field)(getattr(cols, f), blk[f], blk["valid"], offsets)
-    new_hi = jax.vmap(per_field)(key_hi, blk["key_hi"], blk["valid"], offsets)
-    new_lo = jax.vmap(per_field)(key_lo, blk["key_lo"], blk["valid"], offsets)
+        out[f] = jax.vmap(_windowed_scatter_field)(
+            getattr(cols, f), blk[f], blk["valid"], offsets
+        )
+    new_hi = jax.vmap(_windowed_scatter_field)(key_hi, blk["key_hi"], blk["valid"], offsets)
+    new_lo = jax.vmap(_windowed_scatter_field)(key_lo, blk["key_lo"], blk["valid"], offsets)
     return type(cols)(**out), new_hi, new_lo
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_tree_rows(cols, blk, offsets):
+    """Tree-log variant of _scatter_rows (shared window semantics via
+    _windowed_scatter_field)."""
+    out = {
+        f: jax.vmap(_windowed_scatter_field)(
+            getattr(cols, f), blk[f], blk["valid"], offsets
+        )
+        for f in cols._fields
+    }
+    return type(cols)(**out)
 
 
 @functools.lru_cache(maxsize=32)

@@ -569,3 +569,156 @@ class TestResidentRichtext:
         batch = DeviceDocBatch(n_docs=1, capacity=256)
         batch.append_payloads([strip_envelope(doc.export_updates(None))], cid)
         assert batch.richtexts() == [t.get_richtext_value()]
+
+
+class TestDeviceTreeBatch:
+    """Resident movable-tree logs: incremental appends + device replay
+    vs host TreeState and the one-shot fleet path."""
+
+    def test_initial_plus_incremental(self):
+        from loro_tpu.parallel.fleet import DeviceTreeBatch
+
+        doc = LoroDoc(peer=1)
+        tr = doc.get_tree("tr")
+        a = tr.create()
+        b = tr.create(a)
+        c = tr.create(b)
+        doc.commit()
+        cid = tr.id
+        batch = DeviceTreeBatch(n_docs=1, move_capacity=256, node_capacity=64)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        mark = doc.oplog_vv()
+        tr.move(c, a)
+        tr.delete(b)
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], cid)
+        host = {t: tr.parent(t) for t in tr.nodes()}
+        assert batch.parent_maps() == [host]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_incremental_fuzz_concurrent(self, seed):
+        from loro_tpu.parallel.fleet import DeviceTreeBatch
+
+        rng = random.Random(seed)
+        pairs = []
+        for i in range(3):
+            a = LoroDoc(peer=2 * i + 1)
+            b = LoroDoc(peer=2 * i + 2)
+            tr = a.get_tree("tr")
+            root = tr.create()
+            for _ in range(3):
+                tr.create(root)
+            b.import_(a.export_snapshot())
+            pairs.append((a, b))
+        cid = pairs[0][0].get_tree("tr").id
+        batch = DeviceTreeBatch(n_docs=3, move_capacity=1024, node_capacity=128)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        batch.append_changes(
+            [a.oplog.changes_in_causal_order() for a, _ in pairs], cid
+        )
+        for epoch in range(4):
+            for a, b in pairs:
+                for d in (a, b):
+                    tr = d.get_tree("tr")
+                    nodes = [t for t in tr.nodes()]
+                    r = rng.random()
+                    if not nodes or r < 0.3:
+                        tr.create(rng.choice(nodes) if nodes and rng.random() < 0.7 else None)
+                    elif r < 0.6 and len(nodes) >= 2:
+                        t1, t2 = rng.sample(nodes, 2)
+                        try:
+                            tr.move(t1, t2, rng.randint(0, 1))
+                        except Exception:
+                            pass  # cycle rejected locally
+                    elif r < 0.75:
+                        tr.delete(rng.choice(nodes))
+                    else:
+                        tr.create(rng.choice(nodes), index=0)
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+                assert a.get_deep_value() == b.get_deep_value()
+            ups = []
+            for i, (a, _) in enumerate(pairs):
+                ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
+                marks[i] = a.oplog_vv()
+            batch.append_changes(ups, cid)
+            got = batch.parent_maps()
+            for i, (a, _) in enumerate(pairs):
+                tr = a.get_tree("tr")
+                host = {t: tr.parent(t) for t in tr.nodes()}
+                assert got[i] == host, f"seed {seed} epoch {epoch} doc {i}"
+
+    def test_children_order_matches_host(self):
+        from loro_tpu.parallel.fleet import DeviceTreeBatch
+
+        docs = []
+        for i in range(2):
+            a, b = LoroDoc(peer=700 + 2 * i), LoroDoc(peer=701 + 2 * i)
+            tr = a.get_tree("tr")
+            root = tr.create()
+            kids = [tr.create(root) for _ in range(3)]
+            b.import_(a.export_snapshot())
+            a.get_tree("tr").move(kids[2], root, 0)
+            b.get_tree("tr").create(root, index=1)
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+            a.commit()
+            docs.append(a)
+        cid = docs[0].get_tree("tr").id
+        batch = DeviceTreeBatch(n_docs=2, move_capacity=256, node_capacity=64)
+        batch.append_changes([d.oplog.changes_in_causal_order() for d in docs], cid)
+        got = batch.children_maps()
+        for i, d in enumerate(docs):
+            tr = d.get_tree("tr")
+            host = {}
+            for t in [None] + tr.nodes():
+                ch = tr.children(t)
+                if ch:
+                    host[t] = ch
+            assert got[i] == host, f"doc {i}"
+
+    def test_capacity_guards(self):
+        from loro_tpu.parallel.fleet import DeviceTreeBatch
+
+        doc = LoroDoc(peer=1)
+        tr = doc.get_tree("tr")
+        for _ in range(10):
+            tr.create()
+        doc.commit()
+        batch = DeviceTreeBatch(n_docs=1, move_capacity=8, node_capacity=64)
+        with pytest.raises(RuntimeError, match="move capacity"):
+            batch.append_changes([doc.oplog.changes_in_causal_order()], tr.id)
+        batch2 = DeviceTreeBatch(n_docs=1, move_capacity=64, node_capacity=4)
+        with pytest.raises(RuntimeError, match="node capacity"):
+            batch2.append_changes([doc.oplog.changes_in_causal_order()], tr.id)
+
+    def test_failed_append_leaves_batch_untouched(self):
+        from loro_tpu.parallel.fleet import DeviceTreeBatch
+
+        doc = LoroDoc(peer=1)
+        tr = doc.get_tree("tr")
+        r = tr.create()
+        tr.create(r)
+        doc.commit()
+        batch = DeviceTreeBatch(n_docs=1, move_capacity=64, node_capacity=64)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], tr.id)
+        before_nodes = list(batch.nodes[0])
+        before_counts = batch.counts.copy()
+        # an over-capacity epoch must not leak phantom node registrations
+        doc2 = LoroDoc(peer=2)
+        tr2 = doc2.get_tree("tr")
+        for _ in range(80):
+            tr2.create()
+        doc2.commit()
+        with pytest.raises(RuntimeError):
+            batch.append_changes([doc2.oplog.changes_in_causal_order()], tr.id)
+        assert batch.nodes[0] == before_nodes
+        assert (batch.counts == before_counts).all()
+        # the batch stays fully usable
+        mark = doc.oplog_vv()
+        tr.delete(r)
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], tr.id)
+        host = {t: tr.parent(t) for t in tr.nodes()}
+        assert batch.parent_maps() == [host]

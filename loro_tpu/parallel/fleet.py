@@ -1590,6 +1590,100 @@ def _scatter_rows(state, blk, offsets):
     return type(cols)(**out), new_hi, new_lo
 
 
+class DeviceCounterBatch:
+    """Device-resident counter sums for a doc batch (increments are
+    commutative, so the resident state IS the fold — one donated
+    scatter-add per append, the cheapest member of the resident
+    family).
+
+    Precision contract: device sums are float32 (x64 is disabled on the
+    TPU path; same contract as the one-shot merge_counter_changes), so
+    values match the host's f64 CounterState exactly for integer-valued
+    deltas up to 2^24 and to f32 rounding otherwise."""
+
+    def __init__(self, n_docs: int, slot_capacity: int, mesh=None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_docs = n_docs
+        self.d = _mesh_pad(self.mesh, n_docs)
+        self.s = slot_capacity
+        self.slot_of: List[Dict[ContainerID, int]] = [dict() for _ in range(self.d)]
+        self.sums = jax.device_put(
+            np.zeros((self.d, self.s), np.float32), doc_sharding(self.mesh)
+        )
+
+    def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]]) -> None:
+        from ..core.change import CounterIncr
+        from ..ops.fugue_batch import pad_bucket
+
+        per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
+        rows_per_doc: List[list] = []
+        staged_slots: List[list] = []
+        for di, changes in enumerate(per_doc_changes):
+            rows: list = []
+            staged: Dict = {}
+            order: list = []
+            rows_per_doc.append(rows)
+            staged_slots.append(order)
+            if not changes:
+                continue
+            slots = self.slot_of[di]
+
+            def slot_idx(cid):
+                i = slots.get(cid)
+                if i is None:
+                    i = staged.get(cid)
+                if i is None:
+                    i = len(slots) + len(order)
+                    staged[cid] = i
+                    order.append(cid)
+                return i
+
+            for ch in changes:
+                for op in ch.ops:
+                    if isinstance(op.content, CounterIncr):
+                        rows.append((slot_idx(op.container), float(op.content.delta)))
+        for di in range(self.d):
+            if len(self.slot_of[di]) + len(staged_slots[di]) > self.s:
+                raise RuntimeError(
+                    f"DeviceCounterBatch slot capacity exceeded for doc {di}"
+                )
+        if not any(rows_per_doc):
+            return
+        for di, order in enumerate(staged_slots):
+            for cid in order:
+                self.slot_of[di][cid] = len(self.slot_of[di])
+        m = pad_bucket(max(len(r) for r in rows_per_doc), floor=16)
+        slot = np.full((self.d, m), self.s, np.int32)  # dump slot
+        delta = np.zeros((self.d, m), np.float32)
+        for di, rows in enumerate(rows_per_doc):
+            for i, (s_, dl) in enumerate(rows):
+                slot[di, i] = s_
+                delta[di, i] = dl
+        sh = doc_sharding(self.mesh)
+        self.sums = _fold_counter_rows(
+            self.sums, jax.device_put(slot, sh), jax.device_put(delta, sh)
+        )
+
+    def value_maps(self) -> List[Dict[ContainerID, float]]:
+        sums = np.asarray(self.sums)
+        return [
+            {cid: float(sums[di, s_]) for cid, s_ in self.slot_of[di].items()}
+            for di in range(self.n_docs)
+        ]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _fold_counter_rows(sums, slot, delta):
+    from ..ops.lww import counter_merge_doc
+
+    def per_doc(acc, s_, dl):
+        # one canonical counter-sum kernel (rows with slot >= S are the
+        # padding the dump slot swallows)
+        return acc + counter_merge_doc(s_, dl, s_ < acc.shape[0], acc.shape[0])
+
+    return jax.vmap(per_doc)(sums, slot, delta)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_tree_rows(cols, blk, offsets):
     """Tree-log variant of _scatter_rows (shared window semantics via

@@ -204,6 +204,37 @@ def extract_movable(changes, cid):
     return cols, elems, values
 
 
+@jax.jit
+def movable_by_key_batch(valid, deleted, key_hi, key_lo, win_row, win_lam, val_idx):
+    """RESIDENT materialization (DeviceMovableBatch): element-level
+    output from standing state — per element, the move-winner's slot
+    row (LWW fold) carries the element's standing ShadowOrder key and
+    tombstone; ONE [E]-sized sort realizes the list (E elements, not S
+    slots).  Returns (value ordinals i32[D, E] padded -1, counts).
+
+    valid/deleted/key_hi/key_lo: [D, S] slot-buffer columns;
+    win_row/win_lam: [D, E] move-winner fold (row index, lamport;
+    win_lam == NEG means the element was never placed);
+    val_idx: [D, E] value-winner fold (value ordinals)."""
+
+    def per_doc(v, dl, kh, kl, wrow, wlam, vidx):
+        s = v.shape[0]
+        e_cap = wrow.shape[0]
+        row = jnp.clip(wrow, 0, s - 1)
+        alive = (wlam > NEG) & v[row] & ~dl[row]
+        ekh = jnp.where(alive, kh[row], jnp.uint32(0xFFFFFFFF))
+        ekl = jnp.where(alive, kl[row], jnp.uint32(0xFFFFFFFF))
+        alive_i = alive.astype(jnp.int32)
+        _, _, vis_s, vid_s = jax.lax.sort((ekh, ekl, alive_i, vidx), num_keys=2)
+        pos = jnp.cumsum(vis_s) - vis_s
+        out = jnp.full(e_cap, -1, jnp.int32).at[
+            jnp.where(vis_s == 1, pos, e_cap)
+        ].set(vid_s, mode="drop")
+        return out, alive_i.sum()
+
+    return jax.vmap(per_doc)(valid, deleted, key_hi, key_lo, win_row, win_lam, val_idx)
+
+
 class LazyPayloadValue:
     """Undecoded value: payload bytes + offset (decoded only if it wins
     the set-LWW — mirrors the map batch's lazy cells)."""

@@ -1590,6 +1590,225 @@ def _scatter_rows(state, blk, offsets):
     return type(cols)(**out), new_hi, new_lo
 
 
+class DeviceMovableBatch:
+    """Device-resident MovableList state for a doc batch — the last
+    member of the resident family.
+
+    Decomposition (reference semantics diff_calc.rs:1669-2020): position
+    SLOTS are sequence elements (they ride an internal DeviceDocBatch:
+    standing ShadowOrder keys, O(delta) ingest, tombstones); per element
+    the winning slot (last move by (lamport, peer)) and winning value
+    (last set) are LWW — both kept as RESIDENT folds (LwwResident with
+    the slot ROW / value ordinal as the folded value).  Materialization
+    is ONE [E]-sized sort: each element gathers its winning slot's
+    standing key + tombstone (a tombstoned winner hides the element; a
+    newer concurrent move revives it), no slot-level re-rank."""
+
+    def __init__(self, n_docs: int, capacity: int, elem_capacity: int, mesh=None):
+        from ..ops.lww import NEG, LwwResident
+
+        self.seq = DeviceDocBatch(n_docs, capacity, mesh=mesh, as_text=False)
+        self.mesh = self.seq.mesh
+        self.n_docs = n_docs
+        self.d = self.seq.d
+        self.e_cap = elem_capacity
+        self.elem_ids: List[Dict] = [dict() for _ in range(self.d)]
+        self.values: List[list] = [[] for _ in range(self.d)]
+        sh = doc_sharding(self.mesh)
+        z = lambda dt, fill: jax.device_put(np.full((self.d, elem_capacity), fill, dt), sh)
+        mk = lambda vfill: LwwResident(
+            lamport=z(np.int32, int(NEG)),
+            peer_hi=z(np.uint32, 0),
+            peer_lo=z(np.uint32, 0),
+            value=z(np.int32, vfill),
+        )
+        self.moves = mk(0)  # value = winning slot ROW in the seq buffer
+        self.vals = mk(-2)  # value = winning value ordinal
+
+    def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
+        """Incremental ingest: slots append into the internal seq batch
+        (one block scatter), element winners fold (two donated LWW
+        updates).  Staged before validation — capacity errors leave the
+        batch untouched."""
+        from ..core.change import MovableMove, MovableSet, SeqDelete, SeqInsert
+        from ..oplog.oplog import _RunCont
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.lww import lww_update_resident
+
+        # NOTE: the SeqInsert/SeqDelete arms below intentionally mirror
+        # DeviceDocBatch._python_rows (same parent-resolution and
+        # delete-span contract) but diverge in what they PRODUCE per row
+        # (element ordinals + move/set fold rows vs content codes) — a
+        # shared walk would need per-row callbacks for every arm; the
+        # differential fuzzers pin both walks to the host engine.
+        per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
+        rows_per_doc: List[list] = []
+        overlays: List[Dict[Tuple[int, int], int]] = []
+        move_rows: List[list] = []  # (elem, lam, peer, slot_row)
+        set_rows: List[list] = []  # (elem, lam, peer, value_ordinal)
+        staged_elems: List[list] = []
+        staged_vals: List[list] = []
+        del_pairs: List[Tuple[int, int]] = []
+        for di, changes in enumerate(per_doc_changes):
+            rows: list = []
+            overlay: Dict[Tuple[int, int], int] = {}
+            mrows: list = []
+            srows: list = []
+            e_staged: Dict = {}
+            e_order: list = []
+            v_staged: list = []
+            rows_per_doc.append(rows)
+            overlays.append(overlay)
+            move_rows.append(mrows)
+            set_rows.append(srows)
+            staged_elems.append(e_order)
+            staged_vals.append(v_staged)
+            if not changes:
+                continue
+            idmap = self.seq.id2row[di]
+            base = int(self.seq.counts[di])
+            eids = self.elem_ids[di]
+            n_vals = len(self.values[di])
+
+            def eidx(eid):
+                i = eids.get(eid)
+                if i is None:
+                    i = e_staged.get(eid)
+                if i is None:
+                    i = len(eids) + len(e_order)
+                    e_staged[eid] = i
+                    e_order.append(eid)
+                return i
+
+            def vidx(v):
+                v_staged.append(v)
+                return n_vals + len(v_staged) - 1
+
+            def resolve(key):
+                r = overlay.get(key)
+                return idmap[key] if r is None else r
+
+            for ch in changes:
+                for op in ch.ops:
+                    if op.container != cid:
+                        continue
+                    c = op.content
+                    lam = ch.lamport + (op.counter - ch.ctr_start)
+                    if isinstance(c, SeqInsert):
+                        body = c.content
+                        for j in range(len(body)):
+                            if j == 0:
+                                if isinstance(c.parent, _RunCont):
+                                    prow = resolve((ch.peer, op.counter - 1))
+                                elif c.parent is None:
+                                    prow = -1
+                                else:
+                                    prow = resolve((c.parent.peer, c.parent.counter))
+                                side = int(c.side)
+                            else:
+                                prow = base + len(rows) - 1
+                                side = 1
+                            row = base + len(rows)
+                            eid = (ch.peer, op.counter + j)
+                            ei = eidx(eid)
+                            overlay[eid] = row
+                            rows.append((prow, side, op.counter + j, ei, ch.peer))
+                            mrows.append((ei, lam + j, ch.peer, row))
+                            srows.append((ei, lam + j, ch.peer, vidx(body[j])))
+                    elif isinstance(c, MovableMove):
+                        if isinstance(c.parent, _RunCont):
+                            prow = resolve((ch.peer, op.counter - 1))
+                        elif c.parent is None:
+                            prow = -1
+                        else:
+                            prow = resolve((c.parent.peer, c.parent.counter))
+                        row = base + len(rows)
+                        ei = eidx((c.elem.peer, c.elem.counter))
+                        overlay[(ch.peer, op.counter)] = row
+                        rows.append((prow, int(c.side), op.counter, ei, ch.peer))
+                        mrows.append((ei, lam, ch.peer, row))
+                    elif isinstance(c, MovableSet):
+                        ei = eidx((c.elem.peer, c.elem.counter))
+                        srows.append((ei, lam, ch.peer, vidx(c.value)))
+                    elif isinstance(c, SeqDelete):
+                        for sp in c.spans:
+                            for ctr in range(sp.start, sp.end):
+                                try:
+                                    del_pairs.append((di, resolve((sp.peer, ctr))))
+                                except KeyError:
+                                    pass  # outside this batch's history
+        # validate BEFORE mutating (element capacity; the seq batch
+        # validates row capacity in _commit_rows before ITS mutation)
+        for di in range(self.d):
+            if len(self.elem_ids[di]) + len(staged_elems[di]) > self.e_cap:
+                raise RuntimeError(
+                    f"DeviceMovableBatch element capacity exceeded for doc {di}"
+                )
+        self.seq._commit_rows(rows_per_doc, overlays, del_pairs)
+        # commit staged element/value registrations
+        for di in range(self.d):
+            for eid in staged_elems[di]:
+                self.elem_ids[di][eid] = len(self.elem_ids[di])
+            self.values[di].extend(staged_vals[di])
+        # fold element winners (moves then values)
+        sh = doc_sharding(self.mesh)
+        put = lambda a: jax.device_put(a, sh)
+        for rows_set, res_name in ((move_rows, "moves"), (set_rows, "vals")):
+            if not any(rows_set):
+                continue
+            m = pad_bucket(max(len(r) for r in rows_set), floor=16)
+            shp = (self.d, m)
+            elem = np.full(shp, self.e_cap, np.int32)
+            lam = np.zeros(shp, np.int32)
+            hi = np.zeros(shp, np.uint32)
+            lo = np.zeros(shp, np.uint32)
+            val = np.full(shp, -2, np.int32)
+            valid = np.zeros(shp, bool)
+            for di, rws in enumerate(rows_set):
+                for i, (ei, lm, peer, v) in enumerate(rws):
+                    elem[di, i] = ei
+                    lam[di, i] = lm
+                    hi[di, i] = peer >> 32
+                    lo[di, i] = peer & 0xFFFFFFFF
+                    val[di, i] = v
+                    valid[di, i] = True
+            setattr(
+                self,
+                res_name,
+                lww_update_resident(
+                    getattr(self, res_name),
+                    put(elem),
+                    put(lam),
+                    put(hi),
+                    put(lo),
+                    put(valid),
+                    self.e_cap,
+                    value=put(val),
+                ),
+            )
+
+    def value_lists(self) -> List[list]:
+        """Materialize every doc's ordered element values (one launch;
+        same contract as Fleet.merge_movable_changes per doc)."""
+        from ..ops.movable_batch import movable_by_key_batch
+
+        out_idx, counts = movable_by_key_batch(
+            self.seq.cols.valid,
+            self.seq.cols.deleted,
+            self.seq.key_hi,
+            self.seq.key_lo,
+            self.moves.value,
+            self.moves.lamport,
+            self.vals.value,
+        )
+        out_idx = np.asarray(out_idx)
+        counts = np.asarray(counts)
+        return [
+            [self.values[di][j] for j in out_idx[di, : counts[di]]]
+            for di in range(self.n_docs)
+        ]
+
+
 class DeviceCounterBatch:
     """Device-resident counter sums for a doc batch (increments are
     commutative, so the resident state IS the fold — one donated

@@ -827,3 +827,87 @@ class TestDeviceCounterBatch:
         batch.append_changes([d.oplog.changes_in_causal_order()])
         got = batch.value_maps()[0][d.get_counter("c").id]
         assert got == pytest.approx(d.get_counter("c").get_value(), rel=1e-6)
+
+
+class TestDeviceMovableBatch:
+    """Resident MovableList: incremental slots + element LWW folds vs
+    the host MovableListState."""
+
+    def test_initial_plus_incremental(self):
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("m")
+        ml.push("a", "b", "c")
+        doc.commit()
+        cid = ml.id
+        batch = DeviceMovableBatch(n_docs=1, capacity=256, elem_capacity=64)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], cid)
+        assert batch.value_lists() == [ml.get_value()]
+        mark = doc.oplog_vv()
+        ml.move(2, 0)
+        ml.set(1, "B")
+        ml.delete(2, 1)
+        ml.insert(1, "x")
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], cid)
+        assert batch.value_lists() == [ml.get_value()]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_incremental_fuzz_concurrent(self, seed):
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        rng = random.Random(seed)
+        pairs = []
+        for i in range(3):
+            a, b = LoroDoc(peer=2 * i + 1), LoroDoc(peer=2 * i + 2)
+            a.get_movable_list("m").push(*[f"s{j}" for j in range(3)])
+            b.import_(a.export_snapshot())
+            pairs.append((a, b))
+        cid = pairs[0][0].get_movable_list("m").id
+        batch = DeviceMovableBatch(n_docs=3, capacity=2048, elem_capacity=256)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        batch.append_changes(
+            [a.oplog.changes_in_causal_order() for a, _ in pairs], cid
+        )
+        for epoch in range(4):
+            for a, b in pairs:
+                for d in (a, b):
+                    ml = d.get_movable_list("m")
+                    L = len(ml)
+                    r = rng.random()
+                    if L == 0 or r < 0.3:
+                        ml.insert(rng.randint(0, L), f"v{rng.randrange(100)}")
+                    elif r < 0.5 and L >= 2:
+                        ml.move(rng.randrange(L), rng.randrange(L))
+                    elif r < 0.7:
+                        ml.set(rng.randrange(L), f"w{rng.randrange(100)}")
+                    elif r < 0.85:
+                        ml.delete(rng.randrange(L), 1)
+                    else:
+                        ml.push(f"p{rng.randrange(100)}")
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+                assert a.get_deep_value() == b.get_deep_value()
+            ups = []
+            for i, (a, _) in enumerate(pairs):
+                ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
+                marks[i] = a.oplog_vv()
+            batch.append_changes(ups, cid)
+            got = batch.value_lists()
+            for i, (a, _) in enumerate(pairs):
+                want = a.get_movable_list("m").get_value()
+                assert got[i] == want, f"seed {seed} epoch {epoch} doc {i}"
+
+    def test_elem_capacity_guard_atomic(self):
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("m")
+        ml.push(*[str(i) for i in range(10)])
+        doc.commit()
+        batch = DeviceMovableBatch(n_docs=1, capacity=256, elem_capacity=4)
+        with pytest.raises(RuntimeError, match="element capacity"):
+            batch.append_changes([doc.oplog.changes_in_causal_order()], ml.id)
+        assert batch.elem_ids[0] == {} and batch.values[0] == []

@@ -1688,6 +1688,13 @@ class DeviceMovableBatch:
                 r = overlay.get(key)
                 return idmap[key] if r is None else r
 
+            def resolve_parent(c, peer, counter):
+                if isinstance(c.parent, _RunCont):
+                    return resolve((peer, counter - 1))
+                if c.parent is None:
+                    return -1
+                return resolve((c.parent.peer, c.parent.counter))
+
             for ch in changes:
                 for op in ch.ops:
                     if op.container != cid:
@@ -1698,12 +1705,7 @@ class DeviceMovableBatch:
                         body = c.content
                         for j in range(len(body)):
                             if j == 0:
-                                if isinstance(c.parent, _RunCont):
-                                    prow = resolve((ch.peer, op.counter - 1))
-                                elif c.parent is None:
-                                    prow = -1
-                                else:
-                                    prow = resolve((c.parent.peer, c.parent.counter))
+                                prow = resolve_parent(c, ch.peer, op.counter)
                                 side = int(c.side)
                             else:
                                 prow = base + len(rows) - 1
@@ -1716,12 +1718,7 @@ class DeviceMovableBatch:
                             mrows.append((ei, lam + j, ch.peer, row))
                             srows.append((ei, lam + j, ch.peer, vidx(body[j])))
                     elif isinstance(c, MovableMove):
-                        if isinstance(c.parent, _RunCont):
-                            prow = resolve((ch.peer, op.counter - 1))
-                        elif c.parent is None:
-                            prow = -1
-                        else:
-                            prow = resolve((c.parent.peer, c.parent.counter))
+                        prow = resolve_parent(c, ch.peer, op.counter)
                         row = base + len(rows)
                         ei = eidx((c.elem.peer, c.elem.counter))
                         overlay[(ch.peer, op.counter)] = row

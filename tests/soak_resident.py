@@ -1,0 +1,156 @@
+"""Resident-family soak — NOT collected by pytest.
+
+Run: python tests/soak_resident.py  (~2-4 min at defaults)
+
+Drives ALL five resident device batches (text+richtext, map, tree,
+counter, movable list) through many epochs of concurrent multi-replica
+edits on the 8-device CPU mesh, gating every epoch against the host
+oracles.  Env: SOAK_RES_DOCS (6), SOAK_RES_EPOCHS (10), SOAK_RES_SEED.
+"""
+import os
+import os.path as _p
+import random
+import sys
+import time
+
+_here = _p.dirname(_p.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, _p.dirname(_here))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import loro_tpu as lt  # noqa: E402
+from loro_tpu.parallel.fleet import (  # noqa: E402
+    DeviceCounterBatch,
+    DeviceDocBatch,
+    DeviceMapBatch,
+    DeviceMovableBatch,
+    DeviceTreeBatch,
+)
+from loro_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+N = int(os.environ.get("SOAK_RES_DOCS", "6"))
+EPOCHS = int(os.environ.get("SOAK_RES_EPOCHS", "10"))
+SEED = int(os.environ.get("SOAK_RES_SEED", "0"))
+
+t0 = time.time()
+rng = random.Random(SEED)
+pairs = []
+for i in range(N):
+    a, b = lt.LoroDoc(peer=2 * i + 1), lt.LoroDoc(peer=2 * i + 2)
+    a.get_text("t").insert(0, "resident soak baseline text")
+    a.get_movable_list("ml").push("a", "b")
+    tr = a.get_tree("tr")
+    tr.create()
+    b.import_(a.export_snapshot())
+    pairs.append((a, b))
+mesh = make_mesh()
+cid_t = pairs[0][0].get_text("t").id
+cid_ml = pairs[0][0].get_movable_list("ml").id
+cid_tr = pairs[0][0].get_tree("tr").id
+docs_b = DeviceDocBatch(N, capacity=1 << 13, mesh=mesh)
+maps_b = DeviceMapBatch(N, slot_capacity=128, mesh=mesh)
+tree_b = DeviceTreeBatch(N, move_capacity=1 << 12, node_capacity=512, mesh=mesh)
+ctr_b = DeviceCounterBatch(N, slot_capacity=32, mesh=mesh)
+ml_b = DeviceMovableBatch(N, capacity=1 << 12, elem_capacity=512, mesh=mesh)
+marks = [a.oplog_vv() for a, _ in pairs]
+init = [a.oplog.changes_in_causal_order() for a, _ in pairs]
+docs_b.append_changes(init, cid_t)
+maps_b.append_changes(init)
+tree_b.append_changes(init, cid_tr)
+ctr_b.append_changes(init)
+ml_b.append_changes(init, cid_ml)
+
+KEYS = ["k1", "k2", "k3"]
+for epoch in range(EPOCHS):
+    for a, b in pairs:
+        for d in (a, b):
+            for _ in range(rng.randint(3, 10)):
+                kind = rng.randint(0, 5)
+                if kind == 0:
+                    t = d.get_text("t")
+                    L = len(t)
+                    r = rng.random()
+                    if L >= 3 and r < 0.25:
+                        s = rng.randrange(L - 2)
+                        t.mark(s, rng.randint(s + 1, L), "bold", rng.choice([True, None]))
+                    elif L > 4 and r < 0.45:
+                        t.delete(rng.randrange(L - 2), 2)
+                    else:
+                        t.insert(rng.randint(0, L), rng.choice(["xy", "q", "lo "]))
+                elif kind == 1:
+                    m = d.get_map("m")
+                    if rng.random() < 0.2:
+                        m.delete(rng.choice(KEYS))
+                    else:
+                        m.set(rng.choice(KEYS), rng.randrange(100))
+                elif kind == 2:
+                    tr = d.get_tree("tr")
+                    nodes = tr.nodes()
+                    r = rng.random()
+                    if not nodes or r < 0.4:
+                        tr.create(rng.choice(nodes) if nodes else None)
+                    elif r < 0.7 and len(nodes) >= 2:
+                        t1, t2 = rng.sample(nodes, 2)
+                        try:
+                            tr.move(t1, t2)
+                        except Exception:
+                            pass
+                    else:
+                        tr.delete(rng.choice(nodes))
+                elif kind == 3:
+                    d.get_counter("c").increment(rng.randint(-50, 50))
+                elif kind == 4:
+                    ml = d.get_movable_list("ml")
+                    L = len(ml)
+                    r = rng.random()
+                    if L == 0 or r < 0.35:
+                        ml.insert(rng.randint(0, L), f"v{rng.randrange(99)}")
+                    elif r < 0.55 and L >= 2:
+                        ml.move(rng.randrange(L), rng.randrange(L))
+                    elif r < 0.75:
+                        ml.set(rng.randrange(L), f"w{rng.randrange(99)}")
+                    else:
+                        ml.delete(rng.randrange(L), 1)
+            d.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        assert a.get_deep_value() == b.get_deep_value()
+    ups = []
+    for i, (a, _) in enumerate(pairs):
+        ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
+        marks[i] = a.oplog_vv()
+    docs_b.append_changes(ups, cid_t)
+    maps_b.append_changes(ups)
+    tree_b.append_changes(ups, cid_tr)
+    ctr_b.append_changes(ups)
+    ml_b.append_changes(ups, cid_ml)
+
+    texts = docs_b.texts()
+    segs = docs_b.richtexts()
+    mvals = maps_b.root_value_maps("m")
+    parents = tree_b.parent_maps()
+    kids = tree_b.children_maps()
+    cvals = ctr_b.value_maps()
+    mls = ml_b.value_lists()
+    for i, (a, _) in enumerate(pairs):
+        t = a.get_text("t")
+        assert texts[i] == t.to_string(), f"text epoch {epoch} doc {i}"
+        assert segs[i] == t.get_richtext_value(), f"richtext epoch {epoch} doc {i}"
+        assert mvals[i] == a.get_map("m").get_value(), f"map epoch {epoch} doc {i}"
+        tr = a.get_tree("tr")
+        assert parents[i] == {x: tr.parent(x) for x in tr.nodes()}, f"tree epoch {epoch} doc {i}"
+        host_kids = {}
+        for x in [None] + tr.nodes():
+            ch = tr.children(x)
+            if ch:
+                host_kids[x] = ch
+        assert kids[i] == host_kids, f"children epoch {epoch} doc {i}"
+        c = a.get_counter("c")
+        assert cvals[i].get(c.id, 0.0) == c.get_value(), f"counter epoch {epoch} doc {i}"
+        assert mls[i] == a.get_movable_list("ml").get_value(), f"mlist epoch {epoch} doc {i}"
+    print(f"epoch {epoch}: all 5 resident families match host oracles ({time.time()-t0:.0f}s)")
+
+print(f"RESIDENT SOAK CLEAN: {N} docs x {EPOCHS} epochs in {time.time()-t0:.0f}s")

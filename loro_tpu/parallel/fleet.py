@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import functools
 import os
+import struct
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -1044,6 +1045,233 @@ class DeviceDocBatch:
         return [
             [self.value_store[i][j] for j in codes[i, : counts[i]]] for i in range(self.n_docs)
         ]
+
+    # -- checkpoint/resume (fleet-scale; SURVEY §5) --------------------
+    STATE_VERSION = 1
+    # serialized row columns (valid is derivable from counts): ONE
+    # schema shared by export and import so they cannot drift
+    _STATE_SCHEMA = (
+        ("parent", np.int32),
+        ("side", np.int32),
+        ("peer_hi", np.uint32),
+        ("peer_lo", np.uint32),
+        ("counter", np.int32),
+        ("deleted", np.uint8),
+        ("content", np.int32),
+    )
+
+    def export_state(self) -> bytes:
+        """Serialize the resident batch into an LTKV store (storage/kv
+        SSTable): per-doc committed row columns, value stores, anchor
+        metadata.  id2row and the order engine are NOT serialized —
+        both rebuild deterministically from the row table on import
+        (keys are re-assigned by replay; any valid assignment orders
+        identically).  One server restart = export_state -> bytes ->
+        import_state."""
+        from ..codec.binary import Writer, _Dicts, _write_cid, _write_value
+        from ..storage import MemKvStore
+
+        cols = {f: np.asarray(getattr(self.cols, f)) for f, _ in self._STATE_SCHEMA}
+        kv = MemKvStore()
+        d = _Dicts()
+        meta = Writer()
+        meta.u8(self.STATE_VERSION)
+        meta.varint(self.n_docs)
+        meta.varint(self.d)  # exporter's mesh-padded width
+        meta.varint(self.cap)
+        meta.u8(1 if self.as_text else 0)
+        meta.varint(self._c_pad)
+        for di in range(self.d):
+            meta.varint(int(self.counts[di]))
+        kv.set(b"meta", bytes(meta.buf))
+        for di in range(self.d):
+            k = int(self.counts[di])
+            w = Writer()
+            for f, dt in self._STATE_SCHEMA:
+                w.bytes_(cols[f][di, :k].astype(dt).tobytes())
+            kv.set(b"doc/%08d/rows" % di, bytes(w.buf))
+            w = Writer()
+            w.varint(len(self.value_store[di]))
+            for v in self.value_store[di]:
+                _write_value(w, d, v)
+            kv.set(b"doc/%08d/values" % di, bytes(w.buf))
+            w = Writer()
+            w.varint(len(self.anchor_meta[di]))
+            for (peer, ctr), a in self.anchor_meta[di].items():
+                w.varint(d.peer(peer))
+                w.zigzag(ctr)
+                w.varint(a["row"])
+                w.str_(a["key"])
+                if a["value"] is None:
+                    w.u8(0)
+                else:
+                    w.u8(1)
+                    _write_value(w, d, a["value"])
+                w.varint(a["lamport"])
+                w.u8((1 if a["start"] else 0) | (2 if a["deleted"] else 0))
+            kv.set(b"doc/%08d/anchors" % di, bytes(w.buf))
+        # container ids can reference peers not yet in the peer table —
+        # register them BEFORE emitting it, or _write_cid below would
+        # append peers past the already-written table (the same guard
+        # as codec/binary.encode_changes)
+        for c in d.cids:
+            if not c.is_root:
+                d.peer(c.peer)
+        w = Writer()
+        w.varint(len(d.peers))
+        for p in d.peers:
+            w.u64le(p)
+        w.varint(len(d.cids))
+        for c in d.cids:
+            _write_cid(w, d, c)
+        kv.set(b"dicts", bytes(w.buf))
+        return kv.export_all()
+
+    @classmethod
+    def import_state(cls, data: bytes, mesh=None) -> "DeviceDocBatch":
+        """Restore a resident batch from export_state bytes: upload the
+        row table, rebuild id maps + the incremental order engine by
+        deterministic replay, re-derive standing keys."""
+        from ..codec.binary import Reader, _read_cid, _read_value
+        from ..errors import DecodeError
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        kv.import_all(data)
+        meta_b = kv.get(b"meta")
+        if meta_b is None:
+            raise DecodeError("DeviceDocBatch state: missing meta")
+        try:
+            r = Reader(meta_b)
+            version = r.u8()
+            if version > cls.STATE_VERSION:
+                raise DecodeError(f"DeviceDocBatch state v{version} too new")
+            n_docs = r.varint()
+            d_saved = r.varint()  # exporter's mesh-padded width
+            cap = r.varint()
+            as_text = r.u8() == 1
+            c_pad = r.varint()
+            counts = [r.varint() for _ in range(d_saved)]
+        except (IndexError, ValueError, struct.error) as e:
+            raise DecodeError(f"DeviceDocBatch state: malformed meta ({e})") from None
+        batch = cls(n_docs, cap, mesh=mesh, as_text=as_text)
+        batch._c_pad = c_pad
+        # mesh-pad docs beyond the importer's width must be empty (they
+        # only ever receive None updates on the export side)
+        for di in range(batch.d, d_saved):
+            if counts[di]:
+                raise DecodeError(
+                    "DeviceDocBatch state: exporter pad doc carries rows but "
+                    "importer mesh is narrower"
+                )
+        dicts_b = kv.get(b"dicts")
+        if dicts_b is None:
+            raise DecodeError("DeviceDocBatch state: missing dicts")
+        try:
+            r = Reader(dicts_b)
+            peers = [r.u64le() for _ in range(r.varint())]
+            cids: List[ContainerID] = []
+            for _ in range(r.varint()):
+                cids.append(_read_cid(r, peers))
+        except (IndexError, ValueError, struct.error) as e:
+            raise DecodeError(f"DeviceDocBatch state: malformed dicts ({e})") from None
+        host = {
+            f: np.asarray(getattr(batch.cols, f)).copy() for f in batch.cols._fields
+        }
+        key_hi = np.asarray(batch.key_hi).copy()
+        key_lo = np.asarray(batch.key_lo).copy()
+        from .order_maintenance import split_keys
+
+        for di in range(min(batch.d, d_saved)):
+            k = counts[di]
+            if k > cap:
+                raise DecodeError("DeviceDocBatch state: count exceeds capacity")
+            rows_b = kv.get(b"doc/%08d/rows" % di)
+            if k and rows_b is None:
+                raise DecodeError(f"DeviceDocBatch state: missing rows for doc {di}")
+            if rows_b is not None:
+                r = Reader(rows_b)
+                arrs = {}
+                try:
+                    for f, dt in cls._STATE_SCHEMA:
+                        buf = np.frombuffer(r.bytes_(), dt)
+                        if len(buf) != k:
+                            raise DecodeError("DeviceDocBatch state: row column length")
+                        arrs[f] = buf
+                except (IndexError, ValueError) as e:
+                    raise DecodeError(
+                        f"DeviceDocBatch state: malformed rows ({e})"
+                    ) from None
+                for f in arrs:
+                    tgt = host[f]
+                    tgt[di, :k] = arrs[f].astype(tgt.dtype)
+                host["valid"][di, :k] = True
+                batch.counts[di] = k
+                peer_full = (arrs["peer_hi"].astype(np.uint64) << np.uint64(32)) | arrs[
+                    "peer_lo"
+                ].astype(np.uint64)
+                ctr = arrs["counter"]
+                batch.id2row[di] = {
+                    (int(peer_full[i]), int(ctr[i])): i for i in range(k)
+                }
+                # deterministic order-engine rebuild by replay
+                if k:
+                    replay = [
+                        (int(arrs["parent"][i]), int(arrs["side"][i]), int(peer_full[i]), int(ctr[i]))
+                        for i in range(k)
+                    ]
+                    keys = batch.order[di].append_rows(replay, 0)
+                    if keys is None:
+                        keys = batch.order[di].all_keys()
+                    kh, kl = split_keys(np.asarray(keys, np.int64))
+                    key_hi[di, :k] = kh
+                    key_lo[di, :k] = kl
+            try:
+                vals_b = kv.get(b"doc/%08d/values" % di)
+                if vals_b is not None:
+                    r = Reader(vals_b)
+                    batch.value_store[di] = [
+                        _read_value(r, cids) for _ in range(r.varint())
+                    ]
+                anch_b = kv.get(b"doc/%08d/anchors" % di)
+                if anch_b is not None:
+                    r = Reader(anch_b)
+                    meta_d: Dict[Tuple[int, int], dict] = {}
+                    for _ in range(r.varint()):
+                        pi = r.varint()
+                        if pi >= len(peers):
+                            raise DecodeError("DeviceDocBatch state: anchor peer index")
+                        peer = peers[pi]
+                        ctr_ = r.zigzag()
+                        row = r.varint()
+                        key = r.str_()
+                        val = _read_value(r, cids) if r.u8() == 1 else None
+                        lam = r.varint()
+                        flags = r.u8()
+                        meta_d[(peer, ctr_)] = {
+                            "row": row,
+                            "key": key,
+                            "value": val,
+                            "lamport": lam,
+                            "peer": peer,
+                            "start": bool(flags & 1),
+                            "deleted": bool(flags & 2),
+                        }
+                    batch.anchor_meta[di] = meta_d
+                    batch.anchor_by_row[di] = {
+                        a["row"]: pc for pc, a in meta_d.items()
+                    }
+            except (IndexError, ValueError, struct.error, UnicodeDecodeError) as e:
+                raise DecodeError(
+                    f"DeviceDocBatch state: malformed doc {di} ({e})"
+                ) from None
+        sh = doc_sharding(batch.mesh)
+        from ..ops.fugue_batch import SeqColumnsU
+
+        batch.cols = SeqColumnsU(**{f: jax.device_put(v, sh) for f, v in host.items()})
+        batch.key_hi = jax.device_put(key_hi, sh)
+        batch.key_lo = jax.device_put(key_lo, sh)
+        return batch
 
     def richtexts(self) -> List[list]:
         """Materialize every doc as Quill-style [{insert, attributes?}]

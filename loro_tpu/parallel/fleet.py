@@ -1068,7 +1068,7 @@ class DeviceDocBatch:
         (keys are re-assigned by replay; any valid assignment orders
         identically).  One server restart = export_state -> bytes ->
         import_state."""
-        from ..codec.binary import Writer, _Dicts, _write_cid, _write_value
+        from ..codec.binary import Writer, _Dicts, _write_value
         from ..storage import MemKvStore
 
         cols = {f: np.asarray(getattr(self.cols, f)) for f, _ in self._STATE_SCHEMA}
@@ -1091,9 +1091,7 @@ class DeviceDocBatch:
                 w.bytes_(cols[f][di, :k].astype(dt).tobytes())
             kv.set(b"doc/%08d/rows" % di, bytes(w.buf))
             w = Writer()
-            w.varint(len(self.value_store[di]))
-            for v in self.value_store[di]:
-                _write_value(w, d, v)
+            _state_write_values(w, d, self.value_store[di])
             kv.set(b"doc/%08d/values" % di, bytes(w.buf))
             w = Writer()
             w.varint(len(self.anchor_meta[di]))
@@ -1110,21 +1108,7 @@ class DeviceDocBatch:
                 w.varint(a["lamport"])
                 w.u8((1 if a["start"] else 0) | (2 if a["deleted"] else 0))
             kv.set(b"doc/%08d/anchors" % di, bytes(w.buf))
-        # container ids can reference peers not yet in the peer table —
-        # register them BEFORE emitting it, or _write_cid below would
-        # append peers past the already-written table (the same guard
-        # as codec/binary.encode_changes)
-        for c in d.cids:
-            if not c.is_root:
-                d.peer(c.peer)
-        w = Writer()
-        w.varint(len(d.peers))
-        for p in d.peers:
-            w.u64le(p)
-        w.varint(len(d.cids))
-        for c in d.cids:
-            _write_cid(w, d, c)
-        kv.set(b"dicts", bytes(w.buf))
+        kv.set(b"dicts", _state_dicts_blob(d))
         return kv.export_all()
 
     @classmethod
@@ -1132,7 +1116,7 @@ class DeviceDocBatch:
         """Restore a resident batch from export_state bytes: upload the
         row table, rebuild id maps + the incremental order engine by
         deterministic replay, re-derive standing keys."""
-        from ..codec.binary import Reader, _read_cid, _read_value
+        from ..codec.binary import Reader, _read_value
         from ..errors import DecodeError
         from ..storage import MemKvStore
 
@@ -1151,6 +1135,8 @@ class DeviceDocBatch:
             cap = r.varint()
             as_text = r.u8() == 1
             c_pad = r.varint()
+            if c_pad <= 0:  # the chain-budget doubling loop needs > 0
+                raise DecodeError("DeviceDocBatch state: bad chain budget")
             counts = [r.varint() for _ in range(d_saved)]
         except (IndexError, ValueError, struct.error) as e:
             raise DecodeError(f"DeviceDocBatch state: malformed meta ({e})") from None
@@ -1167,14 +1153,7 @@ class DeviceDocBatch:
         dicts_b = kv.get(b"dicts")
         if dicts_b is None:
             raise DecodeError("DeviceDocBatch state: missing dicts")
-        try:
-            r = Reader(dicts_b)
-            peers = [r.u64le() for _ in range(r.varint())]
-            cids: List[ContainerID] = []
-            for _ in range(r.varint()):
-                cids.append(_read_cid(r, peers))
-        except (IndexError, ValueError, struct.error) as e:
-            raise DecodeError(f"DeviceDocBatch state: malformed dicts ({e})") from None
+        peers, cids = _state_read_dicts(dicts_b)
         host = {
             f: np.asarray(getattr(batch.cols, f)).copy() for f in batch.cols._fields
         }
@@ -1229,10 +1208,7 @@ class DeviceDocBatch:
             try:
                 vals_b = kv.get(b"doc/%08d/values" % di)
                 if vals_b is not None:
-                    r = Reader(vals_b)
-                    batch.value_store[di] = [
-                        _read_value(r, cids) for _ in range(r.varint())
-                    ]
+                    batch.value_store[di] = _state_read_values(vals_b, cids)
                 anch_b = kv.get(b"doc/%08d/anchors" % di)
                 if anch_b is not None:
                     r = Reader(anch_b)
@@ -1576,6 +1552,98 @@ class DeviceMapBatch:
             )
         return out
 
+    # -- checkpoint/resume --------------------------------------------
+    STATE_VERSION = 1
+
+    def export_state(self) -> bytes:
+        """Serialize the resident winners + slot/value dictionaries into
+        an LTKV store (lazy values decode here — winners only live on)."""
+        from ..codec.binary import Writer, _Dicts
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        d = _Dicts()
+        meta = Writer()
+        meta.u8(self.STATE_VERSION)
+        meta.varint(self.n_docs)
+        meta.varint(self.d)
+        meta.varint(self.s)
+        kv.set(b"meta", bytes(meta.buf))
+        _state_write_grid(kv, b"res", [np.asarray(a) for a in self.res])
+        for di in range(self.d):
+            w = Writer()
+            w.varint(len(self.slot_of[di]))
+            for (cid, key), s_ in self.slot_of[di].items():
+                w.varint(d.cid(cid))
+                w.str_(key)
+                w.varint(s_)
+            kv.set(b"doc/%08d/slots" % di, bytes(w.buf))
+            w = Writer()
+            _state_write_values(w, d, self.values[di])
+            kv.set(b"doc/%08d/values" % di, bytes(w.buf))
+        kv.set(b"dicts", _state_dicts_blob(d))
+        return kv.export_all()
+
+    @classmethod
+    def import_state(cls, data: bytes, mesh=None) -> "DeviceMapBatch":
+        from ..codec.binary import Reader
+        from ..errors import DecodeError
+        from ..ops.lww import LwwResident
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        kv.import_all(data)
+        meta_b, dicts_b = kv.get(b"meta"), kv.get(b"dicts")
+        if meta_b is None or dicts_b is None:
+            raise DecodeError("DeviceMapBatch state: missing meta/dicts")
+        try:
+            r = Reader(meta_b)
+            version = r.u8()
+            if version > cls.STATE_VERSION:
+                raise DecodeError(f"DeviceMapBatch state v{version} too new")
+            n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
+        except (IndexError, ValueError) as e:
+            raise DecodeError(f"DeviceMapBatch state: malformed meta ({e})") from None
+        peers, cids = _state_read_dicts(dicts_b)
+        batch = cls(n_docs, s, mesh=mesh)
+        res_b = kv.get(b"res")
+        if res_b is None:
+            raise DecodeError("DeviceMapBatch state: missing res")
+        grids = _state_read_grid(
+            res_b,
+            [((d_saved, s), dt) for dt in (np.int32, np.uint32, np.uint32, np.int32)],
+        )
+        host = [np.asarray(a).copy() for a in batch.res]
+        lim = min(batch.d, d_saved)
+        for h, g in zip(host, grids):
+            h[:lim] = g[:lim]
+        sh = doc_sharding(batch.mesh)
+        batch.res = LwwResident(*[jax.device_put(h, sh) for h in host])
+        for di in range(lim):
+            slots_b = kv.get(b"doc/%08d/slots" % di)
+            if slots_b is not None:
+                try:
+                    r = Reader(slots_b)
+                    so: Dict[Tuple[ContainerID, str], int] = {}
+                    for _ in range(r.varint()):
+                        ci = r.varint()
+                        if ci >= len(cids):
+                            raise DecodeError("DeviceMapBatch state: cid index")
+                        key = r.str_()
+                        s_ = r.varint()
+                        if s_ >= s:
+                            raise DecodeError("DeviceMapBatch state: slot index")
+                        so[(cids[ci], key)] = s_
+                    batch.slot_of[di] = so
+                except (IndexError, ValueError, UnicodeDecodeError) as e:
+                    raise DecodeError(
+                        f"DeviceMapBatch state: malformed slots ({e})"
+                    ) from None
+            vals_b = kv.get(b"doc/%08d/values" % di)
+            if vals_b is not None:
+                batch.values[di] = _state_read_values(vals_b, cids)
+        return batch
+
 
 class DeviceTreeBatch:
     """Device-resident movable-tree move logs for a doc batch (the tree
@@ -1749,6 +1817,133 @@ class DeviceTreeBatch:
                 res[tid] = None if p == ROOT else nodes[p]
             out.append(res)
         return out
+
+    # -- checkpoint/resume --------------------------------------------
+    STATE_VERSION = 1
+    _STATE_SCHEMA = (
+        ("lamport", np.int32),
+        ("peer_hi", np.uint32),
+        ("peer_lo", np.uint32),
+        ("counter", np.int32),
+        ("target", np.int32),
+        ("parent", np.int32),
+    )
+
+    def export_state(self) -> bytes:
+        """Serialize the resident move logs + node dictionaries + host
+        move metadata (fractional positions) into an LTKV store."""
+        from ..codec.binary import Writer
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        meta = Writer()
+        meta.u8(self.STATE_VERSION)
+        meta.varint(self.n_docs)
+        meta.varint(self.d)
+        meta.varint(self.cap)
+        meta.varint(self.node_cap)
+        for di in range(self.d):
+            meta.varint(int(self.counts[di]))
+        kv.set(b"meta", bytes(meta.buf))
+        cols = {f: np.asarray(getattr(self.cols, f)) for f, _ in self._STATE_SCHEMA}
+        for di in range(self.d):
+            k = int(self.counts[di])
+            w = Writer()
+            for f, dt in self._STATE_SCHEMA:
+                w.bytes_(cols[f][di, :k].astype(dt).tobytes())
+            kv.set(b"doc/%08d/log" % di, bytes(w.buf))
+            w = Writer()
+            w.varint(len(self.nodes[di]))
+            for tid in self.nodes[di]:
+                w.u64le(tid.peer)
+                w.zigzag(tid.counter)
+            kv.set(b"doc/%08d/nodes" % di, bytes(w.buf))
+            w = Writer()
+            w.varint(len(self.move_meta[di]))
+            for lam, peer, ctr, t, is_del, pos in self.move_meta[di]:
+                w.varint(lam)
+                w.u64le(peer)
+                w.zigzag(ctr)
+                w.varint(t)
+                w.u8((1 if is_del else 0) | (2 if pos is not None else 0))
+                if pos is not None:
+                    w.bytes_(pos)
+            kv.set(b"doc/%08d/meta" % di, bytes(w.buf))
+        return kv.export_all()
+
+    @classmethod
+    def import_state(cls, data: bytes, mesh=None) -> "DeviceTreeBatch":
+        from ..codec.binary import Reader
+        from ..core.ids import TreeID
+        from ..errors import DecodeError
+        from ..ops.tree_batch import TreeLogCols
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        kv.import_all(data)
+        meta_b = kv.get(b"meta")
+        if meta_b is None:
+            raise DecodeError("DeviceTreeBatch state: missing meta")
+        try:
+            r = Reader(meta_b)
+            version = r.u8()
+            if version > cls.STATE_VERSION:
+                raise DecodeError(f"DeviceTreeBatch state v{version} too new")
+            n_docs, d_saved = r.varint(), r.varint()
+            cap, node_cap = r.varint(), r.varint()
+            counts = [r.varint() for _ in range(d_saved)]
+        except (IndexError, ValueError) as e:
+            raise DecodeError(f"DeviceTreeBatch state: malformed meta ({e})") from None
+        batch = cls(n_docs, cap, node_cap, mesh=mesh)
+        for di in range(batch.d, d_saved):
+            if counts[di]:
+                raise DecodeError("DeviceTreeBatch state: importer mesh too narrow")
+        host = {f: np.asarray(getattr(batch.cols, f)).copy() for f in batch.cols._fields}
+        try:
+            for di in range(min(batch.d, d_saved)):
+                k = counts[di]
+                if k > cap:
+                    raise DecodeError("DeviceTreeBatch state: count exceeds capacity")
+                log_b = kv.get(b"doc/%08d/log" % di)
+                if k and log_b is None:
+                    raise DecodeError(f"DeviceTreeBatch state: missing log for doc {di}")
+                if log_b is not None:
+                    r = Reader(log_b)
+                    for f, dt in cls._STATE_SCHEMA:
+                        buf = np.frombuffer(r.bytes_(), dt)
+                        if len(buf) != k:
+                            raise DecodeError("DeviceTreeBatch state: log column length")
+                        host[f][di, :k] = buf.astype(host[f].dtype)
+                    host["valid"][di, :k] = True
+                    batch.counts[di] = k
+                nodes_b = kv.get(b"doc/%08d/nodes" % di)
+                if nodes_b is not None:
+                    r = Reader(nodes_b)
+                    nodes = []
+                    for _ in range(r.varint()):
+                        nodes.append(TreeID(r.u64le(), r.zigzag()))
+                    if len(nodes) > node_cap:
+                        raise DecodeError("DeviceTreeBatch state: node overflow")
+                    batch.nodes[di] = nodes
+                    batch.node_ids[di] = {tid: i for i, tid in enumerate(nodes)}
+                mm_b = kv.get(b"doc/%08d/meta" % di)
+                if mm_b is not None:
+                    r = Reader(mm_b)
+                    mm = []
+                    for _ in range(r.varint()):
+                        lam = r.varint()
+                        peer = r.u64le()
+                        ctr = r.zigzag()
+                        t = r.varint()
+                        flags = r.u8()
+                        pos = r.bytes_() if flags & 2 else None
+                        mm.append((lam, peer, ctr, t, bool(flags & 1), pos))
+                    batch.move_meta[di] = mm
+        except (IndexError, ValueError, struct.error) as e:
+            raise DecodeError(f"DeviceTreeBatch state: malformed doc ({e})") from None
+        sh = doc_sharding(batch.mesh)
+        batch.cols = TreeLogCols(**{f: jax.device_put(v, sh) for f, v in host.items()})
+        return batch
 
     def children_maps(self) -> List[dict]:
         """{parent | None: [children in (fractional-index, move-key)
@@ -2012,6 +2207,116 @@ class DeviceMovableBatch:
                 ),
             )
 
+    # -- checkpoint/resume --------------------------------------------
+    STATE_VERSION = 1
+
+    def export_state(self) -> bytes:
+        """Serialize the movable batch: the nested slot-sequence batch
+        rides its own export; element folds, dictionaries and values
+        layer on top."""
+        from ..codec.binary import Writer, _Dicts
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        d = _Dicts()
+        meta = Writer()
+        meta.u8(self.STATE_VERSION)
+        meta.varint(self.n_docs)
+        meta.varint(self.d)
+        meta.varint(self.e_cap)
+        kv.set(b"meta", bytes(meta.buf))
+        kv.set(b"seq", self.seq.export_state())
+        _state_write_grid(kv, b"moves", [np.asarray(a) for a in self.moves])
+        _state_write_grid(kv, b"vals", [np.asarray(a) for a in self.vals])
+        for di in range(self.d):
+            w = Writer()
+            w.varint(len(self.elem_ids[di]))
+            for (peer, ctr), i in self.elem_ids[di].items():
+                w.u64le(peer)
+                w.zigzag(ctr)
+                w.varint(i)
+            kv.set(b"doc/%08d/elems" % di, bytes(w.buf))
+            w = Writer()
+            _state_write_values(w, d, self.values[di])
+            kv.set(b"doc/%08d/values" % di, bytes(w.buf))
+        kv.set(b"dicts", _state_dicts_blob(d))
+        return kv.export_all()
+
+    @classmethod
+    def import_state(cls, data: bytes, mesh=None) -> "DeviceMovableBatch":
+        from ..codec.binary import Reader
+        from ..errors import DecodeError
+        from ..ops.lww import LwwResident
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        kv.import_all(data)
+        meta_b, dicts_b, seq_b = kv.get(b"meta"), kv.get(b"dicts"), kv.get(b"seq")
+        if meta_b is None or dicts_b is None or seq_b is None:
+            raise DecodeError("DeviceMovableBatch state: missing sections")
+        try:
+            r = Reader(meta_b)
+            version = r.u8()
+            if version > cls.STATE_VERSION:
+                raise DecodeError(f"DeviceMovableBatch state v{version} too new")
+            n_docs, d_saved, e_cap = r.varint(), r.varint(), r.varint()
+        except (IndexError, ValueError) as e:
+            raise DecodeError(
+                f"DeviceMovableBatch state: malformed meta ({e})"
+            ) from None
+        _peers, cids = _state_read_dicts(dicts_b)
+        seq = DeviceDocBatch.import_state(seq_b, mesh=mesh)
+        batch = cls.__new__(cls)
+        batch.seq = seq
+        batch.mesh = seq.mesh
+        batch.n_docs = n_docs
+        batch.d = seq.d
+        batch.e_cap = e_cap
+        batch.elem_ids = [dict() for _ in range(batch.d)]
+        batch.values = [[] for _ in range(batch.d)]
+        sh = doc_sharding(batch.mesh)
+        lim = min(batch.d, d_saved)
+        for name in ("moves", "vals"):
+            blob = kv.get(name.encode())
+            if blob is None:
+                raise DecodeError(f"DeviceMovableBatch state: missing {name}")
+            grids = _state_read_grid(
+                blob,
+                [
+                    ((d_saved, e_cap), dt)
+                    for dt in (np.int32, np.uint32, np.uint32, np.int32)
+                ],
+            )
+            from ..ops.lww import NEG
+
+            defaults = (int(NEG), 0, 0, 0 if name == "moves" else -2)
+            host = [
+                np.full((batch.d, e_cap), fill, dt)
+                for fill, dt in zip(defaults, (np.int32, np.uint32, np.uint32, np.int32))
+            ]
+            for h, g in zip(host, grids):
+                h[:lim] = g[:lim]
+            setattr(batch, name, LwwResident(*[jax.device_put(h, sh) for h in host]))
+        try:
+            for di in range(lim):
+                elems_b = kv.get(b"doc/%08d/elems" % di)
+                if elems_b is not None:
+                    r = Reader(elems_b)
+                    eids: Dict = {}
+                    for _ in range(r.varint()):
+                        peer = r.u64le()
+                        ctr = r.zigzag()
+                        eids[(peer, ctr)] = r.varint()
+                    batch.elem_ids[di] = eids
+                vals_b = kv.get(b"doc/%08d/values" % di)
+                if vals_b is not None:
+                    batch.values[di] = _state_read_values(vals_b, cids)
+        except (IndexError, ValueError, struct.error) as e:
+            raise DecodeError(
+                f"DeviceMovableBatch state: malformed doc ({e})"
+            ) from None
+        return batch
+
     def value_lists(self) -> List[list]:
         """Materialize every doc's ordered element values (one launch;
         same contract as Fleet.merge_movable_changes per doc)."""
@@ -2032,6 +2337,91 @@ class DeviceMovableBatch:
             [self.values[di][j] for j in out_idx[di, : counts[di]]]
             for di in range(self.n_docs)
         ]
+
+
+# ---- shared checkpoint helpers (fleet-scale checkpoint/resume) --------
+
+
+def _state_dicts_blob(d) -> bytes:
+    """Serialize the peer/cid dictionaries (cid peers pre-registered —
+    the encode_changes guard)."""
+    from ..codec.binary import Writer, _write_cid
+
+    for c in d.cids:
+        if not c.is_root:
+            d.peer(c.peer)
+    w = Writer()
+    w.varint(len(d.peers))
+    for p in d.peers:
+        w.u64le(p)
+    w.varint(len(d.cids))
+    for c in d.cids:
+        _write_cid(w, d, c)
+    return bytes(w.buf)
+
+
+def _state_read_dicts(blob: bytes):
+    from ..codec.binary import Reader, _read_cid
+    from ..errors import DecodeError
+
+    try:
+        r = Reader(blob)
+        peers = [r.u64le() for _ in range(r.varint())]
+        cids: List[ContainerID] = []
+        for _ in range(r.varint()):
+            cids.append(_read_cid(r, peers))
+        return peers, cids
+    except (IndexError, ValueError, struct.error) as e:
+        raise DecodeError(f"resident state: malformed dicts ({e})") from None
+
+
+def _state_write_values(w, d, values) -> None:
+    from ..codec.binary import _write_value
+
+    w.varint(len(values))
+    for i, v in enumerate(values):
+        if isinstance(v, _LazyValue):
+            v = v.decode()
+            values[i] = v  # cache: repeat exports stay O(new values)
+        _write_value(w, d, v)
+
+
+def _state_read_values(blob: bytes, cids) -> list:
+    from ..codec.binary import Reader, _read_value
+    from ..errors import DecodeError
+
+    try:
+        r = Reader(blob)
+        return [_read_value(r, cids) for _ in range(r.varint())]
+    except (IndexError, ValueError, struct.error, UnicodeDecodeError) as e:
+        raise DecodeError(f"resident state: malformed values ({e})") from None
+
+
+def _state_write_grid(kv, key: bytes, arrays) -> None:
+    """One [D, S] array set as raw little-endian buffers."""
+    from ..codec.binary import Writer
+
+    w = Writer()
+    for a in arrays:
+        w.bytes_(np.asarray(a).tobytes())
+    kv.set(key, bytes(w.buf))
+
+
+def _state_read_grid(blob: bytes, shapes_dtypes):
+    from ..codec.binary import Reader
+    from ..errors import DecodeError
+
+    try:
+        r = Reader(blob)
+        out = []
+        for shape, dt in shapes_dtypes:
+            buf = np.frombuffer(r.bytes_(), dt)
+            if buf.size != int(np.prod(shape)):
+                raise DecodeError("resident state: grid size mismatch")
+            out.append(buf.reshape(shape).copy())
+        return out
+    except (IndexError, ValueError) as e:
+        raise DecodeError(f"resident state: malformed grid ({e})") from None
 
 
 class DeviceCounterBatch:
@@ -2114,6 +2504,82 @@ class DeviceCounterBatch:
             {cid: float(sums[di, s_]) for cid, s_ in self.slot_of[di].items()}
             for di in range(self.n_docs)
         ]
+
+    # -- checkpoint/resume --------------------------------------------
+    STATE_VERSION = 1
+
+    def export_state(self) -> bytes:
+        from ..codec.binary import Writer, _Dicts
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        d = _Dicts()
+        meta = Writer()
+        meta.u8(self.STATE_VERSION)
+        meta.varint(self.n_docs)
+        meta.varint(self.d)
+        meta.varint(self.s)
+        kv.set(b"meta", bytes(meta.buf))
+        _state_write_grid(kv, b"sums", [np.asarray(self.sums)])
+        for di in range(self.d):
+            w = Writer()
+            w.varint(len(self.slot_of[di]))
+            for cid, s_ in self.slot_of[di].items():
+                w.varint(d.cid(cid))
+                w.varint(s_)
+            kv.set(b"doc/%08d/slots" % di, bytes(w.buf))
+        kv.set(b"dicts", _state_dicts_blob(d))
+        return kv.export_all()
+
+    @classmethod
+    def import_state(cls, data: bytes, mesh=None) -> "DeviceCounterBatch":
+        from ..codec.binary import Reader
+        from ..errors import DecodeError
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        kv.import_all(data)
+        meta_b, dicts_b = kv.get(b"meta"), kv.get(b"dicts")
+        if meta_b is None or dicts_b is None:
+            raise DecodeError("DeviceCounterBatch state: missing meta/dicts")
+        try:
+            r = Reader(meta_b)
+            version = r.u8()
+            if version > cls.STATE_VERSION:
+                raise DecodeError(f"DeviceCounterBatch state v{version} too new")
+            n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
+        except (IndexError, ValueError) as e:
+            raise DecodeError(f"DeviceCounterBatch state: malformed meta ({e})") from None
+        _peers, cids = _state_read_dicts(dicts_b)
+        batch = cls(n_docs, s, mesh=mesh)
+        sums_b = kv.get(b"sums")
+        if sums_b is None:
+            raise DecodeError("DeviceCounterBatch state: missing sums")
+        (grid,) = _state_read_grid(sums_b, [((d_saved, s), np.float32)])
+        host = np.asarray(batch.sums).copy()
+        lim = min(batch.d, d_saved)
+        host[:lim] = grid[:lim]
+        batch.sums = jax.device_put(host, doc_sharding(batch.mesh))
+        for di in range(lim):
+            slots_b = kv.get(b"doc/%08d/slots" % di)
+            if slots_b is not None:
+                try:
+                    r = Reader(slots_b)
+                    so: Dict[ContainerID, int] = {}
+                    for _ in range(r.varint()):
+                        ci = r.varint()
+                        if ci >= len(cids):
+                            raise DecodeError("DeviceCounterBatch state: cid index")
+                        s_ = r.varint()
+                        if s_ >= s:
+                            raise DecodeError("DeviceCounterBatch state: slot index")
+                        so[cids[ci]] = s_
+                    batch.slot_of[di] = so
+                except (IndexError, ValueError) as e:
+                    raise DecodeError(
+                        f"DeviceCounterBatch state: malformed slots ({e})"
+                    ) from None
+        return batch
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -1022,3 +1022,99 @@ class TestResidentCheckpoint:
         batch.append_changes([d.oplog.changes_in_causal_order() for d in docs], cid)
         restored = DeviceDocBatch.import_state(batch.export_state())  # 8-dev mesh
         assert restored.texts() == [d.get_text("t").to_string() for d in docs]
+
+    def test_map_batch_roundtrip(self):
+        from loro_tpu.parallel.fleet import DeviceMapBatch
+
+        pairs = []
+        for i in range(2):
+            a, b = LoroDoc(peer=2 * i + 1), LoroDoc(peer=(1 << 33) + i)
+            for d in (a, b):
+                m = d.get_map("m")
+                m.set("k1", d.peer)
+                m.set("k2", {"nested": [1, 2]})
+                d.commit()
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+            pairs.append((a, b))
+        batch = DeviceMapBatch(n_docs=2, slot_capacity=16)
+        batch.append_changes([a.oplog.changes_in_causal_order() for a, _ in pairs])
+        restored = DeviceMapBatch.import_state(batch.export_state())
+        assert restored.root_value_maps("m") == [
+            a.get_map("m").get_value() for a, _ in pairs
+        ]
+        # continues folding
+        marks = [a.oplog_vv() for a, _ in pairs]
+        for a, _ in pairs:
+            a.get_map("m").set("k3", "post")
+            a.commit()
+        restored.append_changes(
+            [a.oplog.changes_between(m, a.oplog_vv()) for (a, _), m in zip(pairs, marks)]
+        )
+        assert restored.root_value_maps("m") == [
+            a.get_map("m").get_value() for a, _ in pairs
+        ]
+
+    def test_tree_batch_roundtrip(self):
+        from loro_tpu.parallel.fleet import DeviceTreeBatch
+
+        doc = LoroDoc(peer=1)
+        tr = doc.get_tree("tr")
+        root = tr.create()
+        kids = [tr.create(root) for _ in range(3)]
+        tr.move(kids[2], root, 0)
+        tr.delete(kids[0])
+        doc.commit()
+        batch = DeviceTreeBatch(n_docs=1, move_capacity=128, node_capacity=32)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], tr.id)
+        restored = DeviceTreeBatch.import_state(batch.export_state())
+        assert restored.parent_maps() == [{t: tr.parent(t) for t in tr.nodes()}]
+        host_kids = {}
+        for t in [None] + tr.nodes():
+            ch = tr.children(t)
+            if ch:
+                host_kids[t] = ch
+        assert restored.children_maps() == [host_kids]
+        # continues appending
+        mark = doc.oplog_vv()
+        tr.create(kids[1])
+        doc.commit()
+        restored.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], tr.id)
+        assert restored.parent_maps() == [{t: tr.parent(t) for t in tr.nodes()}]
+
+    def test_counter_batch_roundtrip(self):
+        from loro_tpu.parallel.fleet import DeviceCounterBatch
+
+        doc = LoroDoc(peer=1)
+        doc.get_counter("c").increment(41)
+        doc.commit()
+        batch = DeviceCounterBatch(n_docs=1, slot_capacity=8)
+        batch.append_changes([doc.oplog.changes_in_causal_order()])
+        restored = DeviceCounterBatch.import_state(batch.export_state())
+        mark = doc.oplog_vv()
+        doc.get_counter("c").increment(1)
+        doc.commit()
+        restored.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())])
+        assert restored.value_maps()[0][doc.get_counter("c").id] == 42
+
+    def test_movable_batch_roundtrip(self):
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("ml")
+        ml.push("a", "b", "c")
+        ml.move(2, 0)
+        ml.set(1, "B")
+        doc.commit()
+        batch = DeviceMovableBatch(n_docs=1, capacity=256, elem_capacity=64)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], ml.id)
+        restored = DeviceMovableBatch.import_state(batch.export_state())
+        assert restored.value_lists() == [ml.get_value()]
+        # continues: move + set + delete after restore
+        mark = doc.oplog_vv()
+        ml.move(0, 2)
+        ml.set(0, "zz")
+        ml.delete(1, 1)
+        doc.commit()
+        restored.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], ml.id)
+        assert restored.value_lists() == [ml.get_value()]

@@ -1040,11 +1040,23 @@ class DeviceDocBatch:
 
     def values(self, use_solver: bool = False) -> List[list]:
         """Materialize value lists (as_text=False batches)."""
+        from ..errors import DecodeError
+
         assert not self.as_text, "values() is for as_text=False batches"
         codes, counts = self._materialize(use_solver)
-        return [
-            [self.value_store[i][j] for j in codes[i, : counts[i]]] for i in range(self.n_docs)
-        ]
+        out = []
+        for i in range(self.n_docs):
+            store = self.value_store[i]
+            row = []
+            for j in codes[i, : counts[i]]:
+                if not 0 <= j < len(store):
+                    raise DecodeError(
+                        "resident batch: content ordinal outside the value store "
+                        "(corrupt restored state?)"
+                    )
+                row.append(store[j])
+            out.append(row)
+        return out
 
     # -- checkpoint/resume (fleet-scale; SURVEY §5) --------------------
     STATE_VERSION = 1
@@ -1140,6 +1152,9 @@ class DeviceDocBatch:
             counts = [r.varint() for _ in range(d_saved)]
         except (IndexError, ValueError, struct.error) as e:
             raise DecodeError(f"DeviceDocBatch state: malformed meta ({e})") from None
+        _state_sane_sizes("DeviceDocBatch", d_saved, capacity=cap)
+        if not 0 < n_docs <= d_saved:
+            raise DecodeError("DeviceDocBatch state: implausible n_docs")
         batch = cls(n_docs, cap, mesh=mesh, as_text=as_text)
         batch._c_pad = c_pad
         # mesh-pad docs beyond the importer's width must be empty (they
@@ -1209,6 +1224,19 @@ class DeviceDocBatch:
                 vals_b = kv.get(b"doc/%08d/values" % di)
                 if vals_b is not None:
                     batch.value_store[di] = _state_read_values(vals_b, cids)
+                if k:
+                    c_col = host["content"][di, :k].astype(np.int64)
+                    if as_text:
+                        if c_col.min() < -1 or c_col.max() >= 0x110000:
+                            raise DecodeError("DeviceDocBatch state: content code")
+                    elif batch.value_store[di] and (
+                        c_col.min() < -1
+                        or c_col.max() >= len(batch.value_store[di])
+                    ):
+                        # (an empty store with content rows is the
+                        # externally-indexed nested use — DeviceMovable-
+                        # Batch slots; values() re-checks at read time)
+                        raise DecodeError("DeviceDocBatch state: value ordinal")
                 anch_b = kv.get(b"doc/%08d/anchors" % di)
                 if anch_b is not None:
                     r = Reader(anch_b)
@@ -1604,6 +1632,9 @@ class DeviceMapBatch:
             n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceMapBatch state: malformed meta ({e})") from None
+        _state_sane_sizes("DeviceMapBatch", d_saved, slot_capacity=s)
+        if not 0 < n_docs <= d_saved:
+            raise DecodeError("DeviceMapBatch state: implausible n_docs")
         peers, cids = _state_read_dicts(dicts_b)
         batch = cls(n_docs, s, mesh=mesh)
         res_b = kv.get(b"res")
@@ -1642,6 +1673,11 @@ class DeviceMapBatch:
             vals_b = kv.get(b"doc/%08d/values" % di)
             if vals_b is not None:
                 batch.values[di] = _state_read_values(vals_b, cids)
+            # registered slots must reference in-range value ordinals
+            # (value_maps would IndexError otherwise)
+            for _ck, s_ in batch.slot_of[di].items():
+                if int(host[3][di, s_]) >= len(batch.values[di]):
+                    raise DecodeError("DeviceMapBatch state: value ordinal")
         return batch
 
 
@@ -1894,6 +1930,9 @@ class DeviceTreeBatch:
             counts = [r.varint() for _ in range(d_saved)]
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceTreeBatch state: malformed meta ({e})") from None
+        _state_sane_sizes("DeviceTreeBatch", d_saved, move_capacity=cap, node_capacity=node_cap)
+        if not 0 < n_docs <= d_saved:
+            raise DecodeError("DeviceTreeBatch state: implausible n_docs")
         batch = cls(n_docs, cap, node_cap, mesh=mesh)
         for di in range(batch.d, d_saved):
             if counts[di]:
@@ -1939,6 +1978,16 @@ class DeviceTreeBatch:
                         pos = r.bytes_() if flags & 2 else None
                         mm.append((lam, peer, ctr, t, bool(flags & 1), pos))
                     batch.move_meta[di] = mm
+                if k:
+                    # node ordinals must stay inside the node dict
+                    # (parent_maps would IndexError on nodes[p])
+                    n_nodes = len(batch.nodes[di])
+                    tgt = host["target"][di, :k].astype(np.int64)
+                    par = host["parent"][di, :k].astype(np.int64)
+                    if tgt.min() < 0 or tgt.max() >= n_nodes:
+                        raise DecodeError("DeviceTreeBatch state: target ordinal")
+                    if par.min() < -2 or par.max() >= n_nodes:
+                        raise DecodeError("DeviceTreeBatch state: parent ordinal")
         except (IndexError, ValueError, struct.error) as e:
             raise DecodeError(f"DeviceTreeBatch state: malformed doc ({e})") from None
         sh = doc_sharding(batch.mesh)
@@ -2264,6 +2313,9 @@ class DeviceMovableBatch:
             raise DecodeError(
                 f"DeviceMovableBatch state: malformed meta ({e})"
             ) from None
+        _state_sane_sizes("DeviceMovableBatch", d_saved, elem_capacity=e_cap)
+        if not 0 < n_docs <= d_saved:
+            raise DecodeError("DeviceMovableBatch state: implausible n_docs")
         _peers, cids = _state_read_dicts(dicts_b)
         seq = DeviceDocBatch.import_state(seq_b, mesh=mesh)
         batch = cls.__new__(cls)
@@ -2296,6 +2348,8 @@ class DeviceMovableBatch:
             ]
             for h, g in zip(host, grids):
                 h[:lim] = g[:lim]
+            if name == "vals":
+                vals_host_value = host[3]
             setattr(batch, name, LwwResident(*[jax.device_put(h, sh) for h in host]))
         try:
             for di in range(lim):
@@ -2306,11 +2360,20 @@ class DeviceMovableBatch:
                     for _ in range(r.varint()):
                         peer = r.u64le()
                         ctr = r.zigzag()
-                        eids[(peer, ctr)] = r.varint()
+                        i = r.varint()
+                        if i >= e_cap:
+                            raise DecodeError("DeviceMovableBatch state: elem ordinal")
+                        eids[(peer, ctr)] = i
                     batch.elem_ids[di] = eids
                 vals_b = kv.get(b"doc/%08d/values" % di)
                 if vals_b is not None:
                     batch.values[di] = _state_read_values(vals_b, cids)
+                # folded value ordinals must stay inside the value store
+                # (value_lists would IndexError otherwise)
+                vv = vals_host_value[di].astype(np.int64)
+                vv = vv[vv >= 0]
+                if vv.size and vv.max() >= len(batch.values[di]):
+                    raise DecodeError("DeviceMovableBatch state: value ordinal")
         except (IndexError, ValueError, struct.error) as e:
             raise DecodeError(
                 f"DeviceMovableBatch state: malformed doc ({e})"
@@ -2340,6 +2403,24 @@ class DeviceMovableBatch:
 
 
 # ---- shared checkpoint helpers (fleet-scale checkpoint/resume) --------
+
+
+def _state_sane_sizes(cls_name: str, d_saved: int, **fields) -> None:
+    """Reject implausible size fields BEFORE allocating host/device
+    arrays from them — a few flipped meta bytes must produce
+    DecodeError, not a multi-GB allocation (checkpoint fuzz contract).
+    Bounds are generous (16M per axis, 128M grid entries)."""
+    from ..errors import DecodeError
+
+    if not 0 < d_saved <= 1 << 20:
+        raise DecodeError(f"{cls_name} state: implausible doc width {d_saved}")
+    for name, v in fields.items():
+        if not 0 < v <= 1 << 24:
+            raise DecodeError(f"{cls_name} state: implausible {name} {v}")
+        if d_saved * v > 1 << 27:
+            raise DecodeError(
+                f"{cls_name} state: implausible grid {d_saved}x{v} ({name})"
+            )
 
 
 def _state_dicts_blob(d) -> bytes:
@@ -2550,6 +2631,9 @@ class DeviceCounterBatch:
             n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceCounterBatch state: malformed meta ({e})") from None
+        _state_sane_sizes("DeviceCounterBatch", d_saved, slot_capacity=s)
+        if not 0 < n_docs <= d_saved:
+            raise DecodeError("DeviceCounterBatch state: implausible n_docs")
         _peers, cids = _state_read_dicts(dicts_b)
         batch = cls(n_docs, s, mesh=mesh)
         sums_b = kv.get(b"sums")

@@ -1118,3 +1118,63 @@ class TestResidentCheckpoint:
         doc.commit()
         restored.append_changes([doc.oplog.changes_between(mark, doc.oplog_vv())], ml.id)
         assert restored.value_lists() == [ml.get_value()]
+
+    def test_checkpoint_mutation_fuzz(self):
+        """random_import analog for the checkpoint formats: mutated
+        blobs either import (and materialize) or raise DecodeError —
+        never crash or hang."""
+        from loro_tpu.errors import DecodeError
+        from loro_tpu.parallel.fleet import (
+            DeviceCounterBatch,
+            DeviceDocBatch,
+            DeviceMapBatch,
+            DeviceMovableBatch,
+            DeviceTreeBatch,
+        )
+
+        doc = LoroDoc(peer=1)
+        doc.get_text("t").insert(0, "fuzz base text")
+        doc.get_text("t").mark(0, 4, "bold", True)
+        doc.get_map("m").set("k", 1)
+        tr = doc.get_tree("tr")
+        r_ = tr.create()
+        tr.create(r_)
+        doc.get_counter("c").increment(3)
+        doc.get_movable_list("ml").push("a", "b")
+        doc.commit()
+        chs = doc.oplog.changes_in_causal_order()
+
+        cases = []
+        b1 = DeviceDocBatch(1, 256)
+        b1.append_changes([chs], doc.get_text("t").id)
+        cases.append((DeviceDocBatch, b1.export_state(), lambda b: (b.texts(), b.richtexts())))
+        b2 = DeviceMapBatch(1, 16)
+        b2.append_changes([chs])
+        cases.append((DeviceMapBatch, b2.export_state(), lambda b: b.value_maps()))
+        b3 = DeviceTreeBatch(1, 64, 16)
+        b3.append_changes([chs], tr.id)
+        cases.append((DeviceTreeBatch, b3.export_state(), lambda b: (b.parent_maps(), b.children_maps())))
+        b4 = DeviceCounterBatch(1, 8)
+        b4.append_changes([chs])
+        cases.append((DeviceCounterBatch, b4.export_state(), lambda b: b.value_maps()))
+        b5 = DeviceMovableBatch(1, 128, 32)
+        b5.append_changes([chs], doc.get_movable_list("ml").id)
+        cases.append((DeviceMovableBatch, b5.export_state(), lambda b: b.value_lists()))
+
+        rng = random.Random(13)
+        for cls, blob, materialize in cases:
+            # pristine must import + materialize
+            materialize(cls.import_state(blob))
+            for _ in range(40):
+                bad = bytearray(blob)
+                for _ in range(rng.randrange(1, 4)):
+                    bad[rng.randrange(len(bad))] = rng.randrange(256)
+                try:
+                    restored = cls.import_state(bytes(bad))
+                    materialize(restored)
+                except DecodeError:
+                    pass
+                # NOTHING else is acceptable: import validates size
+                # fields, slot/elem/value ordinals and content codes, so
+                # a corrupt blob either imports (and materializes) or
+                # raises DecodeError — a raw IndexError here is a bug

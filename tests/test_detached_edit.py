@@ -83,3 +83,66 @@ class TestEditableDetached:
         doc.checkout(f)
         with pytest.raises(LoroError):
             doc.get_text("t").insert(0, "nope")
+
+
+class TestOneDocFuzzMultiContainer:
+    """one_doc_fuzzer analog across every container family: branch at
+    random frontiers, edit detached, jump back, and require (a) a fresh
+    replica replays identically and (b) snapshot round-trips agree."""
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_branching_all_containers(self, seed):
+        rng = random.Random(100 + seed)
+        doc = make_editable(LoroDoc(peer=1))
+        pool = []
+        for step in range(80):
+            kind = rng.randrange(5)
+            if kind == 0:
+                t = doc.get_text("t")
+                if len(t) and rng.random() < 0.3:
+                    pos = rng.randint(0, len(t) - 1)
+                    t.delete(pos, min(2, len(t) - pos))
+                else:
+                    t.insert(rng.randint(0, len(t)), rng.choice("xyz"))
+                    if rng.random() < 0.2 and len(t) >= 2:
+                        s = rng.randint(0, len(t) - 2)
+                        t.mark(s, s + 1, "bold", True)
+            elif kind == 1:
+                doc.get_map("m").set(rng.choice("abc"), rng.randrange(50))
+            elif kind == 2:
+                ml = doc.get_movable_list("ml")
+                n = len(ml)
+                if n and rng.random() < 0.4:
+                    ml.move(rng.randrange(n), rng.randrange(n))
+                else:
+                    ml.insert(rng.randint(0, n), rng.randrange(9))
+            elif kind == 3:
+                tr = doc.get_tree("tr")
+                nodes = tr.nodes()
+                if not nodes or rng.random() < 0.5:
+                    tr.create(rng.choice(nodes) if nodes else None)
+                elif len(nodes) >= 2:
+                    n1, n2 = rng.sample(nodes, 2)
+                    # cycle-creating moves are engine no-ops, never
+                    # exceptions — any raise here is a real bug
+                    tr.move(n1, n2)
+            else:
+                doc.get_counter("c").increment(rng.randrange(-5, 6))
+            doc.commit()
+            pool.append(doc.state_frontiers())
+            r = rng.random()
+            if r < 0.2 and pool:
+                doc.checkout(rng.choice(pool))
+            elif r < 0.45:
+                doc.checkout_to_latest()
+        doc.checkout_to_latest()
+        # (a) updates replay identically into a fresh replica
+        b = LoroDoc(peer=2)
+        b.import_(doc.export_updates())
+        assert b.get_deep_value() == doc.get_deep_value(), f"seed {seed}"
+        assert b.get_text("t").get_richtext_value() == doc.get_text("t").get_richtext_value()
+        # (b) snapshot round-trip agrees (history + state)
+        c = LoroDoc(peer=3)
+        c.import_(doc.export_snapshot())
+        assert c.get_deep_value() == doc.get_deep_value(), f"seed {seed}"
+        assert c.get_text("t").get_richtext_value() == doc.get_text("t").get_richtext_value()

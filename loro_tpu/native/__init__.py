@@ -95,6 +95,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong,
             ctypes.c_void_p,
         ]
+        lib.loro_explode_seq_anchor_meta.restype = ctypes.c_longlong
+        lib.loro_explode_seq_anchor_meta.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ] + [ctypes.c_void_p] * 5 + [ctypes.c_longlong]
         lib.loro_count_map_ops.restype = ctypes.c_longlong
         lib.loro_count_map_ops.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
         lib.loro_explode_map.restype = ctypes.c_longlong
@@ -240,6 +246,42 @@ def explode_seq_delta_payload(payload: bytes, target_cid_index: int):
         "del_start": del_start[: n_del_out.value],
         "del_end": del_end[: n_del_out.value],
     }
+
+
+def explode_seq_anchor_meta(payload: bytes, target_cid_index: int):
+    """Style-anchor metadata in the same row numbering as
+    explode_seq_delta_payload (host pairs anchors to device rows by the
+    `row` ordinal).  Values stay encoded — `voffset` feeds
+    decode_value_at.  Returns a dict of numpy columns or None when the
+    native library is unavailable; raises ValueError on malformed
+    payloads."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.loro_explode_seq_anchor_meta(
+        payload, len(payload), target_cid_index, None, None, None, None, None, 0
+    )
+    if n < 0:
+        raise ValueError("native anchor decode failed (malformed payload?)")
+    row = np.empty(n, np.int64)
+    key = np.empty(n, np.int32)
+    voff = np.empty(n, np.int64)
+    lam = np.empty(n, np.int32)
+    flags = np.empty(n, np.int32)
+    wrote = lib.loro_explode_seq_anchor_meta(
+        payload,
+        len(payload),
+        target_cid_index,
+        row.ctypes.data_as(ctypes.c_void_p),
+        key.ctypes.data_as(ctypes.c_void_p),
+        voff.ctypes.data_as(ctypes.c_void_p),
+        lam.ctypes.data_as(ctypes.c_void_p),
+        flags.ctypes.data_as(ctypes.c_void_p),
+        n,
+    )
+    if wrote != n:
+        raise ValueError("native anchor decode failed")
+    return {"row": row, "key_idx": key, "voffset": voff, "lamport": lam, "flags": flags}
 
 
 def explode_map_payload(payload: bytes):

@@ -650,6 +650,72 @@ long long loro_explode_seq_delta(const uint8_t* buf, long long len, int target_c
   return row;
 }
 
+// Style-anchor metadata for a target container, in the SAME row
+// numbering as loro_explode_seq_delta (the host pairs anchors to their
+// device rows by ordinal).  Per anchor: row ordinal, wire key index,
+// value BYTE OFFSET into the payload (decoded lazily host-side, like
+// the map explode's winners), lamport, flags (bit0 = is_start).
+// Returns anchors written, or -1 on malformed input / n_max overflow.
+long long loro_explode_seq_anchor_meta(const uint8_t* buf, long long len,
+                                       int target_cid,
+                                       int64_t* out_row, int32_t* out_key,
+                                       int64_t* out_voffset,
+                                       int32_t* out_lamport,
+                                       int32_t* out_flags,
+                                       long long n_max) {
+  Reader r{buf, buf + len};
+  uint64_t n_peers, n_keys; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
+  if (!parse_prelude(r, &n_peers, cid_types, metas, &n_keys)) return -1;
+  long long row = 0, n_anchor = 0;
+  for (auto& m : metas) {
+    int64_t ctr = m.ctr;
+    for (uint64_t k = 0; k < m.n_ops; k++) {
+      uint64_t cidx = r.varint();
+      uint8_t kind = r.u8();
+      if (!r.ok) return -1;
+      if ((long long)cidx != target_cid) {
+        int64_t atoms;
+        if (!skip_op(r, kind, &atoms)) return -1;
+        ctr += atoms;
+        continue;
+      }
+      if (kind == K_INSERT_ANCHOR) {
+        uint8_t ptag = r.u8();
+        if (ptag == PT_ID) { r.varint(); r.zigzag(); }
+        r.u8();  // side
+        uint64_t key = r.varint();
+        if (!r.ok || key >= n_keys) return -1;
+        int64_t voff = (int64_t)(r.p - buf);
+        if (!skip_value(r)) return -1;
+        uint8_t is_start = r.u8();
+        r.varint();  // info (expand behavior rides anchor placement)
+        if (!r.ok) return -1;
+        if (out_row) {  // null outputs = counting pass
+          if (n_anchor >= n_max) return -1;
+          out_row[n_anchor] = row;
+          out_key[n_anchor] = (int32_t)key;
+          out_voffset[n_anchor] = voff;
+          out_lamport[n_anchor] = (int32_t)(m.lamport + (ctr - m.ctr));
+          out_flags[n_anchor] = is_start ? 1 : 0;
+        }
+        n_anchor++;
+        row++;
+        ctr += 1;
+      } else {
+        // every other kind: skip_op's atom count IS the row count for
+        // insert kinds (one row per codepoint/value; the main explode
+        // already strictly validated this same payload) and deletes
+        // emit no rows
+        int64_t atoms;
+        if (!skip_op(r, kind, &atoms)) return -1;
+        if (kind == K_INSERT_TEXT || kind == K_INSERT_VALUES) row += atoms;
+        ctr += atoms;
+      }
+    }
+  }
+  return n_anchor;
+}
+
 // Count delete spans for a target container (sizing for the delta API).
 long long loro_count_seq_deletes(const uint8_t* buf, long long len, int target_cid) {
   Reader r{buf, buf + len};

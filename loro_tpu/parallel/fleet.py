@@ -908,7 +908,12 @@ class DeviceDocBatch:
         to append_changes via the Python decoder per payload when the
         native library is unavailable."""
         from ..codec.binary import decode_changes, read_tables
-        from ..native import available, explode_seq_delta_payload
+        from ..native import (
+            available,
+            decode_value_at,
+            explode_seq_anchor_meta,
+            explode_seq_delta_payload,
+        )
 
         if not available() or not self.as_text:
             # no native lib, or a value batch (the native explode only
@@ -939,11 +944,12 @@ class DeviceDocBatch:
                 except ValueError:
                     continue  # no ops for this container
                 out = explode_seq_delta_payload(payload, target)
+                anchor_cols = None
                 if (np.asarray(out["content"]) == -1).any():
-                    # style anchors: the native explode integrates them
-                    # as rows but carries no style metadata — the python
-                    # walk must record the pair table for richtexts()
-                    raise KeyError("anchors need the python walk")
+                    # style anchors: fetch their metadata natively (same
+                    # row numbering as the main explode) so richtexts()
+                    # keeps its pair table without the python walk
+                    anchor_cols = explode_seq_anchor_meta(payload, target)
                 base = int(self.counts[di])
                 idmap = self.id2row[di]
                 n = len(out["parent"])
@@ -970,6 +976,21 @@ class DeviceDocBatch:
                         peer_arr.tolist(),
                     )
                 )
+                if anchor_cols is not None:
+                    for ai in range(len(anchor_cols["row"])):
+                        rrow = int(anchor_cols["row"][ai])
+                        a_peer = peers_wire[int(out["peer_idx"][rrow])]
+                        stage[(a_peer, int(out["counter"][rrow]))] = {
+                            "row": base + rrow,
+                            "key": _keys[int(anchor_cols["key_idx"][ai])],
+                            "value": decode_value_at(
+                                payload, int(anchor_cols["voffset"][ai]), cids
+                            ),
+                            "lamport": int(anchor_cols["lamport"][ai]),
+                            "peer": a_peer,
+                            "start": bool(anchor_cols["flags"][ai] & 1),
+                            "deleted": False,
+                        }
                 for k in range(len(out["del_peer_idx"])):
                     dp = peers_wire[out["del_peer_idx"][k]]
                     for ctr in range(int(out["del_start"][k]), int(out["del_end"][k])):
@@ -979,10 +1000,11 @@ class DeviceDocBatch:
                         if row is not None:
                             del_pairs.append((di, row))
             except (KeyError, ValueError):
-                # style anchors (not in the native explode) or other
-                # unresolvables: python fallback for this payload only
+                # unresolvable refs or malformed input for the native
+                # path: python fallback for this payload only
                 rows.clear()
                 overlay.clear()
+                stage.clear()
                 del del_pairs[n_dels_start:]
                 self._python_rows(
                     di, decode_changes(payload), cid, rows, overlay, del_pairs, stage

@@ -1178,3 +1178,87 @@ class TestResidentCheckpoint:
                 # fields, slot/elem/value ordinals and content codes, so
                 # a corrupt blob either imports (and materializes) or
                 # raises DecodeError — a raw IndexError here is a bug
+
+
+class TestNativeAnchorIngest:
+    """Anchor-bearing payloads must ingest NATIVELY (round-4: the C++
+    explode now surfaces anchor metadata; no python fallback)."""
+
+    def _no_fallback(self, monkeypatch, batch):
+        def boom(*a, **k):
+            raise AssertionError("python fallback must not run for anchor payloads")
+
+        monkeypatch.setattr(batch, "_python_rows", boom)
+
+    def test_marks_payload_native(self, monkeypatch):
+        from loro_tpu.doc import strip_envelope
+        from loro_tpu.native import available
+        from loro_tpu.parallel.fleet import DeviceDocBatch
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        doc = LoroDoc(peer=3)
+        cid = doc.get_text("t").id
+        t = doc.get_text("t")
+        t.insert(0, "styled text here")
+        t.mark(0, 6, "bold", True)
+        t.mark(3, 10, "color", "red")
+        t.unmark(4, 6, "bold")
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=256)
+        self._no_fallback(monkeypatch, batch)
+        batch.append_payloads([strip_envelope(doc.export_updates(None))], cid)
+        assert batch.richtexts() == [t.get_richtext_value()]
+        assert batch.texts() == [t.to_string()]
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_multi_epoch_payload_richtext_fuzz(self, seed, monkeypatch):
+        from loro_tpu.doc import strip_envelope
+        from loro_tpu.native import available
+        from loro_tpu.parallel.fleet import DeviceDocBatch
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        rng = random.Random(50 + seed)
+        pairs = []
+        for i in range(2):
+            a, b = LoroDoc(peer=2 * i + 1), LoroDoc(peer=2 * i + 2)
+            a.get_text("t").insert(0, "the quick brown fox")
+            b.import_(a.export_updates(b.oplog_vv()))
+            pairs.append((a, b))
+        cid = pairs[0][0].get_text("t").id
+        batch = DeviceDocBatch(n_docs=2, capacity=2048)
+        self._no_fallback(monkeypatch, batch)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        batch.append_payloads(
+            [strip_envelope(a.export_updates(None)) for a, _ in pairs], cid
+        )
+        for epoch in range(3):
+            for a, b in pairs:
+                for d in (a, b):
+                    t = d.get_text("t")
+                    L = len(t)
+                    r = rng.random()
+                    if L >= 3 and r < 0.4:
+                        s = rng.randrange(L - 2)
+                        k = rng.choice(["bold", "color"])
+                        if rng.random() < 0.3:
+                            t.unmark(s, rng.randint(s + 1, L), k)
+                        else:
+                            t.mark(s, rng.randint(s + 1, L), k, rng.choice([True, "red"]))
+                    elif L > 4 and r < 0.6:
+                        t.delete(rng.randrange(L - 2), 2)
+                    else:
+                        t.insert(rng.randint(0, L), rng.choice(["zz", "q"]))
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+            ups = []
+            for i, (a, _) in enumerate(pairs):
+                ups.append(strip_envelope(a.export_updates(marks[i])))
+                marks[i] = a.oplog_vv()
+            batch.append_payloads(ups, cid)
+            got = batch.richtexts()
+            for i, (a, _) in enumerate(pairs):
+                want = a.get_text("t").get_richtext_value()
+                assert got[i] == want, f"seed {seed} epoch {epoch} doc {i}"

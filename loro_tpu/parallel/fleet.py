@@ -1749,20 +1749,46 @@ class DeviceTreeBatch:
         node registration and rows are STAGED before any validation, so
         a capacity error leaves the batch untouched (the DeviceDocBatch
         atomicity contract)."""
-        from ..core.change import TreeMove
-        from ..ops.fugue_batch import pad_bucket
-        from ..ops.tree_batch import ROOT, TRASH
-
         per_doc_changes = list(per_doc_changes) + [None] * (self.d - len(per_doc_changes))
         rows_per_doc: List[list] = []
         staged_nodes: List[list] = []
         for di, changes in enumerate(per_doc_changes):
             rows: list = []
+            staged_order: list = []
+            rows_per_doc.append(rows)
+            staged_nodes.append(staged_order)
+            if changes:
+                self._explode_changes_into(di, changes, cid, rows, staged_order)
+        self._commit_moves(rows_per_doc, staged_nodes)
+
+    def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]], cid) -> None:
+        """Incremental NATIVE ingest: envelope-stripped binary payloads
+        -> C++ tree explode (wire order — the device replay sorts by the
+        global move key anyway) -> one block scatter.  Falls back to the
+        Python decoder per payload on unresolvable input."""
+        from ..codec.binary import decode_changes, read_tables
+        from ..core.ids import TreeID
+        from ..native import available, explode_tree_payload
+        from ..ops.tree_batch import ROOT, TRASH
+
+        if not available():
+            self.append_changes(
+                [decode_changes(p) if p else None for p in per_doc_payloads], cid
+            )
+            return
+        per_doc_payloads = list(per_doc_payloads) + [None] * (
+            self.d - len(per_doc_payloads)
+        )
+        rows_per_doc: List[list] = []
+        staged_nodes: List[list] = []
+        fallback: List[Tuple[int, bytes]] = []
+        for di, payload in enumerate(per_doc_payloads):
+            rows: list = []
             staged: Dict = {}
             staged_order: list = []
             rows_per_doc.append(rows)
             staged_nodes.append(staged_order)
-            if not changes:
+            if not payload:
                 continue
             ids = self.node_ids[di]
             n_committed = len(self.nodes[di])
@@ -1777,20 +1803,101 @@ class DeviceTreeBatch:
                     staged_order.append(tid)
                 return i
 
-            for ch in changes:
-                for op in ch.ops:
-                    if op.container != cid or not isinstance(op.content, TreeMove):
-                        continue
-                    c = op.content
-                    lam = ch.lamport + (op.counter - ch.ctr_start)
-                    t = node_idx(c.target)
-                    if c.is_delete:
+            try:
+                peers_wire, _keys, cids, _r = read_tables(payload)
+                try:
+                    target = cids.index(cid)
+                except ValueError:
+                    continue  # no ops for this container
+                out = explode_tree_payload(payload, target)
+                fl = out["flags"]
+                for i in range(len(out["lamport"])):
+                    tid = TreeID(
+                        int(peers_wire[int(out["target_peer_idx"][i])]),
+                        int(out["target_ctr"][i]),
+                    )
+                    t = node_idx(tid)
+                    if fl[i] & 2:  # delete
                         p = TRASH
-                    elif c.parent is None:
-                        p = ROOT
+                        is_del = True
+                    elif fl[i] & 4:  # has parent
+                        p = node_idx(
+                            TreeID(
+                                int(peers_wire[int(out["parent_peer_idx"][i])]),
+                                int(out["parent_ctr"][i]),
+                            )
+                        )
+                        is_del = False
                     else:
-                        p = node_idx(c.parent)
-                    rows.append((lam, ch.peer, op.counter, t, p, c.is_delete, c.position))
+                        p = ROOT
+                        is_del = False
+                    pos = None
+                    if fl[i] & 8:
+                        o = int(out["pos_off"][i])
+                        pos = bytes(payload[o : o + int(out["pos_len"][i])])
+                    rows.append(
+                        (
+                            int(out["lamport"][i]),
+                            int(peers_wire[int(out["peer_idx"][i])]),
+                            int(out["counter"][i]),
+                            t,
+                            p,
+                            is_del,
+                            pos,
+                        )
+                    )
+            except ValueError:
+                rows.clear()
+                staged.clear()
+                staged_order.clear()
+                fallback.append((di, payload))
+        for di, payload in fallback:  # python walk per unresolvable payload
+            self._explode_changes_into(
+                di, decode_changes(payload), cid, rows_per_doc[di], staged_nodes[di]
+            )
+        self._commit_moves(rows_per_doc, staged_nodes)
+
+    def _explode_changes_into(self, di, changes, cid, rows, staged_order) -> None:
+        """Python change walk appending into pre-staged row/node lists
+        (the append_payloads fallback)."""
+        from ..core.change import TreeMove
+        from ..ops.tree_batch import ROOT, TRASH
+
+        ids = self.node_ids[di]
+        n_committed = len(self.nodes[di])
+        staged = {tid: n_committed + i for i, tid in enumerate(staged_order)}
+
+        def node_idx(tid):
+            i = ids.get(tid)
+            if i is None:
+                i = staged.get(tid)
+            if i is None:
+                i = n_committed + len(staged_order)
+                staged[tid] = i
+                staged_order.append(tid)
+            return i
+
+        for ch in changes:
+            for op in ch.ops:
+                if op.container != cid or not isinstance(op.content, TreeMove):
+                    continue
+                c = op.content
+                lam = ch.lamport + (op.counter - ch.ctr_start)
+                t = node_idx(c.target)
+                if c.is_delete:
+                    p = TRASH
+                elif c.parent is None:
+                    p = ROOT
+                else:
+                    p = node_idx(c.parent)
+                rows.append((lam, ch.peer, op.counter, t, p, c.is_delete, c.position))
+
+    def _commit_moves(self, rows_per_doc, staged_nodes) -> None:
+        """Shared tail: validate capacities, commit staged nodes, block-
+        scatter the new move rows."""
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.tree_batch import ROOT
+
         max_new = (
             pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16)
             if any(rows_per_doc)

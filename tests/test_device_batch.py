@@ -1262,3 +1262,75 @@ class TestNativeAnchorIngest:
             for i, (a, _) in enumerate(pairs):
                 want = a.get_text("t").get_richtext_value()
                 assert got[i] == want, f"seed {seed} epoch {epoch} doc {i}"
+
+
+class TestTreePayloadIngest:
+    """DeviceTreeBatch.append_payloads: native C++ tree explode feeding
+    the resident log (wire order; the device replay sorts anyway)."""
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_payload_epochs_match_host(self, seed, monkeypatch):
+        from loro_tpu.doc import strip_envelope
+        from loro_tpu.native import available
+        from loro_tpu.parallel.fleet import DeviceTreeBatch
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        rng = random.Random(70 + seed)
+        pairs = []
+        for i in range(2):
+            a, b = LoroDoc(peer=2 * i + 1), LoroDoc(peer=2 * i + 2)
+            tr = a.get_tree("tr")
+            root = tr.create()
+            tr.create(root)
+            b.import_(a.export_snapshot())
+            pairs.append((a, b))
+        cid = pairs[0][0].get_tree("tr").id
+        batch = DeviceTreeBatch(n_docs=2, move_capacity=1024, node_capacity=128)
+
+        def boom(*a, **k):
+            raise AssertionError("python fallback must not run")
+
+        monkeypatch.setattr(batch, "_explode_changes_into", boom)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        batch.append_payloads(
+            [strip_envelope(a.export_updates(None)) for a, _ in pairs], cid
+        )
+        for epoch in range(3):
+            for a, b in pairs:
+                for d in (a, b):
+                    tr = d.get_tree("tr")
+                    nodes = tr.nodes()
+                    r = rng.random()
+                    if not nodes or r < 0.4:
+                        tr.create(rng.choice(nodes) if nodes else None, index=0)
+                    elif r < 0.7 and len(nodes) >= 2:
+                        n1, n2 = rng.sample(nodes, 2)
+                        try:
+                            tr.move(n1, n2, rng.randint(0, 1))
+                        except Exception:
+                            pass  # local cycle rejection
+                    else:
+                        tr.delete(rng.choice(nodes))
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+                assert a.get_deep_value() == b.get_deep_value()
+            ups = []
+            for i, (a, _) in enumerate(pairs):
+                ups.append(strip_envelope(a.export_updates(marks[i])))
+                marks[i] = a.oplog_vv()
+            batch.append_payloads(ups, cid)
+            parents = batch.parent_maps()
+            kids = batch.children_maps()
+            for i, (a, _) in enumerate(pairs):
+                tr = a.get_tree("tr")
+                assert parents[i] == {t: tr.parent(t) for t in tr.nodes()}, (
+                    f"seed {seed} epoch {epoch} doc {i}"
+                )
+                host_kids = {}
+                for t in [None] + tr.nodes():
+                    ch = tr.children(t)
+                    if ch:
+                        host_kids[t] = ch
+                assert kids[i] == host_kids, f"seed {seed} epoch {epoch} doc {i}"

@@ -132,6 +132,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_longlong,
             ctypes.c_int,
         ] + [ctypes.c_void_p] * 15 + [ctypes.c_longlong] * 3
+        lib.loro_explode_movable_delta.restype = ctypes.c_longlong
+        lib.loro_explode_movable_delta.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_int,
+        ] + [ctypes.c_void_p] * 15 + [ctypes.c_longlong] * 3 + [ctypes.c_void_p] * 2
         lib.loro_order_new.restype = ctypes.c_void_p
         lib.loro_order_new.argtypes = []
         lib.loro_order_free.restype = None
@@ -440,6 +446,71 @@ def explode_movable_payload(payload: bytes, target_cid_index: int):
     )
     if wrote != ns:
         raise ValueError("native decode failed (unresolvable refs or count mismatch)")
+    return {"slots": slots, "sets": sets, "dels": dels}
+
+
+def explode_movable_delta_payload(payload: bytes, target_cid_index: int):
+    """Delta variant of explode_movable_payload: slot parents that don't
+    resolve inside the payload come back as parent == -2 with
+    (ext_peer_idx, ext_counter) pairs for host resolution against the
+    resident batch's id map (DeviceMovableBatch.append_payloads)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_slots = ctypes.c_longlong()
+    n_sets = ctypes.c_longlong()
+    n_dels = ctypes.c_longlong()
+    rc = lib.loro_count_movable(
+        payload,
+        len(payload),
+        target_cid_index,
+        ctypes.byref(n_slots),
+        ctypes.byref(n_sets),
+        ctypes.byref(n_dels),
+    )
+    if rc < 0:
+        raise ValueError("native decode failed (malformed payload?)")
+    ns, nv, nd = n_slots.value, n_sets.value, n_dels.value
+    slots = {
+        "parent": np.empty(ns, np.int32),
+        "side": np.empty(ns, np.int32),
+        "peer_idx": np.empty(ns, np.int32),
+        "counter": np.empty(ns, np.int32),
+        "lamport": np.empty(ns, np.int32),
+        "elem_peer_idx": np.empty(ns, np.int32),
+        "elem_ctr": np.empty(ns, np.int32),
+    }
+    sets = {
+        "elem_peer_idx": np.empty(nv, np.int32),
+        "elem_ctr": np.empty(nv, np.int32),
+        "lamport": np.empty(nv, np.int32),
+        "peer_idx": np.empty(nv, np.int32),
+        "value_off": np.empty(nv, np.int64),
+    }
+    dels = {
+        "peer_idx": np.empty(nd, np.int32),
+        "start": np.empty(nd, np.int64),
+        "end": np.empty(nd, np.int64),
+    }
+    ext_peer = np.empty(ns, np.int32)
+    ext_ctr = np.empty(ns, np.int64)
+    wrote = lib.loro_explode_movable_delta(
+        payload,
+        len(payload),
+        target_cid_index,
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in slots.values()],
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in sets.values()],
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in dels.values()],
+        ns,
+        nv,
+        nd,
+        ext_peer.ctypes.data_as(ctypes.c_void_p),
+        ext_ctr.ctypes.data_as(ctypes.c_void_p),
+    )
+    if wrote != ns:
+        raise ValueError("native delta decode failed")
+    slots["ext_peer_idx"] = ext_peer
+    slots["ext_counter"] = ext_ctr
     return {"slots": slots, "sets": sets, "dels": dels}
 
 

@@ -938,17 +938,18 @@ long long loro_count_movable(const uint8_t* buf, long long len, int target_cid,
   return 0;
 }
 
-long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid,
-                               int32_t* s_parent, int32_t* s_side,
-                               int32_t* s_peer, int32_t* s_ctr,
-                               int32_t* s_lamport, int32_t* s_epeer,
-                               int32_t* s_ectr,
-                               int32_t* v_epeer, int32_t* v_ectr,
-                               int32_t* v_lamport, int32_t* v_peer,
-                               int64_t* v_off,
-                               int32_t* d_peer, int64_t* d_start, int64_t* d_end,
-                               long long n_slots, long long n_sets,
-                               long long n_dels) {
+static long long movable_walk(const uint8_t* buf, long long len, int target_cid,
+                              int32_t* s_parent, int32_t* s_side,
+                              int32_t* s_peer, int32_t* s_ctr,
+                              int32_t* s_lamport, int32_t* s_epeer,
+                              int32_t* s_ectr,
+                              int32_t* v_epeer, int32_t* v_ectr,
+                              int32_t* v_lamport, int32_t* v_peer,
+                              int64_t* v_off,
+                              int32_t* d_peer, int64_t* d_start, int64_t* d_end,
+                              long long n_slots, long long n_sets,
+                              long long n_dels,
+                              int32_t* s_extpeer, int64_t* s_extctr) {
   Reader r{buf, buf + len};
   uint64_t n_peers; std::vector<int32_t> cid_types; std::vector<ChangeMeta> metas;
   if (!parse_prelude(r, &n_peers, cid_types, metas)) return -1;
@@ -978,13 +979,20 @@ long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid
         uint64_t n = r.varint();
         if (!r.ok) return -1;
         int32_t parent_row;
+        uint32_t ext_peer = 0; int64_t ext_ctr = -1; bool ext = false;
         if (ptag == PT_NONE) parent_row = -1;
         else if (ptag == PT_RUNCONT) {
           parent_row = map.get(idkey(m.peer_idx, ctr - 1));
-          if (parent_row < 0) return -1;
+          if (parent_row < 0) {
+            if (!s_extpeer) return -1;  // one-shot mode: must resolve
+            parent_row = -2; ext = true; ext_peer = m.peer_idx; ext_ctr = ctr - 1;
+          }
         } else {
           parent_row = map.get(idkey(p_peer, p_ctr));
-          if (parent_row < 0) return -1;
+          if (parent_row < 0) {
+            if (!s_extpeer) return -1;
+            parent_row = -2; ext = true; ext_peer = p_peer; ext_ctr = p_ctr;
+          }
         }
         for (uint64_t j = 0; j < n; j++) {
           int64_t voff = (int64_t)(r.p - buf);
@@ -997,6 +1005,10 @@ long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid
           s_lamport[srow] = (int32_t)(m.lamport + (ctr - m.ctr) + (int64_t)j);
           s_epeer[srow] = (int32_t)m.peer_idx;  // insert: elem id == own id
           s_ectr[srow] = (int32_t)(ctr + (int64_t)j);
+          if (s_extpeer) {
+            s_extpeer[srow] = (ext && j == 0) ? (int32_t)ext_peer : -1;
+            s_extctr[srow] = (ext && j == 0) ? ext_ctr : -1;
+          }
           map.put(idkey(m.peer_idx, ctr + (int64_t)j), (int32_t)srow);
           v_epeer[vrow] = (int32_t)m.peer_idx;
           v_ectr[vrow] = (int32_t)(ctr + (int64_t)j);
@@ -1020,13 +1032,20 @@ long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid
         uint8_t side = r.u8();
         if (!r.ok) return -1;
         int32_t parent_row;
+        uint32_t ext_peer = 0; int64_t ext_ctr = -1; bool ext = false;
         if (ptag == PT_NONE) parent_row = -1;
         else if (ptag == PT_RUNCONT) {
           parent_row = map.get(idkey(m.peer_idx, ctr - 1));
-          if (parent_row < 0) return -1;
+          if (parent_row < 0) {
+            if (!s_extpeer) return -1;  // one-shot mode: must resolve
+            parent_row = -2; ext = true; ext_peer = m.peer_idx; ext_ctr = ctr - 1;
+          }
         } else {
           parent_row = map.get(idkey(p_peer, p_ctr));
-          if (parent_row < 0) return -1;
+          if (parent_row < 0) {
+            if (!s_extpeer) return -1;
+            parent_row = -2; ext = true; ext_peer = p_peer; ext_ctr = p_ctr;
+          }
         }
         if (srow >= n_slots) return -1;
         s_parent[srow] = parent_row;
@@ -1036,6 +1055,10 @@ long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid
         s_lamport[srow] = (int32_t)(m.lamport + (ctr - m.ctr));
         s_epeer[srow] = (int32_t)epi;
         s_ectr[srow] = (int32_t)ectr;
+        if (s_extpeer) {
+          s_extpeer[srow] = ext ? (int32_t)ext_peer : -1;
+          s_extctr[srow] = ext ? ext_ctr : -1;
+        }
         map.put(idkey(m.peer_idx, ctr), (int32_t)srow);
         srow++;
         ctr += 1;
@@ -1076,6 +1099,45 @@ long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid
     }
   }
   return srow;
+}
+
+long long loro_explode_movable(const uint8_t* buf, long long len, int target_cid,
+                               int32_t* s_parent, int32_t* s_side,
+                               int32_t* s_peer, int32_t* s_ctr,
+                               int32_t* s_lamport, int32_t* s_epeer,
+                               int32_t* s_ectr,
+                               int32_t* v_epeer, int32_t* v_ectr,
+                               int32_t* v_lamport, int32_t* v_peer,
+                               int64_t* v_off,
+                               int32_t* d_peer, int64_t* d_start, int64_t* d_end,
+                               long long n_slots, long long n_sets,
+                               long long n_dels) {
+  return movable_walk(buf, len, target_cid, s_parent, s_side, s_peer, s_ctr,
+                      s_lamport, s_epeer, s_ectr, v_epeer, v_ectr, v_lamport,
+                      v_peer, v_off, d_peer, d_start, d_end, n_slots, n_sets,
+                      n_dels, nullptr, nullptr);
+}
+
+// Delta variant: parents that don't resolve inside this payload come
+// back as s_parent == -2 with (s_extpeer, s_extctr) pairs for host
+// resolution against the resident batch's id map (the movable analog
+// of loro_explode_seq_delta's ext-ref protocol).
+long long loro_explode_movable_delta(const uint8_t* buf, long long len, int target_cid,
+                                     int32_t* s_parent, int32_t* s_side,
+                                     int32_t* s_peer, int32_t* s_ctr,
+                                     int32_t* s_lamport, int32_t* s_epeer,
+                                     int32_t* s_ectr,
+                                     int32_t* v_epeer, int32_t* v_ectr,
+                                     int32_t* v_lamport, int32_t* v_peer,
+                                     int64_t* v_off,
+                                     int32_t* d_peer, int64_t* d_start, int64_t* d_end,
+                                     long long n_slots, long long n_sets,
+                                     long long n_dels,
+                                     int32_t* s_extpeer, int64_t* s_extctr) {
+  return movable_walk(buf, len, target_cid, s_parent, s_side, s_peer, s_ctr,
+                      s_lamport, s_epeer, s_ectr, v_epeer, v_ectr, v_lamport,
+                      v_peer, v_off, d_peer, d_start, d_end, n_slots, n_sets,
+                      n_dels, s_extpeer, s_extctr);
 }
 
 }  // extern "C"

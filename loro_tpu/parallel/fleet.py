@@ -2231,14 +2231,9 @@ class DeviceMovableBatch:
         (one block scatter), element winners fold (two donated LWW
         updates).  Staged before validation — capacity errors leave the
         batch untouched."""
-        from ..core.change import MovableMove, MovableSet, SeqDelete, SeqInsert
-        from ..oplog.oplog import _RunCont
-        from ..ops.fugue_batch import pad_bucket
-        from ..ops.lww import lww_update_resident
-
-        # NOTE: the SeqInsert/SeqDelete arms below intentionally mirror
+        # NOTE: _walk_movable_changes intentionally mirrors
         # DeviceDocBatch._python_rows (same parent-resolution and
-        # delete-span contract) but diverge in what they PRODUCE per row
+        # delete-span contract) but diverges in what it PRODUCES per row
         # (element ordinals + move/set fold rows vs content codes) — a
         # shared walk would need per-row callbacks for every arm; the
         # differential fuzzers pin both walks to the host engine.
@@ -2251,90 +2246,246 @@ class DeviceMovableBatch:
         staged_vals: List[list] = []
         del_pairs: List[Tuple[int, int]] = []
         for di, changes in enumerate(per_doc_changes):
-            rows: list = []
-            overlay: Dict[Tuple[int, int], int] = {}
-            mrows: list = []
-            srows: list = []
-            e_staged: Dict = {}
-            e_order: list = []
-            v_staged: list = []
-            rows_per_doc.append(rows)
-            overlays.append(overlay)
-            move_rows.append(mrows)
-            set_rows.append(srows)
-            staged_elems.append(e_order)
-            staged_vals.append(v_staged)
+            rows, overlay, mrows, srows, e_staged, e_order, v_staged = self._stage_doc(
+                rows_per_doc, overlays, move_rows, set_rows, staged_elems, staged_vals
+            )
             if not changes:
+                continue
+            self._walk_movable_changes(
+                di, changes, cid, rows, overlay, mrows, srows,
+                e_staged, e_order, v_staged, del_pairs,
+            )
+        self._commit_movable(
+            rows_per_doc, overlays, move_rows, set_rows,
+            staged_elems, staged_vals, del_pairs,
+        )
+
+    @staticmethod
+    def _stage_doc(rows_per_doc, overlays, move_rows, set_rows, staged_elems, staged_vals):
+        """Allocate + register one doc's staging structures (shared by
+        both ingest entry points so they commit identical shapes)."""
+        rows: list = []
+        overlay: Dict[Tuple[int, int], int] = {}
+        mrows: list = []
+        srows: list = []
+        e_staged: Dict = {}
+        e_order: list = []
+        v_staged: list = []
+        rows_per_doc.append(rows)
+        overlays.append(overlay)
+        move_rows.append(mrows)
+        set_rows.append(srows)
+        staged_elems.append(e_order)
+        staged_vals.append(v_staged)
+        return rows, overlay, mrows, srows, e_staged, e_order, v_staged
+
+    def _elem_registrar(self, di, e_staged, e_order):
+        """Staged element-ordinal lookup shared by BOTH ingest paths —
+        the numbering must stay in lockstep with the commit loop."""
+        eids = self.elem_ids[di]
+
+        def eidx(eid):
+            i = eids.get(eid)
+            if i is None:
+                i = e_staged.get(eid)
+            if i is None:
+                i = len(eids) + len(e_order)
+                e_staged[eid] = i
+                e_order.append(eid)
+            return i
+
+        return eidx
+
+    def _walk_movable_changes(
+        self, di, changes, cid, rows, overlay, mrows, srows,
+        e_staged, e_order, v_staged, del_pairs,
+    ) -> None:
+        """Per-doc python change walk (also the append_payloads
+        fallback): produces slot rows + move/set fold rows + staged
+        element/value registrations."""
+        from ..core.change import MovableMove, MovableSet, SeqDelete, SeqInsert
+        from ..oplog.oplog import _RunCont
+
+        idmap = self.seq.id2row[di]
+        base = int(self.seq.counts[di])
+        n_vals = len(self.values[di])
+        eidx = self._elem_registrar(di, e_staged, e_order)
+
+        def vidx(v):
+            v_staged.append(v)
+            return n_vals + len(v_staged) - 1
+
+        def resolve(key):
+            r = overlay.get(key)
+            return idmap[key] if r is None else r
+
+        def resolve_parent(c, peer, counter):
+            if isinstance(c.parent, _RunCont):
+                return resolve((peer, counter - 1))
+            if c.parent is None:
+                return -1
+            return resolve((c.parent.peer, c.parent.counter))
+
+        for ch in changes:
+            for op in ch.ops:
+                if op.container != cid:
+                    continue
+                c = op.content
+                lam = ch.lamport + (op.counter - ch.ctr_start)
+                if isinstance(c, SeqInsert):
+                    body = c.content
+                    for j in range(len(body)):
+                        if j == 0:
+                            prow = resolve_parent(c, ch.peer, op.counter)
+                            side = int(c.side)
+                        else:
+                            prow = base + len(rows) - 1
+                            side = 1
+                        row = base + len(rows)
+                        eid = (ch.peer, op.counter + j)
+                        ei = eidx(eid)
+                        overlay[eid] = row
+                        rows.append((prow, side, op.counter + j, ei, ch.peer))
+                        mrows.append((ei, lam + j, ch.peer, row))
+                        srows.append((ei, lam + j, ch.peer, vidx(body[j])))
+                elif isinstance(c, MovableMove):
+                    prow = resolve_parent(c, ch.peer, op.counter)
+                    row = base + len(rows)
+                    ei = eidx((c.elem.peer, c.elem.counter))
+                    overlay[(ch.peer, op.counter)] = row
+                    rows.append((prow, int(c.side), op.counter, ei, ch.peer))
+                    mrows.append((ei, lam, ch.peer, row))
+                elif isinstance(c, MovableSet):
+                    ei = eidx((c.elem.peer, c.elem.counter))
+                    srows.append((ei, lam, ch.peer, vidx(c.value)))
+                elif isinstance(c, SeqDelete):
+                    for sp in c.spans:
+                        for ctr in range(sp.start, sp.end):
+                            try:
+                                del_pairs.append((di, resolve((sp.peer, ctr))))
+                            except KeyError:
+                                pass  # outside this batch's history
+
+    def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]], cid) -> None:
+        """Incremental NATIVE ingest: envelope-stripped payloads -> C++
+        movable delta explode (cross-epoch slot parents resolved through
+        the seq batch's id maps via the ext-ref protocol) -> one block
+        scatter + two donated folds.  Falls back to the Python walk per
+        unresolvable payload."""
+        from ..codec.binary import decode_changes, read_tables
+        from ..native import available, decode_value_at, explode_movable_delta_payload
+
+        if not available():
+            self.append_changes(
+                [decode_changes(p) if p else None for p in per_doc_payloads], cid
+            )
+            return
+        per_doc_payloads = list(per_doc_payloads) + [None] * (
+            self.d - len(per_doc_payloads)
+        )
+        rows_per_doc: List[list] = []
+        overlays: List[Dict[Tuple[int, int], int]] = []
+        move_rows: List[list] = []
+        set_rows: List[list] = []
+        staged_elems: List[list] = []
+        staged_vals: List[list] = []
+        del_pairs: List[Tuple[int, int]] = []
+        for di, payload in enumerate(per_doc_payloads):
+            rows, overlay, mrows, srows, e_staged, e_order, v_staged = self._stage_doc(
+                rows_per_doc, overlays, move_rows, set_rows, staged_elems, staged_vals
+            )
+            if not payload:
                 continue
             idmap = self.seq.id2row[di]
             base = int(self.seq.counts[di])
-            eids = self.elem_ids[di]
             n_vals = len(self.values[di])
+            n_dels_start = len(del_pairs)
+            eidx = self._elem_registrar(di, e_staged, e_order)
 
-            def eidx(eid):
-                i = eids.get(eid)
-                if i is None:
-                    i = e_staged.get(eid)
-                if i is None:
-                    i = len(eids) + len(e_order)
-                    e_staged[eid] = i
-                    e_order.append(eid)
-                return i
+            # NOTE: per-row python loop (vs the seq analog's vectorized
+            # fast path) — movable epochs are move/set-dominated and
+            # small; vectorize like DeviceDocBatch.append_payloads if a
+            # full-history movable ingest ever shows up hot
+            try:
+                peers_wire, _keys, cids, _r = read_tables(payload)
+                try:
+                    target = cids.index(cid)
+                except ValueError:
+                    continue  # no ops for this container
+                out = explode_movable_delta_payload(payload, target)
+                sl = out["slots"]
+                n = len(sl["parent"])
+                for i in range(n):
+                    prow = int(sl["parent"][i])
+                    if prow >= 0:
+                        prow = base + prow
+                    elif prow == -2:  # cross-epoch parent: id-map lookup
+                        key = (
+                            int(peers_wire[int(sl["ext_peer_idx"][i])]),
+                            int(sl["ext_counter"][i]),
+                        )
+                        r_ = overlay.get(key)
+                        prow = idmap[key] if r_ is None else r_
+                    peer = int(peers_wire[int(sl["peer_idx"][i])])
+                    ctr_v = int(sl["counter"][i])
+                    ei = eidx(
+                        (int(peers_wire[int(sl["elem_peer_idx"][i])]), int(sl["elem_ctr"][i]))
+                    )
+                    row = base + i
+                    overlay[(peer, ctr_v)] = row
+                    rows.append((prow, int(sl["side"][i]), ctr_v, ei, peer))
+                    mrows.append((ei, int(sl["lamport"][i]), peer, row))
+                st = out["sets"]
+                for i in range(len(st["lamport"])):
+                    ei = eidx(
+                        (int(peers_wire[int(st["elem_peer_idx"][i])]), int(st["elem_ctr"][i]))
+                    )
+                    v_staged.append(
+                        decode_value_at(payload, int(st["value_off"][i]), cids)
+                    )
+                    srows.append(
+                        (
+                            ei,
+                            int(st["lamport"][i]),
+                            int(peers_wire[int(st["peer_idx"][i])]),
+                            n_vals + len(v_staged) - 1,
+                        )
+                    )
+                dl = out["dels"]
+                for i in range(len(dl["peer_idx"])):
+                    dp = int(peers_wire[int(dl["peer_idx"][i])])
+                    for ctr_v in range(int(dl["start"][i]), int(dl["end"][i])):
+                        row = overlay.get((dp, ctr_v))
+                        if row is None:
+                            row = idmap.get((dp, ctr_v))
+                        if row is not None:
+                            del_pairs.append((di, row))
+            except (KeyError, ValueError):
+                rows.clear()
+                overlay.clear()
+                mrows.clear()
+                srows.clear()
+                e_staged.clear()
+                e_order.clear()
+                v_staged.clear()
+                del del_pairs[n_dels_start:]
+                self._walk_movable_changes(
+                    di, decode_changes(payload), cid, rows, overlay, mrows,
+                    srows, e_staged, e_order, v_staged, del_pairs,
+                )
+        self._commit_movable(
+            rows_per_doc, overlays, move_rows, set_rows,
+            staged_elems, staged_vals, del_pairs,
+        )
 
-            def vidx(v):
-                v_staged.append(v)
-                return n_vals + len(v_staged) - 1
+    def _commit_movable(
+        self, rows_per_doc, overlays, move_rows, set_rows,
+        staged_elems, staged_vals, del_pairs,
+    ) -> None:
+        """Shared tail: validate, commit registrations, scatter + folds."""
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.lww import lww_update_resident
 
-            def resolve(key):
-                r = overlay.get(key)
-                return idmap[key] if r is None else r
-
-            def resolve_parent(c, peer, counter):
-                if isinstance(c.parent, _RunCont):
-                    return resolve((peer, counter - 1))
-                if c.parent is None:
-                    return -1
-                return resolve((c.parent.peer, c.parent.counter))
-
-            for ch in changes:
-                for op in ch.ops:
-                    if op.container != cid:
-                        continue
-                    c = op.content
-                    lam = ch.lamport + (op.counter - ch.ctr_start)
-                    if isinstance(c, SeqInsert):
-                        body = c.content
-                        for j in range(len(body)):
-                            if j == 0:
-                                prow = resolve_parent(c, ch.peer, op.counter)
-                                side = int(c.side)
-                            else:
-                                prow = base + len(rows) - 1
-                                side = 1
-                            row = base + len(rows)
-                            eid = (ch.peer, op.counter + j)
-                            ei = eidx(eid)
-                            overlay[eid] = row
-                            rows.append((prow, side, op.counter + j, ei, ch.peer))
-                            mrows.append((ei, lam + j, ch.peer, row))
-                            srows.append((ei, lam + j, ch.peer, vidx(body[j])))
-                    elif isinstance(c, MovableMove):
-                        prow = resolve_parent(c, ch.peer, op.counter)
-                        row = base + len(rows)
-                        ei = eidx((c.elem.peer, c.elem.counter))
-                        overlay[(ch.peer, op.counter)] = row
-                        rows.append((prow, int(c.side), op.counter, ei, ch.peer))
-                        mrows.append((ei, lam, ch.peer, row))
-                    elif isinstance(c, MovableSet):
-                        ei = eidx((c.elem.peer, c.elem.counter))
-                        srows.append((ei, lam, ch.peer, vidx(c.value)))
-                    elif isinstance(c, SeqDelete):
-                        for sp in c.spans:
-                            for ctr in range(sp.start, sp.end):
-                                try:
-                                    del_pairs.append((di, resolve((sp.peer, ctr))))
-                                except KeyError:
-                                    pass  # outside this batch's history
         # validate BEFORE mutating (element capacity; the seq batch
         # validates row capacity in _commit_rows before ITS mutation)
         for di in range(self.d):

@@ -1334,3 +1334,62 @@ class TestTreePayloadIngest:
                     if ch:
                         host_kids[t] = ch
                 assert kids[i] == host_kids, f"seed {seed} epoch {epoch} doc {i}"
+
+
+class TestMovablePayloadIngest:
+    """DeviceMovableBatch.append_payloads: native C++ movable delta
+    explode (ext-ref protocol for cross-epoch slot parents)."""
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_payload_epochs_match_host(self, seed, monkeypatch):
+        from loro_tpu.doc import strip_envelope
+        from loro_tpu.native import available
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        rng = random.Random(80 + seed)
+        pairs = []
+        for i in range(2):
+            a, b = LoroDoc(peer=2 * i + 1), LoroDoc(peer=2 * i + 2)
+            a.get_movable_list("ml").push("s0", "s1", "s2")
+            b.import_(a.export_snapshot())
+            pairs.append((a, b))
+        cid = pairs[0][0].get_movable_list("ml").id
+        batch = DeviceMovableBatch(n_docs=2, capacity=2048, elem_capacity=256)
+
+        def boom(*a, **k):
+            raise AssertionError("python fallback must not run")
+
+        monkeypatch.setattr(batch, "_walk_movable_changes", boom)
+        marks = [a.oplog_vv() for a, _ in pairs]
+        batch.append_payloads(
+            [strip_envelope(a.export_updates(None)) for a, _ in pairs], cid
+        )
+        for epoch in range(3):
+            for a, b in pairs:
+                for d in (a, b):
+                    ml = d.get_movable_list("ml")
+                    L = len(ml)
+                    r = rng.random()
+                    if L == 0 or r < 0.3:
+                        ml.insert(rng.randint(0, L), f"v{rng.randrange(99)}")
+                    elif r < 0.5 and L >= 2:
+                        ml.move(rng.randrange(L), rng.randrange(L))
+                    elif r < 0.7:
+                        ml.set(rng.randrange(L), {"w": rng.randrange(99)})
+                    else:
+                        ml.delete(rng.randrange(L), 1)
+                    d.commit()
+                a.import_(b.export_updates(a.oplog_vv()))
+                b.import_(a.export_updates(b.oplog_vv()))
+                assert a.get_deep_value() == b.get_deep_value()
+            ups = []
+            for i, (a, _) in enumerate(pairs):
+                ups.append(strip_envelope(a.export_updates(marks[i])))
+                marks[i] = a.oplog_vv()
+            batch.append_payloads(ups, cid)
+            got = batch.value_lists()
+            for i, (a, _) in enumerate(pairs):
+                want = a.get_movable_list("ml").get_value()
+                assert got[i] == want, f"seed {seed} epoch {epoch} doc {i}"

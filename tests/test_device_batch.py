@@ -1393,3 +1393,31 @@ class TestMovablePayloadIngest:
             for i, (a, _) in enumerate(pairs):
                 want = a.get_movable_list("ml").get_value()
                 assert got[i] == want, f"seed {seed} epoch {epoch} doc {i}"
+
+    def test_checkpoint_after_payload_ingest(self):
+        """export/import after NATIVE payload ingest (all decoded state
+        must serialize; the restored batch keeps appending payloads)."""
+        from loro_tpu.doc import strip_envelope
+        from loro_tpu.native import available
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        if not available():
+            pytest.skip("native codec unavailable")
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("ml")
+        ml.push("a", {"b": 1}, "c")
+        ml.move(2, 0)
+        doc.commit()
+        cid = ml.id
+        batch = DeviceMovableBatch(n_docs=1, capacity=256, elem_capacity=64)
+        batch.append_payloads([strip_envelope(doc.export_updates(None))], cid)
+        restored = DeviceMovableBatch.import_state(batch.export_state())
+        assert restored.value_lists() == [ml.get_value()]
+        mark = doc.oplog_vv()
+        ml.set(0, "Z")
+        ml.delete(2, 1)
+        doc.commit()
+        restored.append_payloads(
+            [strip_envelope(doc.export_updates(mark))], cid
+        )
+        assert restored.value_lists() == [ml.get_value()]

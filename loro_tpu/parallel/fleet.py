@@ -618,6 +618,24 @@ class Fleet:
         return self._map_winner_values(np.asarray(vi), extracts)
 
 
+def _resolve_row(overlay, idmap, key, di, what):
+    """Overlay-then-idmap row lookup that raises a typed, actionable
+    error for unknown ids (shared by every resident ingest walk)."""
+    r = overlay.get(key)
+    if r is not None:
+        return r
+    try:
+        return idmap[key]
+    except KeyError:
+        from ..errors import LoroError
+
+        raise LoroError(
+            f"doc {di}: {what} references unknown element {key} — resident "
+            "batches need every doc's FULL history from its first epoch "
+            "(feed the base import before deltas)"
+        ) from None
+
+
 class DeviceDocBatch:
     """Device-resident document batch with incremental ingest.
 
@@ -699,19 +717,22 @@ class DeviceDocBatch:
         rows_per_doc: List[List[Tuple[int, int, int, int, int]]] = []
         overlays: List[Dict[Tuple[int, int], int]] = []
         anchor_stages: List[Dict[Tuple[int, int], dict]] = []
+        value_stages: List[list] = []
         del_pairs: List[Tuple[int, int]] = []
         for di, changes in enumerate(per_doc_changes):
             rows: List[Tuple[int, int, int, int, int]] = []
             overlay: Dict[Tuple[int, int], int] = {}
             stage: Dict[Tuple[int, int], dict] = {}
+            vstage: list = []
             rows_per_doc.append(rows)
             overlays.append(overlay)
             anchor_stages.append(stage)
+            value_stages.append(vstage)
             if changes:
-                self._python_rows(di, changes, cid, rows, overlay, del_pairs, stage)
-        self._commit_rows(rows_per_doc, overlays, del_pairs, anchor_stages)
+                self._python_rows(di, changes, cid, rows, overlay, del_pairs, stage, vstage)
+        self._commit_rows(rows_per_doc, overlays, del_pairs, anchor_stages, value_stages)
 
-    def _python_rows(self, di, changes, cid, rows, overlay, del_pairs, anchor_stage) -> None:
+    def _python_rows(self, di, changes, cid, rows, overlay, del_pairs, anchor_stage, value_stage) -> None:
         """Pure-Python op walk producing (parent,side,counter,content,
         peer) rows + delete pairs + staged anchor metadata for one doc
         (also the fallback for the native delta path)."""
@@ -720,10 +741,10 @@ class DeviceDocBatch:
 
         base = int(self.counts[di])
         idmap = self.id2row[di]
+        n_vals = len(self.value_store[di])
 
         def resolve(key):
-            r = overlay.get(key)
-            return idmap[key] if r is None else r
+            return _resolve_row(overlay, idmap, key, di, "op parent")
 
         for ch in changes:
             for op in ch.ops:
@@ -761,18 +782,22 @@ class DeviceDocBatch:
                         elif self.as_text:
                             content = ord(body[j])
                         else:
-                            content = len(self.value_store[di])
-                            self.value_store[di].append(body[j])
+                            content = n_vals + len(value_stage)
+                            value_stage.append(body[j])
                         rows.append((prow, side, op.counter + j, content, ch.peer))
                 elif isinstance(c, SeqDelete):
+                    # deletes tolerate unknown targets (same as the
+                    # native paths): a missing target means the insert
+                    # is missing too, which the parent resolution flags
                     for sp in c.spans:
                         for ctr in range(sp.start, sp.end):
-                            try:
-                                del_pairs.append((di, resolve((sp.peer, ctr))))
-                            except KeyError:
-                                pass  # target outside this batch's history
+                            row_d = overlay.get((sp.peer, ctr))
+                            if row_d is None:
+                                row_d = idmap.get((sp.peer, ctr))
+                            if row_d is not None:
+                                del_pairs.append((di, row_d))
 
-    def _commit_rows(self, rows_per_doc, overlays, del_pairs, anchor_stages=None) -> None:
+    def _commit_rows(self, rows_per_doc, overlays, del_pairs, anchor_stages=None, value_stages=None) -> None:
         """Shared tail: validate capacity, commit staged id maps +
         anchor metadata, block-scatter new rows, tombstone deletes
         (append_changes and append_payloads both end here)."""
@@ -800,6 +825,9 @@ class DeviceDocBatch:
                 self.anchor_by_row[di].update(
                     {a["row"]: pc for pc, a in stage.items()}
                 )
+        for di, vs in enumerate(value_stages or ()):
+            if vs:
+                self.value_store[di].extend(vs)
         if max_new:
             from .order_maintenance import split_keys
 
@@ -926,14 +954,17 @@ class DeviceDocBatch:
         rows_per_doc: List[list] = []
         overlays: List[Dict[Tuple[int, int], int]] = []
         anchor_stages: List[Dict[Tuple[int, int], dict]] = []
+        value_stages: List[list] = []
         del_pairs: List[Tuple[int, int]] = []
         for di, payload in enumerate(per_doc_payloads):
             rows: list = []
             overlay: Dict[Tuple[int, int], int] = {}
             stage: Dict[Tuple[int, int], dict] = {}
+            vstage: list = []
             rows_per_doc.append(rows)
             overlays.append(overlay)
             anchor_stages.append(stage)
+            value_stages.append(vstage)
             if not payload:
                 continue
             n_dels_start = len(del_pairs)
@@ -1005,11 +1036,13 @@ class DeviceDocBatch:
                 rows.clear()
                 overlay.clear()
                 stage.clear()
+                vstage.clear()
                 del del_pairs[n_dels_start:]
                 self._python_rows(
-                    di, decode_changes(payload), cid, rows, overlay, del_pairs, stage
+                    di, decode_changes(payload), cid, rows, overlay, del_pairs,
+                    stage, vstage,
                 )
-        self._commit_rows(rows_per_doc, overlays, del_pairs, anchor_stages)
+        self._commit_rows(rows_per_doc, overlays, del_pairs, anchor_stages, value_stages)
 
     def mark_deleted(self, pairs: Sequence[Tuple[int, int]]) -> None:
         """Tombstone (doc, device_row) pairs (delete ops referencing
@@ -2316,8 +2349,7 @@ class DeviceMovableBatch:
             return n_vals + len(v_staged) - 1
 
         def resolve(key):
-            r = overlay.get(key)
-            return idmap[key] if r is None else r
+            return _resolve_row(overlay, idmap, key, di, "movable op parent")
 
         def resolve_parent(c, peer, counter):
             if isinstance(c.parent, _RunCont):
@@ -2359,12 +2391,16 @@ class DeviceMovableBatch:
                     ei = eidx((c.elem.peer, c.elem.counter))
                     srows.append((ei, lam, ch.peer, vidx(c.value)))
                 elif isinstance(c, SeqDelete):
+                    # deletes tolerate unknown targets (same as the
+                    # native paths): a missing target means the insert
+                    # is missing too, which the parent resolution flags
                     for sp in c.spans:
                         for ctr in range(sp.start, sp.end):
-                            try:
-                                del_pairs.append((di, resolve((sp.peer, ctr))))
-                            except KeyError:
-                                pass  # outside this batch's history
+                            row_d = overlay.get((sp.peer, ctr))
+                            if row_d is None:
+                                row_d = idmap.get((sp.peer, ctr))
+                            if row_d is not None:
+                                del_pairs.append((di, row_d))
 
     def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]], cid) -> None:
         """Incremental NATIVE ingest: envelope-stripped payloads -> C++

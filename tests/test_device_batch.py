@@ -1421,3 +1421,28 @@ class TestMovablePayloadIngest:
             [strip_envelope(doc.export_updates(mark))], cid
         )
         assert restored.value_lists() == [ml.get_value()]
+
+
+class TestResidentErrorSurface:
+    def test_missing_base_raises_typed_error(self):
+        """Feeding a delta without the base import raises LoroError with
+        an actionable message (was a raw KeyError), and the failed walk
+        leaks no staged values (list batches)."""
+        from loro_tpu import LoroError
+        from loro_tpu.parallel.fleet import DeviceDocBatch
+
+        a = LoroDoc(peer=1)
+        a.get_list("l").push("v0")
+        a.commit()
+        mark = a.oplog_vv()
+        a.get_list("l").push("v1")
+        a.commit()
+        batch = DeviceDocBatch(1, 256, as_text=False)
+        with pytest.raises(LoroError, match="FULL history"):
+            batch.append_changes(
+                [a.oplog.changes_between(mark, a.oplog_vv())], a.get_list("l").id
+            )
+        assert batch.value_store[0] == []  # no orphan values leaked
+        # the batch stays usable with the correct feeding order
+        batch.append_changes([a.oplog.changes_in_causal_order()], a.get_list("l").id)
+        assert batch.values() == [a.get_list("l").get_value()]

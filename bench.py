@@ -37,6 +37,7 @@ this trace (~130ms for 260k ops) — and publish an explicit x2 band
 """
 import json
 import os
+import re
 import sys
 import time
 
@@ -89,6 +90,20 @@ def _final_record() -> dict:
     """Assemble the ONE output line from the checkpoint state."""
     ck = dict(_CKPT)
     return assemble_record(ck)
+
+
+def _ambient_fields(rec: dict) -> dict:
+    """Attach wedge info + ambient load to a record (r4 verdict weak #7:
+    cross-round CPU comparisons are load-confounded).  setdefault only —
+    a child-measured load is more truthful than a parent re-measurement."""
+    wi = os.environ.get("BENCH_WEDGE_INFO")
+    if wi:
+        rec.setdefault("wedge_info", wi)
+    try:
+        rec.setdefault("load_avg_1m", round(os.getloadavg()[0], 2))
+    except OSError:
+        pass
+    return rec
 
 
 def assemble_record(ck: dict) -> dict:
@@ -145,10 +160,7 @@ def assemble_record(ck: dict) -> dict:
     ):
         if k in ck and ck[k] is not None:
             rec[k] = ck[k]
-    wi = os.environ.get("BENCH_WEDGE_INFO")
-    if wi:
-        rec["wedge_info"] = wi
-    return rec
+    return _ambient_fields(rec)
 
 
 def _emit_simple(metric: str, ops_per_sec: float, extras: dict | None = None) -> None:
@@ -163,7 +175,7 @@ def _emit_simple(metric: str, ops_per_sec: float, extras: dict | None = None) ->
     }
     if extras:
         rec.update(extras)
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(_ambient_fields(rec)), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -729,13 +741,20 @@ def main() -> None:
             t_rank_net = max(t_rank_m - rtt, 1e-4)
             t_full_net = max(t_full_m - rtt, 1e-4)
             t_place_net = max(t_full_net - t_rank_net, 1e-4)
-            gather_rows_meas = rank_rounds * m_ring * chunk / t_rank_net
+            # the per-round HBM-gather row model only describes the xla
+            # ranking path; the pallas ring rides VMEM (no per-round HBM
+            # gathers), so a "measured gather rate" would be meaningless
+            gather_rows_meas = (
+                rank_rounds * m_ring * chunk / t_rank_net if impl == "xla" else None
+            )
             ach_gbps = place_bytes * chunk / t_place_net / 1e9
             bank(
                 "roofline_measured",
                 rank_ms_measured=round(t_rank_net * 1e3, 1),
                 place_ms_measured=round(t_place_net * 1e3, 1),
-                gather_rows_per_sec_measured=round(gather_rows_meas),
+                gather_rows_per_sec_measured=(
+                    round(gather_rows_meas) if gather_rows_meas is not None else None
+                ),
                 achieved_hbm_gbps_measured=round(ach_gbps, 1),
                 hbm_frac=round(ach_gbps * 1e9 / peak, 4) if peak else None,
                 roofline_measured_note=(
@@ -925,34 +944,153 @@ def _tunnel_alive(timeout_s: float = 75.0) -> bool:
         return False
 
 
+def _child_log_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_children.log"
+    )
+
+
+def _last_json_record(path: str) -> dict | None:
+    """Last line of `path` that parses as a JSON object with a 'metric'
+    key.  Scans backwards so a child that printed diagnostics after its
+    record can't corrupt the result."""
+    try:
+        with open(path, "rb") as f:
+            text = f.read().decode("utf-8", "replace")
+    except OSError:
+        return None
+    for line in reversed([ln for ln in text.splitlines() if ln.strip()]):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+    return None
+
+
+def _emit_terminal_failure(reason: str) -> None:
+    """The parent's last-resort record: the driver must ALWAYS get one
+    parseable JSON line, even when both the device run and the CPU
+    fallback produced nothing (round-4 post-mortem: parsed=null)."""
+    cfg = os.environ.get("BENCH_CONFIG", "text")
+    metric = (
+        "ops_merged_per_sec_per_chip [bench_failed]"
+        if cfg == "text"
+        else f"{cfg}_bench [bench_failed]"
+    )
+    rec = {
+        "metric": metric,
+        "value": 0,
+        "unit": "ops/s",
+        "vs_baseline": 0.0,
+        "failure": reason,
+    }
+    if cfg == "text":
+        rec["baseline_band"] = BASELINE_BAND
+        rec["baseline_note"] = BASELINE_NOTE
+    print(json.dumps(_ambient_fields(rec)), flush=True)
+
+
+def _run_capture_child(
+    env: dict, timeout_s: int, out_path: str
+) -> tuple[dict | None, int | None]:
+    """Spawn a bench child with stdout -> out_path and stderr -> the
+    shared child log, wait up to timeout_s, and return (the child's
+    JSON record or None, its return code or None on timeout).  The
+    child is NEVER signaled: it may hold an in-flight TPU launch or
+    compile, and signaling those wedges the axon tunnel for the whole
+    session (CLAUDE.md).  On timeout it is simply abandoned in its own
+    session."""
+    import subprocess
+
+    with open(out_path, "wb") as out, open(_child_log_path(), "ab") as log:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            stdout=out,
+            stderr=log,
+            start_new_session=True,
+        )
+    rc: int | None = None
+    try:
+        proc.wait(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        pass  # abandon without signals
+    return _last_json_record(out_path), rc
+
+
 def main_guarded() -> None:
     """Run main() in a subprocess with a watchdog.  The child banks an
     incremental checkpoint after every phase; on timeout the parent
     emits the newest banked device measurement instead of discarding
-    the run.  CPU fallback happens ONLY when no device number exists."""
+    the run.  CPU fallback happens ONLY when no device number exists.
+
+    Artifact contract (round-4 post-mortem): the parent is the ONLY
+    process ever writing to the real stdout/stderr, every child's
+    streams go to files, and the parent's last line is ALWAYS a JSON
+    record — no abandoned child can pollute the driver's capture
+    25 minutes after the parent exits."""
+    import glob
     import subprocess
 
-    if os.environ.get("BENCH_CONFIG", "text") != "text":
-        # secondary configs print their own JSON line; plain watchdog
-        env2 = dict(os.environ, BENCH_INNER="1")
-        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)], env=env2)
+    base = os.path.dirname(os.path.abspath(__file__))
+    for stale in glob.glob(os.path.join(base, ".bench_out_*.jsonl")):
+        # only reap files whose embedded owner pid is dead — a live pid
+        # means a CONCURRENT invocation (e.g. the watcher ladder) whose
+        # parent will still read this path by name
+        m = re.search(r"_(\d+)\.jsonl$", stale)
         try:
-            proc.wait(timeout=int(os.environ.get("BENCH_TIMEOUT", "780")))
-        except subprocess.TimeoutExpired:
-            proc.terminate()
-            try:
-                proc.wait(timeout=60)
-            except subprocess.TimeoutExpired:
-                pass
+            if m:
+                os.kill(int(m.group(1)), 0)  # raises if pid is gone
+                continue
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue  # pid exists but not ours; leave it alone
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    if os.environ.get("BENCH_CONFIG", "text") != "text":
+        # secondary configs: child prints its own JSON; parent captures,
+        # validates, and re-emits it (never signals — the child may be
+        # mid-TPU-launch)
+        env2 = dict(os.environ, BENCH_INNER="1")
+        # pid-unique path: an abandoned child from a PREVIOUS invocation
+        # may still hold an fd to a shared name and write its late record
+        # into OUR capture (the stdout twin of the r4 stderr post-mortem)
+        out_path = os.path.join(
+            base, f".bench_out_{os.environ['BENCH_CONFIG']}_{os.getpid()}.jsonl"
+        )
+        rec, rc = _run_capture_child(
+            env2, int(os.environ.get("BENCH_TIMEOUT", "780")), out_path
+        )
+        if rec is not None:
+            print(json.dumps(_ambient_fields(rec)), flush=True)
+        else:
+            how = (
+                "timed out (child abandoned unsignaled)"
+                if rc is None
+                else f"exited rc={rc}"
+            )
+            _emit_terminal_failure(
+                f"secondary config {os.environ['BENCH_CONFIG']} produced no "
+                f"JSON: {how}"
+            )
         return
 
     ckpt = os.environ.get("BENCH_CHECKPOINT") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".bench_checkpoint.json"
     )
-    try:
-        os.unlink(ckpt)
-    except FileNotFoundError:
-        pass
+    for stale in (ckpt, ckpt + ".cpu"):
+        # ckpt+".cpu" too: a stale banked fallback from a previous run
+        # must never be emitted as THIS run's measurement
+        try:
+            os.unlink(stale)
+        except FileNotFoundError:
+            pass
 
     timeout_s = int(os.environ.get("BENCH_TIMEOUT", "780"))
     env = dict(os.environ, BENCH_INNER="1", BENCH_CHECKPOINT=ckpt)
@@ -978,13 +1116,18 @@ def main_guarded() -> None:
         )
     else:
         # child stdout -> devnull: the parent is the only JSON emitter
-        # (the child's record arrives via the checkpoint file)
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            stdout=subprocess.DEVNULL,
-            start_new_session=True,  # survives parent exit if abandoned
-        )
+        # (the child's record arrives via the checkpoint file).  stderr
+        # -> log file, NOT inherited: an abandoned child dumping its
+        # backend-init traceback ~25 min later must never reach the
+        # driver's captured stream (round-4 post-mortem: parsed=null).
+        with open(_child_log_path(), "ab") as _log:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=_log,
+                start_new_session=True,  # survives parent exit if abandoned
+            )
         rc = None
         try:
             proc.wait(timeout=timeout_s)
@@ -1044,26 +1187,47 @@ def main_guarded() -> None:
             fallback_reason = (
                 f"device child failed rc={rc} after phase "
                 f"{ck.get('last_phase') if ck else None}"
+                + ("" if ck else " — backend init raised: pool down?")
             )
             print(f"bench: device run failed rc={rc}; cpu fallback", file=sys.stderr)
     env_cpu = dict(env, JAX_PLATFORMS="cpu", BENCH_LABEL="cpu_fallback")
+    # mirror into the parent's environ too: assemble_record/_ambient_fields
+    # read these when the PARENT emits a record from the .cpu checkpoint
+    os.environ["BENCH_LABEL"] = "cpu_fallback"
     if fallback_reason:
         env_cpu["BENCH_WEDGE_INFO"] = fallback_reason
+        os.environ["BENCH_WEDGE_INFO"] = fallback_reason
     env_cpu["BENCH_CHECKPOINT"] = ckpt + ".cpu"
     env_cpu.setdefault("BENCH_BUDGET", "180")
     # histogram placement measures ~7% faster than the sort formulation
     # on the 1-core CPU fallback (the TPU default stays sort: measured
     # 2x the other way on v5e); both are differential-tested equal
     env_cpu.setdefault("PLACE_ALGO", "scatter")
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)], env=env_cpu)
-    try:
-        proc.wait(timeout=int(os.environ.get("BENCH_TIMEOUT", "780")))
-    except subprocess.TimeoutExpired:
-        proc.terminate()
+    rec, cpu_rc = _run_capture_child(
+        env_cpu,
+        int(os.environ.get("BENCH_TIMEOUT", "780")),
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f".bench_out_cpu_{os.getpid()}.jsonl",
+        ),
+    )
+    if rec is not None:
+        print(json.dumps(_ambient_fields(rec)), flush=True)
+    else:
+        ck_cpu = None
         try:
-            proc.wait(timeout=60)
-        except subprocess.TimeoutExpired:
+            with open(ckpt + ".cpu") as f:
+                ck_cpu = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
             pass
+        how = "timed out" if cpu_rc is None else f"exited rc={cpu_rc}"
+        if ck_cpu and ck_cpu.get("value"):
+            ck_cpu.setdefault("partial", f"cpu fallback {how}; banked checkpoint")
+            print(json.dumps(assemble_record(ck_cpu)), flush=True)
+        else:
+            _emit_terminal_failure(
+                f"cpu fallback produced no JSON ({how}) and banked no value"
+            )
 
 
 if __name__ == "__main__":

@@ -1303,6 +1303,13 @@ class DeviceDocBatch:
                         peer = peers[pi]
                         ctr_ = r.zigzag()
                         row = r.varint()
+                        if row >= k:
+                            # an out-of-range anchor row would silently
+                            # clip into wrong style positions in
+                            # richtexts(); reject like value ordinals
+                            raise DecodeError(
+                                "DeviceDocBatch state: anchor row out of range"
+                            )
                         key = r.str_()
                         val = _read_value(r, cids) if r.u8() == 1 else None
                         lam = r.varint()

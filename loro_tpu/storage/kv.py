@@ -254,6 +254,13 @@ class MemKvStore:
         flush(pending)
 
         meta_off = len(w.buf)
+        if meta_off >= 2**32:
+            # the v1 trailer is a fixed u32le; block metas are varints,
+            # so only the trailer caps the format at 4 GiB of blocks
+            raise ValueError(
+                f"LTKV v1 store exceeds the 4 GiB trailer limit "
+                f"(blocks span {meta_off} bytes); split the store"
+            )
         w.varint(len(metas))
         for off, ln, large, first, last in metas:
             w.varint(off)

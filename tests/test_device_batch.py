@@ -985,6 +985,35 @@ class TestResidentCheckpoint:
         with pytest.raises(DecodeError):
             DeviceDocBatch.import_state(bytes(blob))
 
+    def test_corrupt_anchor_row_raises(self):
+        """Advisor r4: an anchor whose row ordinal exceeds the doc's row
+        count must raise DecodeError, not silently clip style positions."""
+        from loro_tpu.codec.binary import Reader
+        from loro_tpu.errors import DecodeError
+        from loro_tpu.parallel.fleet import DeviceDocBatch
+        from loro_tpu.storage import MemKvStore
+
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "styled")
+        t.mark(0, 3, "bold", True)
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=128)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], t.id)
+        kv = MemKvStore()
+        kv.import_all(batch.export_state())
+        anch = bytearray(kv.get(b"doc/00000000/anchors"))
+        r = Reader(bytes(anch))
+        assert r.varint() >= 1  # at least one anchor present
+        r.varint()  # peer index
+        r.zigzag()  # counter
+        row_off = r.i
+        assert anch[row_off] < 0x80  # single-byte varint, patchable in place
+        anch[row_off] = 0x7F  # row 127 >= count
+        kv.set(b"doc/00000000/anchors", bytes(anch))
+        with pytest.raises(DecodeError, match="anchor row"):
+            DeviceDocBatch.import_state(kv.export_all())
+
     def test_nested_container_values_roundtrip(self):
         """Regression (review finding): values holding non-root
         ContainerIDs must round-trip — the cid table's peers register

@@ -1036,11 +1036,13 @@ def main_guarded() -> None:
     import subprocess
 
     base = os.path.dirname(os.path.abspath(__file__))
-    for stale in glob.glob(os.path.join(base, ".bench_out_*.jsonl")):
+    for stale in glob.glob(os.path.join(base, ".bench_out_*.jsonl")) + glob.glob(
+        os.path.join(base, ".bench_checkpoint_*.json*")
+    ):
         # only reap files whose embedded owner pid is dead — a live pid
         # means a CONCURRENT invocation (e.g. the watcher ladder) whose
         # parent will still read this path by name
-        m = re.search(r"_(\d+)\.jsonl$", stale)
+        m = re.search(r"_(\d+)\.(?:jsonl|json(?:\.cpu)?)$", stale)
         try:
             if m:
                 os.kill(int(m.group(1)), 0)  # raises if pid is gone
@@ -1081,12 +1083,14 @@ def main_guarded() -> None:
             )
         return
 
+    # pid-unique: an abandoned unsignaled child from a PREVIOUS run may
+    # unwedge minutes later and bank ITS phases — a shared checkpoint
+    # name would let run 1's measurement surface as run 2's result
     ckpt = os.environ.get("BENCH_CHECKPOINT") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".bench_checkpoint.json"
+        os.path.dirname(os.path.abspath(__file__)),
+        f".bench_checkpoint_{os.getpid()}.json",
     )
     for stale in (ckpt, ckpt + ".cpu"):
-        # ckpt+".cpu" too: a stale banked fallback from a previous run
-        # must never be emitted as THIS run's measurement
         try:
             os.unlink(stale)
         except FileNotFoundError:

@@ -153,6 +153,40 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,
             ctypes.c_longlong,
         ] + [ctypes.c_void_p] * 4 + [ctypes.c_longlong, ctypes.c_void_p]
+        lib.loro_idmap_new.restype = ctypes.c_void_p
+        lib.loro_idmap_new.argtypes = []
+        lib.loro_idmap_free.restype = None
+        lib.loro_idmap_free.argtypes = [ctypes.c_void_p]
+        lib.loro_idmap_len.restype = ctypes.c_longlong
+        lib.loro_idmap_len.argtypes = [ctypes.c_void_p]
+        lib.loro_idmap_insert.restype = None
+        lib.loro_idmap_insert.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_longlong,
+        ] + [ctypes.c_void_p] * 3
+        lib.loro_idmap_stage.restype = None
+        lib.loro_idmap_stage.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_longlong,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int,
+        ]
+        lib.loro_idmap_commit.restype = None
+        lib.loro_idmap_commit.argtypes = [ctypes.c_void_p]
+        lib.loro_idmap_abort.restype = None
+        lib.loro_idmap_abort.argtypes = [ctypes.c_void_p]
+        lib.loro_idmap_lookup.restype = None
+        lib.loro_idmap_lookup.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_longlong,
+        ] + [ctypes.c_void_p] * 3
+        lib.loro_idmap_get.restype = ctypes.c_longlong
+        lib.loro_idmap_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_longlong,
+        ]
         _lib = lib
         return lib
 
@@ -541,15 +575,23 @@ class NativeShadowOrder:
         return int(self._lib.loro_order_nrows(self._h))
 
     def append_rows(self, rows, base_row: int):
-        k = len(rows)
         parent = np.asarray([r[0] for r in rows], np.int32)
         side = np.asarray([r[1] for r in rows], np.int32)
         peer = np.asarray([r[2] for r in rows], np.uint64)
         ctr = np.asarray([r[3] for r in rows], np.int64)
-        out = np.empty(k, np.int64)
+        return self.append_arrays(parent, side, peer, ctr, base_row)
+
+    def append_arrays(self, parent, side, peer, ctr, base_row: int):
+        """Columnar append (the hot resident-ingest path — no Python
+        tuple round trip).  Same return contract as append_rows."""
+        parent = np.ascontiguousarray(parent, np.int32)
+        side = np.ascontiguousarray(side, np.int32)
+        peer = np.ascontiguousarray(peer, np.uint64)
+        ctr = np.ascontiguousarray(ctr, np.int64)
+        out = np.empty(len(parent), np.int64)
         rc = self._lib.loro_order_append(
             self._h,
-            k,
+            len(parent),
             parent.ctypes.data_as(ctypes.c_void_p),
             side.ctypes.data_as(ctypes.c_void_p),
             peer.ctypes.data_as(ctypes.c_void_p),
@@ -575,3 +617,107 @@ def native_order():
     if lib is None:
         return None
     return NativeShadowOrder(lib)
+
+
+class NativeIdMap:
+    """C++ (peer, counter) -> device-row map with the staging contract
+    the resident batches need (stage / staged-aware lookup / commit |
+    abort) plus the dict-like subset the Python fallback paths use.
+    Bit-compatible drop-in for the per-doc id2row dicts — the per-row
+    Python dict traffic was the r4 host-funnel cost center."""
+
+    __slots__ = ("_lib", "_h")
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.loro_idmap_new()
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.loro_idmap_free(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.loro_idmap_len(self._h))
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    # -- dict-like subset (fallback walks, resolve_row) ---------------
+    def get(self, key, default=None):
+        r = self._lib.loro_idmap_get(
+            self._h, ctypes.c_uint64(key[0]), ctypes.c_longlong(key[1])
+        )
+        return default if r < 0 else int(r)
+
+    def __getitem__(self, key):
+        r = self.get(key)
+        if r is None:
+            raise KeyError(key)
+        return r
+
+    def __contains__(self, key) -> bool:
+        return self.get(key) is not None
+
+    def update(self, d) -> None:
+        """Committed bulk insert from a Python dict (fallback-path
+        overlay commits)."""
+        if not d:
+            return
+        n = len(d)
+        peer = np.fromiter((k[0] for k in d), np.uint64, n)
+        ctr = np.fromiter((k[1] for k in d), np.int64, n)
+        rows = np.fromiter(d.values(), np.int32, n)
+        self.insert_arrays(peer, ctr, rows)
+
+    # -- columnar hot path --------------------------------------------
+    def insert_arrays(self, peer, ctr, rows) -> None:
+        peer = np.ascontiguousarray(peer, np.uint64)
+        ctr = np.ascontiguousarray(ctr, np.int64)
+        rows = np.ascontiguousarray(rows, np.int32)
+        self._lib.loro_idmap_insert(
+            self._h,
+            len(peer),
+            peer.ctypes.data_as(ctypes.c_void_p),
+            ctr.ctypes.data_as(ctypes.c_void_p),
+            rows.ctypes.data_as(ctypes.c_void_p),
+        )
+
+    def stage_base(self, peer, ctr, base_row: int) -> None:
+        peer = np.ascontiguousarray(peer, np.uint64)
+        ctr = np.ascontiguousarray(ctr, np.int64)
+        self._lib.loro_idmap_stage(
+            self._h,
+            len(peer),
+            peer.ctypes.data_as(ctypes.c_void_p),
+            ctr.ctypes.data_as(ctypes.c_void_p),
+            base_row,
+        )
+
+    def lookup(self, peer, ctr) -> np.ndarray:
+        """Staged-first batch lookup; -1 = missing."""
+        peer = np.ascontiguousarray(peer, np.uint64)
+        ctr = np.ascontiguousarray(ctr, np.int64)
+        out = np.empty(len(peer), np.int32)
+        self._lib.loro_idmap_lookup(
+            self._h,
+            len(peer),
+            peer.ctypes.data_as(ctypes.c_void_p),
+            ctr.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
+
+    def commit(self) -> None:
+        self._lib.loro_idmap_commit(self._h)
+
+    def abort(self) -> None:
+        self._lib.loro_idmap_abort(self._h)
+
+
+def native_idmap():
+    lib = _load()
+    if lib is None:
+        return None
+    return NativeIdMap(lib)

@@ -1152,6 +1152,11 @@ long long loro_explode_movable_delta(const uint8_t* buf, long long len, int targ
 namespace order {
 
 constexpr int64_t KEY_STEP = 1ll << 20;
+// Run continuations take a small low-biased step instead of the gap
+// midpoint (mirrors order_maintenance.py RUN_STEP — the two engines
+// must stay bit-identical): a typing run consumes L*RUN_STEP of the
+// gap instead of halving it L times.
+constexpr int64_t RUN_STEP = 1ll << 8;
 constexpr int32_t HEAD = -2;
 
 struct Doc {
@@ -1209,7 +1214,7 @@ struct Doc {
     if (succ >= 0) prev[succ] = row;
   }
 
-  bool assign_key(int32_t row) {
+  bool assign_key(int32_t row, bool run) {
     int32_t p = prev[row], s = next[row];
     if (p < 0 && s < 0) key[row] = 0;
     else if (p < 0) key[row] = key[s] - KEY_STEP;
@@ -1217,7 +1222,9 @@ struct Doc {
     else {
       int64_t lo = key[p], hi = key[s];
       if (hi - lo < 2) return false;
-      key[row] = lo + (hi - lo) / 2;
+      int64_t step = (hi - lo) / 2;
+      if (run && step > RUN_STEP) step = RUN_STEP;
+      key[row] = lo + step;
     }
     return true;
   }
@@ -1251,14 +1258,16 @@ struct Doc {
     return it->second;
   }
 
-  void place(int32_t parent_row, int32_t side, int32_t row) {
+  // Returns true on the run-continuation fast path (caller assigns a
+  // low-biased key so runs don't bisect the gap).
+  bool place(int32_t parent_row, int32_t side, int32_t row) {
     // run-continuation fast path
     if (parent_row >= 0 && side == 1 && spine[parent_row] < 0 &&
         branches.find(((uint64_t)parent_row << 1) | 1) == branches.end() &&
         peer[parent_row] == peer[row] && ctr[parent_row] == ctr[row] - 1) {
       spine[parent_row] = row;
       splice_after(parent_row, row);
-      return;
+      return true;
     }
     auto& sibs = sibling_list(parent_row, side);
     uint64_t mp = peer[row];
@@ -1280,6 +1289,7 @@ struct Doc {
         splice_after(prev[old_first], row);
       }
     }
+    return false;
   }
 };
 
@@ -1300,6 +1310,107 @@ void loro_order_all_keys(void* h, int64_t* out) {
   for (int64_t i = 0; i < d->n(); i++) out[i] = d->key[i];
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native id map: per-doc (peer u64, counter i64) -> device row.  The
+// resident batches resolve cross-epoch parents/deletes and register
+// every ingested row here; doing it per-row in Python dicts was the
+// host-funnel cost center (r4 verdict #5).  Staging mirrors the
+// Python-side contract: stage -> lookup (staged shadows main) ->
+// commit | abort, so a capacity error leaves the map untouched.
+
+namespace idmap {
+
+struct Key {
+  uint64_t peer;
+  int64_t ctr;
+  bool operator==(const Key& o) const { return peer == o.peer && ctr == o.ctr; }
+};
+
+struct KeyHash {
+  size_t operator()(const Key& k) const {
+    uint64_t x = k.peer ^ (uint64_t)k.ctr * 0x9E3779B97F4A7C15ull;
+    x ^= x >> 30; x *= 0xBF58476D1CE4E5B9ull;
+    x ^= x >> 27; x *= 0x94D049BB133111EBull;
+    return (size_t)(x ^ (x >> 31));
+  }
+};
+
+struct Map {
+  std::unordered_map<Key, int32_t, KeyHash> main, staged;
+};
+
+}  // namespace idmap
+
+extern "C" {
+
+void* loro_idmap_new() { return new idmap::Map(); }
+void loro_idmap_free(void* h) { delete (idmap::Map*)h; }
+
+long long loro_idmap_len(void* h) {
+  return (long long)((idmap::Map*)h)->main.size();
+}
+
+// Committed inserts with explicit rows (import_state, fallback-path
+// overlay commits).
+void loro_idmap_insert(void* h, long long n, const uint64_t* peer,
+                       const int64_t* ctr, const int32_t* rows) {
+  auto* m = (idmap::Map*)h;
+  m->main.reserve(m->main.size() + (size_t)n);
+  for (long long i = 0; i < n; i++) m->main[{peer[i], ctr[i]}] = rows[i];
+}
+
+// Stage n new rows at base_row..base_row+n-1 (visible to lookups,
+// not committed).
+void loro_idmap_stage(void* h, long long n, const uint64_t* peer,
+                      const int64_t* ctr, int32_t base_row) {
+  auto* m = (idmap::Map*)h;
+  m->staged.reserve(m->staged.size() + (size_t)n);
+  for (long long i = 0; i < n; i++)
+    m->staged[{peer[i], ctr[i]}] = base_row + (int32_t)i;
+}
+
+void loro_idmap_commit(void* h) {
+  auto* m = (idmap::Map*)h;
+  m->main.reserve(m->main.size() + m->staged.size());
+  for (auto& kv : m->staged) m->main[kv.first] = kv.second;
+  m->staged.clear();
+}
+
+void loro_idmap_abort(void* h) { ((idmap::Map*)h)->staged.clear(); }
+
+// Batch lookup, staged-first (matches the overlay-then-idmap order of
+// the Python paths); -1 = missing.
+void loro_idmap_lookup(void* h, long long n, const uint64_t* peer,
+                       const int64_t* ctr, int32_t* out) {
+  auto* m = (idmap::Map*)h;
+  for (long long i = 0; i < n; i++) {
+    idmap::Key k{peer[i], ctr[i]};
+    auto it = m->staged.find(k);
+    if (it == m->staged.end()) {
+      it = m->main.find(k);
+      if (it == m->main.end()) { out[i] = -1; continue; }
+    }
+    out[i] = it->second;
+  }
+}
+
+long long loro_idmap_get(void* h, uint64_t peer, int64_t ctr) {
+  auto* m = (idmap::Map*)h;
+  idmap::Key k{peer, ctr};
+  auto it = m->staged.find(k);
+  if (it == m->staged.end()) {
+    it = m->main.find(k);
+    if (it == m->main.end()) return -1;
+  }
+  return it->second;
+}
+
+}  // extern "C"
+
+extern "C" {
+
 // Place k rows (parent_row, side, peer, ctr) at indexes base_row..;
 // fills out_keys.  Returns 0, 1 when a renumber happened (caller
 // re-uploads all keys), or -1 on a non-contiguous base.
@@ -1318,8 +1429,8 @@ long long loro_order_append(void* h, long long k, const int32_t* parent,
     d->next.push_back(-1);
     d->spine.push_back(-1);
     d->key.push_back(0);
-    d->place(parent[j], side[j], row);
-    if (!d->assign_key(row)) {
+    bool run = d->place(parent[j], side[j], row);
+    if (!d->assign_key(row, run)) {
       d->renumber();
       renumbered = true;
     }

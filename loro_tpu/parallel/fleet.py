@@ -618,6 +618,27 @@ class Fleet:
         return self._map_winner_values(np.asarray(vi), extracts)
 
 
+def _pad_axis1(arrays: Dict[str, "jax.Array"], new_n: int, fills: Dict[str, object], sh) -> Dict[str, "jax.Array"]:
+    """Re-pad (d, n) device arrays to (d, new_n) with per-field fills —
+    the repack half of the resident grow path.  Host round trip: growth
+    is rare (power-of-two buckets) and the simple path is shape-safe."""
+    out = {}
+    for f, a in arrays.items():
+        h = np.asarray(a)
+        nh = np.full((h.shape[0], new_n), fills[f], h.dtype)
+        nh[:, : h.shape[1]] = h
+        out[f] = jax.device_put(nh, sh)
+    return out
+
+
+def _grow_target(required: int, current: int) -> int:
+    """Next power-of-two-style bucket >= required, at least 2x current
+    (avoids repeated small regrows)."""
+    from ..ops.fugue_batch import pad_bucket
+
+    return pad_bucket(required, floor=max(16, 2 * current))
+
+
 def _resolve_row(overlay, idmap, key, di, what):
     """Overlay-then-idmap row lookup that raises a typed, actionable
     error for unknown ids (shared by every resident ingest walk)."""
@@ -646,10 +667,13 @@ class DeviceDocBatch:
     appended rows land in the buffer tail, not in (peer, counter) order.
     """
 
-    def __init__(self, n_docs: int, capacity: int, mesh=None, as_text: bool = True):
+    def __init__(self, n_docs: int, capacity: int, mesh=None, as_text: bool = True,
+                 auto_grow: bool = False):
         """as_text=False holds List containers: contents become per-doc
         value ordinals (host keeps the value stores) and values() is the
-        materializer instead of texts()."""
+        materializer instead of texts().  auto_grow=True repacks the
+        batch to the next capacity bucket instead of raising when an
+        append overflows (long-lived server lifecycle)."""
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_docs = n_docs
         d_mesh = self.mesh.shape[DOC_AXIS]
@@ -657,10 +681,15 @@ class DeviceDocBatch:
         n_docs = self.d
         self.cap = capacity
         self.as_text = as_text
+        self.auto_grow = auto_grow
         self._c_pad = 256  # chain budget (doubles on overflow)
         self.counts = np.zeros(n_docs, np.int64)  # used rows per doc
-        # host-side id -> row resolution per doc
-        self.id2row: List[Dict[Tuple[int, int], int]] = [dict() for _ in range(n_docs)]
+        # host-side id -> row resolution per doc (C++ hash map when the
+        # native lib is available; batch stage/lookup/commit contract —
+        # see parallel/idmap.py)
+        from .idmap import make_idmap
+
+        self.id2row = [make_idmap() for _ in range(n_docs)]
         self.value_store: List[List] = [[] for _ in range(n_docs)]
         # richtext: per-doc style-anchor metadata ((peer, ctr) -> dict)
         # + device-row backmap so delete tombstones deactivate pairs
@@ -706,6 +735,37 @@ class DeviceDocBatch:
         self.key_lo = z(np.uint32, 0xFFFFFFFF)
 
     # ------------------------------------------------------------------
+    def grow(self, new_capacity: int) -> None:
+        """Repack the resident columns to a larger row capacity (device
+        re-pad; order engines, id maps, counts and host metadata are
+        capacity-independent).  Part of the resident lifecycle: a
+        long-lived server grows instead of dying at the initial bucket
+        (r4 verdict #6).  Reference analog: the reference re-allocates
+        its tracker arenas as docs grow (crates/loro-internal/src/
+        container/richtext/tracker.rs)."""
+        if new_capacity <= self.cap:
+            return
+        sh = doc_sharding(self.mesh)
+        fills = dict(
+            parent=-1, side=0, peer_hi=0, peer_lo=0, counter=0,
+            deleted=True, content=-1, valid=False,
+        )
+        cols = _pad_axis1(
+            {f: getattr(self.cols, f) for f in self.cols._fields},
+            new_capacity, fills, sh,
+        )
+        from ..ops.fugue_batch import SeqColumnsU
+
+        self.cols = SeqColumnsU(**cols)
+        keys = _pad_axis1(
+            {"key_hi": self.key_hi, "key_lo": self.key_lo},
+            new_capacity,
+            {"key_hi": 0xFFFFFFFF, "key_lo": 0xFFFFFFFF},
+            sh,
+        )
+        self.key_hi, self.key_lo = keys["key_hi"], keys["key_lo"]
+        self.cap = new_capacity
+
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
         """Incremental ingest: each doc's new causally-ordered changes
         (None = no update).  Inserts (chars AND style anchors — anchors
@@ -800,24 +860,41 @@ class DeviceDocBatch:
     def _commit_rows(self, rows_per_doc, overlays, del_pairs, anchor_stages=None, value_stages=None) -> None:
         """Shared tail: validate capacity, commit staged id maps +
         anchor metadata, block-scatter new rows, tombstone deletes
-        (append_changes and append_payloads both end here)."""
+        (append_changes and append_payloads both end here).  Per-doc
+        entries are either tuple lists (Python walks) or column dicts
+        (the native fast path, ids staged in the idmap: overlays[di] is
+        None and commit/abort goes through the map's staging)."""
         from ..ops.fugue_batch import pad_bucket
 
-        max_new = pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16) if any(
-            rows_per_doc
-        ) else 0
+        def n_of(r) -> int:
+            return len(r["parent"]) if isinstance(r, dict) else len(r)
+
+        n_new = [n_of(r) for r in rows_per_doc]
+        max_new = pad_bucket(max(n_new, default=0), floor=16) if any(n_new) else 0
         # validate BEFORE mutating: the scatter window is max_new wide,
         # so every updated doc needs base + max_new <= capacity
         # (dynamic_update_slice would silently clamp otherwise)
-        for di, rows in enumerate(rows_per_doc):
-            if rows and int(self.counts[di]) + max_new > self.cap:
+        required = max(
+            (int(self.counts[di]) + max_new for di, k in enumerate(n_new) if k),
+            default=0,
+        )
+        if required > self.cap:
+            if self.auto_grow:
+                self.grow(_grow_target(required, self.cap))
+            else:
+                for dj, ov in enumerate(overlays):
+                    if ov is None:
+                        self.id2row[dj].abort()
                 raise RuntimeError(
-                    f"DeviceDocBatch capacity exceeded for doc {di}: "
-                    f"{self.counts[di]} + {max_new} > {self.cap}"
+                    f"DeviceDocBatch capacity exceeded: a doc needs "
+                    f"{required} rows > {self.cap} (pass auto_grow=True "
+                    "or call grow())"
                 )
         # commit staged id maps + anchor metadata
         for di, overlay in enumerate(overlays):
-            if overlay:
+            if overlay is None:
+                self.id2row[di].commit()
+            elif overlay:
                 self.id2row[di].update(overlay)
         for di, stage in enumerate(anchor_stages or ()):
             if stage:
@@ -854,20 +931,30 @@ class DeviceDocBatch:
                 shard across threads.  Returns True when the doc's keys
                 were renumbered (caller re-uploads the whole key row)."""
                 rows = rows_per_doc[di]
-                k = len(rows)
                 base = int(self.counts[di])
-                arr = np.asarray([(r[0], r[1], r[2], r[3]) for r in rows], np.int64)
-                pu = np.asarray([r[4] for r in rows], np.uint64)
-                blk["parent"][di, :k] = arr[:, 0]
-                blk["side"][di, :k] = arr[:, 1]
+                if isinstance(rows, dict):
+                    k = len(rows["parent"])
+                    parent, side_a = rows["parent"], rows["side"]
+                    ctr_a, content_a = rows["counter"], rows["content"]
+                    pu = rows["peer"]
+                else:
+                    k = len(rows)
+                    arr = np.asarray(
+                        [(r[0], r[1], r[2], r[3]) for r in rows], np.int64
+                    )
+                    pu = np.asarray([r[4] for r in rows], np.uint64)
+                    parent, side_a = arr[:, 0], arr[:, 1]
+                    ctr_a, content_a = arr[:, 2], arr[:, 3]
+                blk["parent"][di, :k] = parent
+                blk["side"][di, :k] = side_a
                 blk["peer_hi"][di, :k] = (pu >> np.uint64(32)).astype(np.uint32)
                 blk["peer_lo"][di, :k] = (pu & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-                blk["counter"][di, :k] = arr[:, 2]
+                blk["counter"][di, :k] = ctr_a
                 blk["deleted"][di, :k] = False
-                blk["content"][di, :k] = arr[:, 3]
+                blk["content"][di, :k] = content_a
                 blk["valid"][di, :k] = True
-                keys = self.order[di].append_rows(
-                    [(r[0], r[1], int(r[4]), r[2]) for r in rows], base
+                keys = self.order[di].append_arrays(
+                    parent, side_a, pu, ctr_a, base
                 )
                 renum = keys is None
                 if not renum:
@@ -878,7 +965,7 @@ class DeviceDocBatch:
                 self.counts[di] += k
                 return renum
 
-            active = [di for di, rows in enumerate(rows_per_doc) if rows]
+            active = [di for di, k in enumerate(n_new) if k]
             # thread fan-out only pays when the order engine is the
             # native one (ctypes releases the GIL); the Python
             # ShadowOrder fallback would serialize through the GIL and
@@ -915,18 +1002,34 @@ class DeviceDocBatch:
                 jax.device_put(offsets, replicated(self.mesh)),
             )
             self.cols, self.key_hi, self.key_lo = packed
-            # renumbered docs: re-upload the whole key row (rare).
-            # Fixed [cap]-shaped row updates — a :n slice set would
-            # compile a fresh scatter per distinct n (measured as a
-            # compile storm in tests/soak_fleet.py)
-            for di in renumbered:
-                kh, kl = split_keys(self.order[di].all_keys())
-                kh_full = np.full(self.cap, 0xFFFFFFFF, np.uint32)
-                kl_full = np.full(self.cap, 0xFFFFFFFF, np.uint32)
-                kh_full[: len(kh)] = kh
-                kl_full[: len(kl)] = kl
-                self.key_hi = self.key_hi.at[di].set(jnp.asarray(kh_full))
-                self.key_lo = self.key_lo.at[di].set(jnp.asarray(kl_full))
+            # renumbered docs: re-upload whole key rows in ONE jitted
+            # scatter (the per-doc eager .at[di].set dispatch was ~half
+            # of warm epoch time — r5 profile).  Fixed [cap]-wide rows
+            # + bucket-padded doc count bound retraces; pad entries
+            # repeat doc renumbered[0]'s row (idempotent writes).
+            if renumbered:
+                nb = pad_bucket(len(renumbered), floor=4)
+                kh_rows = np.empty((nb, self.cap), np.uint32)
+                kl_rows = np.empty((nb, self.cap), np.uint32)
+                d_idx = np.empty(nb, np.int32)
+                for i in range(nb):
+                    di = renumbered[i] if i < len(renumbered) else renumbered[0]
+                    d_idx[i] = di
+                    if i < len(renumbered):
+                        kh, kl = split_keys(self.order[di].all_keys())
+                        kh_rows[i, : len(kh)] = kh
+                        kl_rows[i, : len(kl)] = kl
+                        kh_rows[i, len(kh):] = 0xFFFFFFFF
+                        kl_rows[i, len(kl):] = 0xFFFFFFFF
+                    else:
+                        kh_rows[i] = kh_rows[0]
+                        kl_rows[i] = kl_rows[0]
+                self.key_hi, self.key_lo = _set_key_rows(
+                    (self.key_hi, self.key_lo),
+                    jnp.asarray(d_idx),
+                    jnp.asarray(kh_rows),
+                    jnp.asarray(kl_rows),
+                )
         self.mark_deleted(del_pairs)
 
     def append_payloads(self, per_doc_payloads: Sequence[Optional[bytes]], cid) -> None:
@@ -951,6 +1054,25 @@ class DeviceDocBatch:
             )
             return
         per_doc_payloads = list(per_doc_payloads) + [None] * (self.d - len(per_doc_payloads))
+        try:
+            self._append_payloads_staged(per_doc_payloads, cid)
+        except BaseException:
+            # ANY escaping error must roll back native-staged ids: the
+            # C++ maps are long-lived, and a later commit would publish
+            # phantom (peer, ctr) -> row mappings for rows that were
+            # never scattered (post-commit aborts are no-ops)
+            for di in range(self.d):
+                self.id2row[di].abort()
+            raise
+
+    def _append_payloads_staged(self, per_doc_payloads, cid) -> None:
+        from ..codec.binary import decode_changes, read_tables
+        from ..native import (
+            decode_value_at,
+            explode_seq_anchor_meta,
+            explode_seq_delta_payload,
+        )
+
         rows_per_doc: List[list] = []
         overlays: List[Dict[Tuple[int, int], int]] = []
         anchor_stages: List[Dict[Tuple[int, int], dict]] = []
@@ -983,34 +1105,38 @@ class DeviceDocBatch:
                     anchor_cols = explode_seq_anchor_meta(payload, target)
                 base = int(self.counts[di])
                 idmap = self.id2row[di]
-                n = len(out["parent"])
-                # vectorized common case; only ext rows loop in python
-                prow_arr = np.where(out["parent"] >= 0, base + out["parent"], out["parent"])
+                # columnar end-to-end: the id registrations ride the
+                # native map's staging (committed in _commit_rows), ext
+                # parents and delete spans resolve in TWO batch lookups
+                # — no per-row Python dict/tuple traffic (r4 verdict #5)
+                peers_np = np.asarray(peers_wire, np.uint64)
+                peer_u64 = peers_np[out["peer_idx"]]
+                ctr64 = out["counter"].astype(np.int64)
+                idmap.stage_base(peer_u64, ctr64, base)
+                prow_arr = np.where(
+                    out["parent"] >= 0, base + out["parent"], out["parent"]
+                ).astype(np.int32)
                 ext_rows = np.flatnonzero(out["parent"] == -2)
-                peer_arr = np.asarray([peers_wire[i] for i in out["peer_idx"]], dtype=object)
-                ctr_list = out["counter"].tolist()
-                overlay.update(
-                    zip(zip(peer_arr.tolist(), ctr_list), range(base, base + n))
-                )
-                for j in ext_rows.tolist():
-                    key = (peers_wire[out["ext_peer_idx"][j]], int(out["ext_counter"][j]))
-                    prow = overlay.get(key)
-                    if prow is None:
-                        prow = idmap[key]
-                    prow_arr[j] = prow
-                rows.extend(
-                    zip(
-                        prow_arr.tolist(),
-                        out["side"].tolist(),
-                        ctr_list,
-                        out["content"].tolist(),
-                        peer_arr.tolist(),
+                if len(ext_rows):
+                    res = idmap.lookup(
+                        peers_np[out["ext_peer_idx"][ext_rows]],
+                        out["ext_counter"][ext_rows],
                     )
-                )
+                    if (res < 0).any():
+                        raise KeyError("unresolved cross-epoch parent")
+                    prow_arr[ext_rows] = res
+                rows_per_doc[di] = {
+                    "parent": prow_arr,
+                    "side": out["side"],
+                    "counter": out["counter"],
+                    "content": out["content"],
+                    "peer": peer_u64,
+                }
+                overlays[di] = None  # marker: ids staged in the idmap
                 if anchor_cols is not None:
                     for ai in range(len(anchor_cols["row"])):
                         rrow = int(anchor_cols["row"][ai])
-                        a_peer = peers_wire[int(out["peer_idx"][rrow])]
+                        a_peer = int(peer_u64[rrow])
                         stage[(a_peer, int(out["counter"][rrow]))] = {
                             "row": base + rrow,
                             "key": _keys[int(anchor_cols["key_idx"][ai])],
@@ -1022,19 +1148,27 @@ class DeviceDocBatch:
                             "start": bool(anchor_cols["flags"][ai] & 1),
                             "deleted": False,
                         }
-                for k in range(len(out["del_peer_idx"])):
-                    dp = peers_wire[out["del_peer_idx"][k]]
-                    for ctr in range(int(out["del_start"][k]), int(out["del_end"][k])):
-                        row = overlay.get((dp, ctr))
-                        if row is None:
-                            row = idmap.get((dp, ctr))
-                        if row is not None:
-                            del_pairs.append((di, row))
+                lens = (out["del_end"] - out["del_start"]).astype(np.int64)
+                tot = int(lens.sum())
+                if tot:
+                    dp = np.repeat(peers_np[out["del_peer_idx"]], lens)
+                    offs = np.repeat(np.cumsum(lens) - lens, lens)
+                    dctr = np.arange(tot, dtype=np.int64) - offs + np.repeat(
+                        out["del_start"], lens
+                    )
+                    drows = idmap.lookup(dp, dctr)
+                    # deletes tolerate unknown targets (as the walks do)
+                    drows = drows[drows >= 0]
+                    if len(drows):
+                        del_pairs.append((di, drows))
             except (KeyError, ValueError):
                 # unresolvable refs or malformed input for the native
                 # path: python fallback for this payload only
+                self.id2row[di].abort()
                 rows.clear()
+                rows_per_doc[di] = rows
                 overlay.clear()
+                overlays[di] = overlay
                 stage.clear()
                 vstage.clear()
                 del del_pairs[n_dels_start:]
@@ -1044,23 +1178,47 @@ class DeviceDocBatch:
                 )
         self._commit_rows(rows_per_doc, overlays, del_pairs, anchor_stages, value_stages)
 
-    def mark_deleted(self, pairs: Sequence[Tuple[int, int]]) -> None:
-        """Tombstone (doc, device_row) pairs (delete ops referencing
-        earlier appends).  Padded to buckets (idempotent repeats of the
-        first pair) to bound retraces."""
+    def mark_deleted(self, pairs) -> None:
+        """Tombstone (doc, rows) entries (delete ops referencing earlier
+        appends).  Each entry is (doc, row) or (doc, row_ndarray) — the
+        columnar ingest path ships whole per-doc delete chunks.  Padded
+        to buckets (idempotent repeats of the first pair) to bound
+        retraces."""
         from ..ops.fugue_batch import pad_bucket
 
         if not pairs:
             return
+        d_parts: List[np.ndarray] = []
+        r_parts: List[np.ndarray] = []
         for di, row in pairs:  # deactivate style pairs whose anchor died
-            pc = self.anchor_by_row[di].get(row)
-            if pc is not None:
-                self.anchor_meta[di][pc]["deleted"] = True
-        k = pad_bucket(len(pairs), floor=16)
-        padded = list(pairs) + [pairs[0]] * (k - len(pairs))
-        d_idx = np.asarray([p[0] for p in padded], np.int32)
-        r_idx = np.asarray([p[1] for p in padded], np.int32)
-        deleted = self.cols.deleted.at[(jnp.asarray(d_idx), jnp.asarray(r_idx))].set(True)
+            abr = self.anchor_by_row[di]
+            if isinstance(row, np.ndarray):
+                if abr:  # anchors are rare; skip the loop when none
+                    for rr in row.tolist():
+                        pc = abr.get(rr)
+                        if pc is not None:
+                            self.anchor_meta[di][pc]["deleted"] = True
+                d_parts.append(np.full(len(row), di, np.int32))
+                r_parts.append(row.astype(np.int32))
+            else:
+                pc = abr.get(row)
+                if pc is not None:
+                    self.anchor_meta[di][pc]["deleted"] = True
+                d_parts.append(np.full(1, di, np.int32))
+                r_parts.append(np.full(1, row, np.int32))
+        d_all = np.concatenate(d_parts)
+        r_all = np.concatenate(r_parts)
+        n = len(d_all)
+        if not n:
+            return
+        k = pad_bucket(n, floor=16)
+        d_idx = np.empty(k, np.int32)
+        r_idx = np.empty(k, np.int32)
+        d_idx[:n], r_idx[:n] = d_all, r_all
+        d_idx[n:], r_idx[n:] = d_all[0], r_all[0]
+        deleted = _set_deleted(
+            self.cols.deleted, jnp.asarray(d_idx), jnp.asarray(r_idx)
+        )
         self.cols = self.cols._replace(deleted=deleted)
 
     def resolve_row(self, doc: int, peer: int, counter: int) -> Optional[int]:
@@ -1260,16 +1418,15 @@ class DeviceDocBatch:
                     "peer_lo"
                 ].astype(np.uint64)
                 ctr = arrs["counter"]
-                batch.id2row[di] = {
-                    (int(peer_full[i]), int(ctr[i])): i for i in range(k)
-                }
+                batch.id2row[di].insert_arrays(
+                    peer_full, ctr.astype(np.int64), np.arange(k, dtype=np.int32)
+                )
                 # deterministic order-engine rebuild by replay
                 if k:
-                    replay = [
-                        (int(arrs["parent"][i]), int(arrs["side"][i]), int(peer_full[i]), int(ctr[i]))
-                        for i in range(k)
-                    ]
-                    keys = batch.order[di].append_rows(replay, 0)
+                    keys = batch.order[di].append_arrays(
+                        arrs["parent"], arrs["side"], peer_full,
+                        ctr.astype(np.int64), 0,
+                    )
                     if keys is None:
                         keys = batch.order[di].all_keys()
                     kh, kl = split_keys(np.asarray(keys, np.int64))
@@ -1455,13 +1612,15 @@ class DeviceMapBatch:
     one donated launch; values live host-side as per-doc ordinal lists.
     """
 
-    def __init__(self, n_docs: int, slot_capacity: int, mesh=None):
+    def __init__(self, n_docs: int, slot_capacity: int, mesh=None,
+                 auto_grow: bool = False):
         from ..ops.lww import NEG, LwwResident
 
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_docs = n_docs
         self.d = _mesh_pad(self.mesh, n_docs)
         self.s = slot_capacity
+        self.auto_grow = auto_grow
         sh = doc_sharding(self.mesh)
         z = lambda dt, fill: jax.device_put(np.full((self.d, self.s), fill, dt), sh)
         self.res = LwwResident(
@@ -1472,6 +1631,34 @@ class DeviceMapBatch:
         )
         self.slot_of: List[Dict[Tuple[ContainerID, str], int]] = [dict() for _ in range(self.d)]
         self.values: List[List] = [[] for _ in range(self.d)]
+
+    def grow(self, new_slot_capacity: int) -> None:
+        """Repack the LWW winner columns to a larger slot capacity
+        (resident lifecycle, r4 verdict #6)."""
+        from ..ops.lww import NEG, LwwResident
+
+        if new_slot_capacity <= self.s:
+            return
+        fills = dict(lamport=int(NEG), peer_hi=0, peer_lo=0, value=-2)
+        res = _pad_axis1(
+            {f: getattr(self.res, f) for f in self.res._fields},
+            new_slot_capacity, fills, doc_sharding(self.mesh),
+        )
+        self.res = LwwResident(**res)
+        self.s = new_slot_capacity
+
+    def _require_slots(self, required: int) -> None:
+        """Grow (auto_grow) or raise when a staged append needs more
+        slots than the current capacity."""
+        if required <= self.s:
+            return
+        if self.auto_grow:
+            self.grow(_grow_target(required, self.s))
+        else:
+            raise ValueError(
+                f"DeviceMapBatch slot capacity exceeded ({required} > "
+                f"{self.s}); grow slot_capacity or pass auto_grow=True"
+            )
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]]) -> None:
         from ..core.change import MapSet
@@ -1504,11 +1691,6 @@ class DeviceMapBatch:
                         slot = staged_slots.get(key)
                     if slot is None:
                         slot = len(slot_of) + len(staged_slots)
-                        if slot >= self.s:
-                            raise ValueError(
-                                f"DeviceMapBatch slot capacity exceeded ({self.s}); "
-                                "grow slot_capacity"
-                            )
                         staged_slots[key] = slot
                     lam = ch.lamport + (op.counter - ch.ctr_start)
                     if c.deleted:
@@ -1517,6 +1699,12 @@ class DeviceMapBatch:
                         vi = n_vals0 + len(staged_vals)
                         staged_vals.append(c.value)
                     rows.append((slot, lam, ch.peer, vi))
+        self._require_slots(
+            max(
+                (len(self.slot_of[di]) + len(new_slots[di]) for di in range(self.d)),
+                default=0,
+            )
+        )
         for di in range(self.d):
             self.slot_of[di].update(new_slots[di])
             self.values[di].extend(new_vals[di])
@@ -1561,11 +1749,6 @@ class DeviceMapBatch:
                     slot = staged_slots.get(key)
                 if slot is None:
                     slot = len(slot_of) + len(staged_slots)
-                    if slot >= self.s:
-                        raise ValueError(
-                            f"DeviceMapBatch slot capacity exceeded ({self.s}); "
-                            "grow slot_capacity"
-                        )
                     staged_slots[key] = slot
                 off = int(out["value_offset"][j])
                 if off < 0:
@@ -1577,6 +1760,12 @@ class DeviceMapBatch:
                 rows.append(
                     (slot, int(out["lamport"][j]), out["peer_u64"][j], vi)
                 )
+        self._require_slots(
+            max(
+                (len(self.slot_of[di]) + len(new_slots[di]) for di in range(self.d)),
+                default=0,
+            )
+        )
         for di in range(self.d):
             self.slot_of[di].update(new_slots[di])
             self.values[di].extend(new_vals[di])
@@ -1757,7 +1946,8 @@ class DeviceTreeBatch:
     TreeCacheForDiff keeps the same per-node move sets and re-walks
     them, diff_calc/tree.rs:230-396)."""
 
-    def __init__(self, n_docs: int, move_capacity: int, node_capacity: int, mesh=None):
+    def __init__(self, n_docs: int, move_capacity: int, node_capacity: int, mesh=None,
+                 auto_grow: bool = False):
         from ..ops.tree_batch import ROOT, TreeLogCols
 
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -1765,6 +1955,7 @@ class DeviceTreeBatch:
         self.d = _mesh_pad(self.mesh, n_docs)
         self.cap = move_capacity
         self.node_cap = node_capacity
+        self.auto_grow = auto_grow
         self.counts = np.zeros(self.d, np.int64)
         # per-doc node dictionaries + host move metadata for sibling
         # positions: (lamport, peer, counter, target_ord, is_delete, pos)
@@ -1932,6 +2123,27 @@ class DeviceTreeBatch:
                     p = node_idx(c.parent)
                 rows.append((lam, ch.peer, op.counter, t, p, c.is_delete, c.position))
 
+    def grow(self, move_capacity: int = None, node_capacity: int = None) -> None:
+        """Repack move-log columns and/or raise the node ceiling
+        (resident lifecycle, r4 verdict #6).  node_capacity is a launch
+        parameter (tree_replay_log_batch pads per launch), so that half
+        is a scalar bump."""
+        from ..ops.tree_batch import ROOT, TreeLogCols
+
+        if move_capacity is not None and move_capacity > self.cap:
+            fills = dict(
+                lamport=0, peer_hi=0, peer_lo=0, counter=0, target=0,
+                parent=ROOT, valid=False,
+            )
+            cols = _pad_axis1(
+                {f: getattr(self.cols, f) for f in self.cols._fields},
+                move_capacity, fills, doc_sharding(self.mesh),
+            )
+            self.cols = TreeLogCols(**cols)
+            self.cap = move_capacity
+        if node_capacity is not None and node_capacity > self.node_cap:
+            self.node_cap = node_capacity
+
     def _commit_moves(self, rows_per_doc, staged_nodes) -> None:
         """Shared tail: validate capacities, commit staged nodes, block-
         scatter the new move rows."""
@@ -1944,16 +2156,30 @@ class DeviceTreeBatch:
             else 0
         )
         # validate BEFORE mutating anything
-        for di, rows in enumerate(rows_per_doc):
-            if rows and int(self.counts[di]) + max_new > self.cap:
+        req_moves = max(
+            (int(self.counts[di]) + max_new
+             for di, rows in enumerate(rows_per_doc) if rows),
+            default=0,
+        )
+        req_nodes = max(
+            (len(self.nodes[di]) + len(staged_nodes[di]) for di in range(self.d)),
+            default=0,
+        )
+        if req_moves > self.cap:
+            if self.auto_grow:
+                self.grow(move_capacity=_grow_target(req_moves, self.cap))
+            else:
                 raise RuntimeError(
-                    f"DeviceTreeBatch move capacity exceeded for doc {di}: "
-                    f"{self.counts[di]} + {max_new} > {self.cap}"
+                    f"DeviceTreeBatch move capacity exceeded: a doc needs "
+                    f"{req_moves} rows > {self.cap}"
                 )
-            if len(self.nodes[di]) + len(staged_nodes[di]) > self.node_cap:
+        if req_nodes > self.node_cap:
+            if self.auto_grow:
+                self.grow(node_capacity=_grow_target(req_nodes, self.node_cap))
+            else:
                 raise RuntimeError(
-                    f"DeviceTreeBatch node capacity exceeded for doc {di}: "
-                    f"{len(self.nodes[di])} + {len(staged_nodes[di])} > {self.node_cap}"
+                    f"DeviceTreeBatch node capacity exceeded: a doc needs "
+                    f"{req_nodes} nodes > {self.node_cap}"
                 )
         if not max_new:
             return
@@ -2216,6 +2442,20 @@ def _windowed_scatter_field(col, nbl, vbl, off):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+def _set_key_rows(keys, d_idx, kh_rows, kl_rows):
+    """Replace whole key rows for renumbered docs (donated, one launch
+    for the whole epoch; duplicate pad indices write identical rows)."""
+    key_hi, key_lo = keys
+    return key_hi.at[d_idx].set(kh_rows), key_lo.at[d_idx].set(kl_rows)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _set_deleted(deleted, d_idx, r_idx):
+    """Tombstone (doc, row) pairs in one donated launch."""
+    return deleted.at[d_idx, r_idx].set(True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows(state, blk, offsets):
     """Write each doc's new-row block at its per-doc offset (donated
     update — the old buffer is reused, no [D, N] copy).  `state` is
@@ -2245,14 +2485,18 @@ class DeviceMovableBatch:
     standing key + tombstone (a tombstoned winner hides the element; a
     newer concurrent move revives it), no slot-level re-rank."""
 
-    def __init__(self, n_docs: int, capacity: int, elem_capacity: int, mesh=None):
+    def __init__(self, n_docs: int, capacity: int, elem_capacity: int, mesh=None,
+                 auto_grow: bool = False):
         from ..ops.lww import NEG, LwwResident
 
-        self.seq = DeviceDocBatch(n_docs, capacity, mesh=mesh, as_text=False)
+        self.seq = DeviceDocBatch(
+            n_docs, capacity, mesh=mesh, as_text=False, auto_grow=auto_grow
+        )
         self.mesh = self.seq.mesh
         self.n_docs = n_docs
         self.d = self.seq.d
         self.e_cap = elem_capacity
+        self.auto_grow = auto_grow
         self.elem_ids: List[Dict] = [dict() for _ in range(self.d)]
         self.values: List[list] = [[] for _ in range(self.d)]
         sh = doc_sharding(self.mesh)
@@ -2521,6 +2765,28 @@ class DeviceMovableBatch:
             staged_elems, staged_vals, del_pairs,
         )
 
+    def grow(self, capacity: int = None, elem_capacity: int = None) -> None:
+        """Repack: slot rows grow through the inner seq batch; element
+        winner columns re-pad here (resident lifecycle, r4 verdict #6)."""
+        from ..ops.lww import NEG, LwwResident
+
+        if capacity is not None:
+            self.seq.grow(capacity)
+        if elem_capacity is not None and elem_capacity > self.e_cap:
+            sh = doc_sharding(self.mesh)
+            for name, vfill in (("moves", 0), ("vals", -2)):
+                res = getattr(self, name)
+                fills = dict(lamport=int(NEG), peer_hi=0, peer_lo=0, value=vfill)
+                setattr(
+                    self,
+                    name,
+                    LwwResident(**_pad_axis1(
+                        {f: getattr(res, f) for f in res._fields},
+                        elem_capacity, fills, sh,
+                    )),
+                )
+            self.e_cap = elem_capacity
+
     def _commit_movable(
         self, rows_per_doc, overlays, move_rows, set_rows,
         staged_elems, staged_vals, del_pairs,
@@ -2531,10 +2797,17 @@ class DeviceMovableBatch:
 
         # validate BEFORE mutating (element capacity; the seq batch
         # validates row capacity in _commit_rows before ITS mutation)
-        for di in range(self.d):
-            if len(self.elem_ids[di]) + len(staged_elems[di]) > self.e_cap:
+        req_elems = max(
+            (len(self.elem_ids[di]) + len(staged_elems[di]) for di in range(self.d)),
+            default=0,
+        )
+        if req_elems > self.e_cap:
+            if self.auto_grow:
+                self.grow(elem_capacity=_grow_target(req_elems, self.e_cap))
+            else:
                 raise RuntimeError(
-                    f"DeviceMovableBatch element capacity exceeded for doc {di}"
+                    f"DeviceMovableBatch element capacity exceeded: a doc "
+                    f"needs {req_elems} elements > {self.e_cap}"
                 )
         self.seq._commit_rows(rows_per_doc, overlays, del_pairs)
         # commit staged element/value registrations
@@ -2839,15 +3112,28 @@ class DeviceCounterBatch:
     values match the host's f64 CounterState exactly for integer-valued
     deltas up to 2^24 and to f32 rounding otherwise."""
 
-    def __init__(self, n_docs: int, slot_capacity: int, mesh=None):
+    def __init__(self, n_docs: int, slot_capacity: int, mesh=None,
+                 auto_grow: bool = False):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_docs = n_docs
         self.d = _mesh_pad(self.mesh, n_docs)
         self.s = slot_capacity
+        self.auto_grow = auto_grow
         self.slot_of: List[Dict[ContainerID, int]] = [dict() for _ in range(self.d)]
         self.sums = jax.device_put(
             np.zeros((self.d, self.s), np.float32), doc_sharding(self.mesh)
         )
+
+    def grow(self, new_slot_capacity: int) -> None:
+        """Repack counter sums to a larger slot capacity (resident
+        lifecycle, r4 verdict #6)."""
+        if new_slot_capacity <= self.s:
+            return
+        self.sums = _pad_axis1(
+            {"sums": self.sums}, new_slot_capacity, {"sums": 0.0},
+            doc_sharding(self.mesh),
+        )["sums"]
+        self.s = new_slot_capacity
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]]) -> None:
         from ..core.change import CounterIncr
@@ -2880,10 +3166,17 @@ class DeviceCounterBatch:
                 for op in ch.ops:
                     if isinstance(op.content, CounterIncr):
                         rows.append((slot_idx(op.container), float(op.content.delta)))
-        for di in range(self.d):
-            if len(self.slot_of[di]) + len(staged_slots[di]) > self.s:
+        req = max(
+            (len(self.slot_of[di]) + len(staged_slots[di]) for di in range(self.d)),
+            default=0,
+        )
+        if req > self.s:
+            if self.auto_grow:
+                self.grow(_grow_target(req, self.s))
+            else:
                 raise RuntimeError(
-                    f"DeviceCounterBatch slot capacity exceeded for doc {di}"
+                    f"DeviceCounterBatch slot capacity exceeded: a doc needs "
+                    f"{req} slots > {self.s}"
                 )
         if not any(rows_per_doc):
             return

@@ -38,6 +38,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 KEY_STEP = 1 << 20
+# Run-continuation inserts take a SMALL biased step instead of the gap
+# midpoint: a typing run of L chars then consumes L*RUN_STEP of the gap
+# instead of halving it L times (which exhausted a fresh 2^20 gap after
+# ~2 nearby runs and made renumbers ~35% of epoch ingests — r5 profile).
+# 2^20 / 2^8 = 4096 sequential chars fit in one gap before a renumber.
+RUN_STEP = 1 << 8
 KEY_BIAS = 1 << 62  # added before the u32-halves split (order-preserving)
 HEAD = -2  # linked-list sentinel: before the first element
 
@@ -122,9 +128,10 @@ class ShadowOrder:
         if succ >= 0:
             self.prev[succ] = row
 
-    def _assign_key(self, row: int) -> bool:
-        """Gap-midpoint key from order neighbors.  False = gap empty
-        (caller renumbers)."""
+    def _assign_key(self, row: int, run: bool = False) -> bool:
+        """Gap key from order neighbors: midpoint for branch inserts, a
+        small low-biased step for run continuations (see RUN_STEP).
+        False = gap empty (caller renumbers)."""
         pred = int(self.prev[row])
         succ = int(self.next[row])
         if pred < 0 and succ < 0:
@@ -137,7 +144,10 @@ class ShadowOrder:
             lo, hi = int(self.key[pred]), int(self.key[succ])
             if hi - lo < 2:
                 return False
-            self.key[row] = lo + (hi - lo) // 2
+            step = (hi - lo) // 2
+            if run and step > RUN_STEP:
+                step = RUN_STEP
+            self.key[row] = lo + step
         return True
 
     def _renumber(self) -> None:
@@ -166,14 +176,25 @@ class ShadowOrder:
             self.peer[row] = np.uint64(peer)
             self.ctr[row] = ctr
             self.spine[row] = -1
-            self._place(parent_row, side, row)
-            if not self._assign_key(row):
+            run = self._place(parent_row, side, row)
+            if not self._assign_key(row, run):
                 self._renumber()
                 renumbered = True
             keys.append(int(self.key[row]))
         return None if renumbered else keys
 
-    def _place(self, parent_row: int, side: int, row: int) -> None:
+    def append_arrays(self, parent, side, peer, ctr, base_row: int):
+        """Columnar adapter matching NativeShadowOrder.append_arrays
+        (the fallback pays the tuple conversion; the native engine
+        takes the arrays directly)."""
+        return self.append_rows(
+            list(zip(parent.tolist(), side.tolist(), peer.tolist(), ctr.tolist())),
+            base_row,
+        )
+
+    def _place(self, parent_row: int, side: int, row: int) -> bool:
+        """Place `row`; True = run-continuation fast path (the caller
+        assigns a low-biased key so runs don't bisect the gap)."""
         # run-continuation fast path: R-insert under a childless parent
         # from the same peer with a contiguous counter
         if (
@@ -186,7 +207,7 @@ class ShadowOrder:
         ):
             self.spine[parent_row] = row
             self._splice_after(parent_row, row)
-            return
+            return True
         sibs = self._sibling_list(parent_row, side)
         i = bisect_left(sibs, self._sib_key(row), key=self._sib_key)
         sibs.insert(i, row)
@@ -206,6 +227,7 @@ class ShadowOrder:
                 nxt = sibs[i + 1] if len(sibs) > i + 1 else -1
                 old_first = self._subtree_first(nxt) if nxt >= 0 else parent_row
                 self._splice_after(int(self.prev[old_first]), row)
+        return False
 
     def _sibling_list(self, parent_row: int, side: int) -> List[int]:
         if parent_row < 0:

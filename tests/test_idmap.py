@@ -1,0 +1,141 @@
+"""Differential fuzz: NativeIdMap (C++ hash map) vs PyIdMap (dict
+oracle) under random stage/lookup/commit/abort/insert interleavings,
+plus the staging contract DeviceDocBatch._commit_rows relies on
+(capacity error -> abort leaves the committed view untouched).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from loro_tpu.native import available, native_idmap
+from loro_tpu.parallel.idmap import PyIdMap
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native library unavailable"
+)
+
+
+def _rand_cols(rng, n, peer_pool, ctr_hi):
+    peer = np.asarray([rng.choice(peer_pool) for _ in range(n)], np.uint64)
+    ctr = np.asarray([rng.randrange(ctr_hi) for _ in range(n)], np.int64)
+    return peer, ctr
+
+
+def test_idmap_differential_fuzz():
+    rng = random.Random(0x1D317)
+    peer_pool = [1, 7, (1 << 33) + 5, (1 << 63) + 11, 2**64 - 3]
+    for _ in range(40):
+        nat, py = native_idmap(), PyIdMap()
+        next_row = 0
+        for _step in range(30):
+            op = rng.random()
+            n = rng.randint(1, 24)
+            if op < 0.35:
+                peer, ctr = _rand_cols(rng, n, peer_pool, 4096)
+                nat.stage_base(peer, ctr, next_row)
+                py.stage_base(peer, ctr, next_row)
+                next_row += n
+            elif op < 0.55:
+                peer, ctr = _rand_cols(rng, n, peer_pool, 4096)
+                rows = np.asarray(
+                    [rng.randrange(1 << 20) for _ in range(n)], np.int32
+                )
+                nat.insert_arrays(peer, ctr, rows)
+                py.insert_arrays(peer, ctr, rows)
+            elif op < 0.7:
+                nat.commit()
+                py.commit()
+            elif op < 0.8:
+                nat.abort()
+                py.abort()
+            else:
+                peer, ctr = _rand_cols(rng, n, peer_pool, 4096)
+                got = nat.lookup(peer, ctr)
+                want = py.lookup(peer, ctr)
+                assert np.array_equal(got, want)
+        nat.commit()
+        py.commit()
+        assert len(nat) == len(py)
+        # committed view must agree key-by-key (incl. single-get API)
+        for k in list(py)[:200]:
+            assert nat.get(k) == py.get(k)
+            assert nat[k] == py[k]
+            assert k in nat
+        missing = (123456789, -42)
+        assert nat.get(missing) is None
+        with pytest.raises(KeyError):
+            nat[missing]
+
+
+def test_idmap_update_from_dict():
+    nat = native_idmap()
+    d = {(1, 0): 0, (1, 1): 1, ((1 << 40) + 3, 9): 2}
+    nat.update(d)
+    for k, v in d.items():
+        assert nat[k] == v
+    assert len(nat) == 3
+    assert bool(nat)
+
+
+def test_escaping_decode_error_aborts_staged_ids():
+    """Review r5: an exception OUTSIDE (KeyError, ValueError) escaping
+    append_payloads after another doc already staged its ids must roll
+    those back — otherwise the next commit publishes phantom rows."""
+    from loro_tpu import LoroDoc
+    from loro_tpu.doc import strip_envelope
+    from loro_tpu.parallel.fleet import DeviceDocBatch
+
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    cid = a.get_text("t").id
+    for d, txt in ((a, "doc a"), (b, "doc b")):
+        d.get_text("t").insert(0, txt)
+        d.commit()
+    batch = DeviceDocBatch(n_docs=2, capacity=64)
+    batch.append_changes(
+        [a.oplog.changes_in_causal_order(), b.oplog.changes_in_causal_order()], cid
+    )
+    committed = [len(batch.id2row[0]), len(batch.id2row[1])]
+    va, vb = a.oplog_vv(), b.oplog_vv()
+    a.get_text("t").insert(0, "more ")
+    a.commit()
+    b.get_text("t").insert(0, "junk ")
+    b.commit()
+    good = strip_envelope(a.export_updates(va))
+    bad = strip_envelope(b.export_updates(vb))[:-6]  # truncated mid-table
+    with pytest.raises(Exception):
+        batch.append_payloads([good, bad], cid)
+    assert [len(batch.id2row[0]), len(batch.id2row[1])] == committed
+    # the batch still works after the rollback
+    batch.append_payloads([good, strip_envelope(b.export_updates(vb))], cid)
+    assert batch.texts() == [
+        a.get_text("t").to_string(), b.get_text("t").to_string()
+    ]
+
+
+def test_capacity_error_leaves_idmap_unstaged():
+    """A capacity overflow during append must abort staged ids: the next
+    (smaller) append still resolves parents against the committed view
+    only, matching the 'batch untouched' contract."""
+    import jax
+
+    from loro_tpu import LoroDoc
+    from loro_tpu.parallel.fleet import DeviceDocBatch
+
+    doc = LoroDoc(peer=9)
+    t = doc.get_text("t")
+    t.insert(0, "abcdef")
+    doc.commit()
+    vv = doc.oplog_vv()
+    batch = DeviceDocBatch(n_docs=1, capacity=32)
+    batch.append_changes([doc.oplog.changes_in_causal_order()], t.id)
+    committed = len(batch.id2row[0])
+    t.insert(3, "x" * 64)  # exceeds capacity 32
+    doc.commit()
+    from loro_tpu.doc import strip_envelope
+
+    payload = strip_envelope(doc.export_updates(vv))
+    with pytest.raises(RuntimeError, match="capacity exceeded"):
+        batch.append_payloads([payload], t.id)
+    assert len(batch.id2row[0]) == committed  # staged ids rolled back
+    assert batch.texts() == ["abcdef"]

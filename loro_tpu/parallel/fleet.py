@@ -684,6 +684,11 @@ class DeviceDocBatch:
         self.auto_grow = auto_grow
         self._c_pad = 256  # chain budget (doubles on overflow)
         self.counts = np.zeros(n_docs, np.int64)  # used rows per doc
+        # ingest epochs date tombstones for compaction: a tombstone may
+        # be reclaimed once every replica has acked the epoch that
+        # ingested its delete (see compact())
+        self.epoch = 0
+        self.tomb_epoch = np.full((n_docs, capacity), -1, np.int64)
         # host-side id -> row resolution per doc (C++ hash map when the
         # native lib is available; batch stage/lookup/commit contract —
         # see parallel/idmap.py)
@@ -701,20 +706,7 @@ class DeviceDocBatch:
         # The C++ engine (native/codec.cpp loro_order_*) is used when
         # available — bit-identical keys; LORO_PY_ORDER=1 forces the
         # Python engine (the differential oracle).
-        import os as _os
-
-        from .order_maintenance import ShadowOrder
-
-        def _make_order():
-            if _os.environ.get("LORO_PY_ORDER", "0") not in ("1", "true", "yes"):
-                from ..native import native_order
-
-                nat = native_order()
-                if nat is not None:
-                    return nat
-            return ShadowOrder()
-
-        self.order = [_make_order() for _ in range(n_docs)]
+        self.order = [self._fresh_order() for _ in range(n_docs)]
         from ..ops.fugue_batch import SeqColumnsU
 
         sh = doc_sharding(self.mesh)
@@ -734,6 +726,13 @@ class DeviceDocBatch:
         self.key_hi = z(np.uint32, 0xFFFFFFFF)
         self.key_lo = z(np.uint32, 0xFFFFFFFF)
 
+    # column fill values shared by __init__, grow() and compact() —
+    # one table so the three cannot drift
+    _COL_FILLS = dict(
+        parent=-1, side=0, peer_hi=0, peer_lo=0, counter=0,
+        deleted=True, content=-1, valid=False,
+    )
+
     # ------------------------------------------------------------------
     def grow(self, new_capacity: int) -> None:
         """Repack the resident columns to a larger row capacity (device
@@ -746,13 +745,9 @@ class DeviceDocBatch:
         if new_capacity <= self.cap:
             return
         sh = doc_sharding(self.mesh)
-        fills = dict(
-            parent=-1, side=0, peer_hi=0, peer_lo=0, counter=0,
-            deleted=True, content=-1, valid=False,
-        )
         cols = _pad_axis1(
             {f: getattr(self.cols, f) for f in self.cols._fields},
-            new_capacity, fills, sh,
+            new_capacity, self._COL_FILLS, sh,
         )
         from ..ops.fugue_batch import SeqColumnsU
 
@@ -764,7 +759,227 @@ class DeviceDocBatch:
             sh,
         )
         self.key_hi, self.key_lo = keys["key_hi"], keys["key_lo"]
+        te = np.full((self.d, new_capacity), -1, np.int64)
+        te[:, : self.cap] = self.tomb_epoch
+        self.tomb_epoch = te
         self.cap = new_capacity
+
+    def compact(self, stable_epochs: Sequence[Optional[int]]) -> int:
+        """Reclaim causally-stable tombstones (resident lifecycle, r4
+        verdict #6; the reference analog is the shallow-snapshot floor,
+        crates/loro-internal/src/encoding/shallow_snapshot.rs:16-40).
+
+        ``stable_epochs[di]`` is the newest ingest epoch (``self.epoch``
+        after an append) that EVERY replica of doc di has acknowledged
+        integrating; None skips the doc.  A tombstone whose delete was
+        ingested at epoch <= that is invisible at every replica, so no
+        future op can treat it as visible.  Three keep-rules still
+        apply, because Fugue ops CAN reference invisible rows:
+
+        - attach-target protection: a future insert at the gap after a
+          visible row `a` with R-children parents (side=L) on `a`'s
+          total-order SUCCESSOR, tombstone or not; an insert at
+          position 0 parents on the total-order FIRST row; and the
+          anchor-aware expand walk (models/handlers._placement_with_
+          expand) can end on the LAST tombstone of an invisible window,
+          so every tombstone whose immediate successor is non-deleted
+          is targetable too — all three classes stay (mirrors
+          models/seq_crdt.placement_for_visible_pos + the expand walk);
+        - live subtrees: a row with a surviving child stays (children's
+          placements reference the parent chain) — EXCEPT a run-interior
+          tombstone whose single live R-child is its run continuation,
+          which drops by promoting that child into its place (safe: the
+          only siblings the child could re-order against are same-peer
+          counters inside the collapsed interval — the dropped chain
+          itself; future same-peer ops carry higher counters);
+        - undated tombstones (imported from pre-epoch checkpoints)
+          never drop.
+
+        Rebuilds the order engine, id map, anchors and device columns
+        for compacted docs; returns rows reclaimed.  O(table) host pass
+        — a rare maintenance op, not the hot path."""
+        from .idmap import make_idmap
+        from .order_maintenance import split_keys
+
+        if len(stable_epochs) > self.d:
+            raise ValueError(
+                f"compact: {len(stable_epochs)} stable_epochs for a "
+                f"{self.d}-doc batch"
+            )
+        stable_epochs = list(stable_epochs) + [None] * (self.d - len(stable_epochs))
+        host = None  # fetched lazily on the first doc that compacts
+        key_hi = key_lo = None
+        reclaimed = 0
+        for di, stable_e in enumerate(stable_epochs):
+            if stable_e is None or not int(self.counts[di]):
+                continue
+            if host is None:
+                host = {f: np.asarray(getattr(self.cols, f)).copy()
+                        for f in self.cols._fields}
+                key_hi = np.asarray(self.key_hi).copy()
+                key_lo = np.asarray(self.key_lo).copy()
+            k = int(self.counts[di])
+            peer = (host["peer_hi"][di, :k].astype(np.uint64) << np.uint64(32)) | \
+                host["peer_lo"][di, :k].astype(np.uint64)
+            ctr = host["counter"][di, :k].astype(np.int64)
+            parent = host["parent"][di, :k].astype(np.int64)
+            deleted = host["deleted"][di, :k]
+            side = host["side"][di, :k].astype(np.int64)
+            te = self.tomb_epoch[di, :k]
+            # attach-target protection from the standing total order
+            order = np.lexsort((key_lo[di, :k], key_hi[di, :k]))
+            protected = np.zeros(k, bool)
+            protected[order[0]] = True  # global first (position-0 inserts)
+            succ_of = np.full(k, -1, np.int64)
+            succ_of[order[:-1]] = order[1:]
+            has_r = np.zeros(k, bool)
+            rmask = side == 1
+            has_r[parent[rmask][parent[rmask] >= 0]] = True
+            tgt = np.flatnonzero((~deleted) & has_r & (succ_of >= 0))
+            protected[succ_of[tgt]] = True
+            # expand-walk targets: the last tombstone before any
+            # non-deleted row (the walk steps over tombstones and can
+            # attach to the final one)
+            nd_succ = np.flatnonzero(
+                (succ_of >= 0) & deleted & ~deleted[np.clip(succ_of, 0, k - 1)]
+            )
+            protected[nd_succ] = True
+            # ...including the end-of-document window, whose final
+            # tombstone has no successor
+            protected[order[-1]] = True
+            # anchor rows never drop, live OR dead: a dead END anchor
+            # with a live start means "style runs to EOF" (richtexts'
+            # dead-end-never-pops rule) — dropping the row would discard
+            # its metadata and silently deactivate the style
+            if self.anchor_by_row[di]:
+                rows_a = np.fromiter(
+                    self.anchor_by_row[di], np.int64, len(self.anchor_by_row[di])
+                )
+                protected[rows_a[rows_a < k]] = True
+            stable_dead = (
+                deleted & (te >= 0) & (te <= int(stable_e)) & ~protected
+            )
+            # Reverse pass (children have higher indices than parents):
+            # a stable tombstone drops when it anchors no live subtree —
+            # either no live children at all (dead subtree), or exactly
+            # one live R-child that is its run continuation, which then
+            # PROMOTES into its place (chain collapse).  Promotion is
+            # sibling-sort-safe: the promoted child keeps its identity
+            # (peer, ctr); the only siblings it could re-order against
+            # are same-peer rows with counters inside the collapsed
+            # (T.ctr, C.ctr] interval — all of which are the dropped
+            # chain rows themselves, and future same-peer ops always
+            # carry higher counters.
+            dparent = parent.copy()
+            dside = side.copy()
+            prom = ctr.copy()  # promoted placement counter (check only)
+            live_l = np.zeros(k, np.int64)
+            live_r = np.zeros(k, np.int64)
+            only_r = np.full(k, -1, np.int64)  # valid when live_r == 1
+            keep = np.zeros(k, bool)
+
+            def credit(child: int, p: int, s: int) -> None:
+                if p < 0:
+                    return
+                if s == 1:
+                    live_r[p] += 1
+                    only_r[p] = child if live_r[p] == 1 else -1
+                else:
+                    live_l[p] += 1
+
+            for r in range(k - 1, -1, -1):
+                if stable_dead[r] and live_l[r] == 0:
+                    if live_r[r] == 0:
+                        continue  # whole subtree dead: drop
+                    if live_r[r] == 1:
+                        c = int(only_r[r])
+                        if peer[c] == peer[r] and prom[c] == ctr[r] + 1:
+                            dparent[c] = parent[r]
+                            dside[c] = side[r]
+                            prom[c] = ctr[r]
+                            credit(c, int(parent[r]), int(side[r]))
+                            continue  # r drops, c takes its place
+                keep[r] = True
+                credit(r, int(dparent[r]), int(dside[r]))
+            n_keep = int(keep.sum())
+            if n_keep == k:
+                continue
+            reclaimed += k - n_keep
+            old_rows = np.flatnonzero(keep)
+            remap = np.full(k, -1, np.int64)
+            remap[old_rows] = np.arange(n_keep)
+            new_parent = dparent[old_rows]
+            pos = new_parent >= 0
+            new_parent[pos] = remap[new_parent[pos]]
+            new_side = dside[old_rows]
+            # rebuild columns for this doc (tail restored to fills)
+            for f in self.cols._fields:
+                row = host[f][di]
+                vals = row[:k][old_rows].copy()
+                row[:] = self._COL_FILLS[f]
+                row[:n_keep] = vals
+            host["parent"][di, :n_keep] = new_parent
+            host["side"][di, :n_keep] = new_side  # promoted rows inherit
+            te_new = te[old_rows].copy()
+            self.tomb_epoch[di, :] = -1
+            self.tomb_epoch[di, :n_keep] = te_new
+            # rebuild the order engine + standing keys by replay
+            self.order[di] = self._fresh_order()
+            keys = self.order[di].append_arrays(
+                new_parent.astype(np.int32),
+                host["side"][di, :n_keep],
+                peer[old_rows],
+                ctr[old_rows],
+                0,
+            )
+            if keys is None:
+                keys = self.order[di].all_keys()
+            kh, kl = split_keys(np.asarray(keys, np.int64))
+            key_hi[di] = 0xFFFFFFFF
+            key_lo[di] = 0xFFFFFFFF
+            key_hi[di, :n_keep] = kh
+            key_lo[di, :n_keep] = kl
+            # rebuild the id map over survivors only
+            m = make_idmap()
+            m.insert_arrays(
+                peer[old_rows], ctr[old_rows], np.arange(n_keep, dtype=np.int32)
+            )
+            self.id2row[di] = m
+            # anchors: drop dead rows' metadata, remap the survivors
+            if self.anchor_meta[di]:
+                new_meta = {}
+                for pc, a in self.anchor_meta[di].items():
+                    nr = remap[a["row"]] if a["row"] < k else -1
+                    if nr >= 0:
+                        new_meta[pc] = dict(a, row=int(nr))
+                self.anchor_meta[di] = new_meta
+                self.anchor_by_row[di] = {a["row"]: pc for pc, a in new_meta.items()}
+            self.counts[di] = n_keep
+        if host is not None and reclaimed:
+            from ..ops.fugue_batch import SeqColumnsU
+
+            sh = doc_sharding(self.mesh)
+            self.cols = SeqColumnsU(
+                **{f: jax.device_put(v, sh) for f, v in host.items()}
+            )
+            self.key_hi = jax.device_put(key_hi, sh)
+            self.key_lo = jax.device_put(key_lo, sh)
+        return reclaimed
+
+    def _fresh_order(self):
+        """A new order engine of the configured kind (compaction
+        rebuild)."""
+        import os as _os
+
+        if _os.environ.get("LORO_PY_ORDER", "0") not in ("1", "true", "yes"):
+            from ..native import native_order
+
+            nat = native_order()
+            if nat is not None:
+                return nat
+        from .order_maintenance import ShadowOrder
+
+        return ShadowOrder()
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
         """Incremental ingest: each doc's new causally-ordered changes
@@ -869,6 +1084,7 @@ class DeviceDocBatch:
         def n_of(r) -> int:
             return len(r["parent"]) if isinstance(r, dict) else len(r)
 
+        self.epoch += 1  # deletes in this append carry this epoch
         n_new = [n_of(r) for r in rows_per_doc]
         max_new = pad_bucket(max(n_new, default=0), floor=16) if any(n_new) else 0
         # validate BEFORE mutating: the scatter window is max_new wide,
@@ -1211,6 +1427,9 @@ class DeviceDocBatch:
         n = len(d_all)
         if not n:
             return
+        # date the tombstones: compact() may reclaim them once every
+        # replica has acked this epoch
+        self.tomb_epoch[d_all, r_all] = self.epoch
         k = pad_bucket(n, floor=16)
         d_idx = np.empty(k, np.int32)
         r_idx = np.empty(k, np.int32)
@@ -1272,7 +1491,7 @@ class DeviceDocBatch:
         return out
 
     # -- checkpoint/resume (fleet-scale; SURVEY §5) --------------------
-    STATE_VERSION = 1
+    STATE_VERSION = 2  # v2: + ingest epoch in meta, tomb-epoch columns
     # serialized row columns (valid is derivable from counts): ONE
     # schema shared by export and import so they cannot drift
     _STATE_SCHEMA = (
@@ -1308,6 +1527,7 @@ class DeviceDocBatch:
         meta.varint(self._c_pad)
         for di in range(self.d):
             meta.varint(int(self.counts[di]))
+        meta.varint(self.epoch)  # v2: compaction epoch clock
         kv.set(b"meta", bytes(meta.buf))
         for di in range(self.d):
             k = int(self.counts[di])
@@ -1315,6 +1535,12 @@ class DeviceDocBatch:
             for f, dt in self._STATE_SCHEMA:
                 w.bytes_(cols[f][di, :k].astype(dt).tobytes())
             kv.set(b"doc/%08d/rows" % di, bytes(w.buf))
+            if k:
+                # v2: tombstone ingest epochs (compaction dating)
+                kv.set(
+                    b"doc/%08d/tombepoch" % di,
+                    self.tomb_epoch[di, :k].astype(np.int64).tobytes(),
+                )
             w = Writer()
             _state_write_values(w, d, self.value_store[di])
             kv.set(b"doc/%08d/values" % di, bytes(w.buf))
@@ -1363,6 +1589,7 @@ class DeviceDocBatch:
             if c_pad <= 0:  # the chain-budget doubling loop needs > 0
                 raise DecodeError("DeviceDocBatch state: bad chain budget")
             counts = [r.varint() for _ in range(d_saved)]
+            epoch = r.varint() if version >= 2 else 0
         except (IndexError, ValueError, struct.error) as e:
             raise DecodeError(f"DeviceDocBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceDocBatch", d_saved, capacity=cap)
@@ -1370,6 +1597,7 @@ class DeviceDocBatch:
             raise DecodeError("DeviceDocBatch state: implausible n_docs")
         batch = cls(n_docs, cap, mesh=mesh, as_text=as_text)
         batch._c_pad = c_pad
+        batch.epoch = epoch
         # mesh-pad docs beyond the importer's width must be empty (they
         # only ever receive None updates on the export side)
         for di in range(batch.d, d_saved):
@@ -1414,6 +1642,14 @@ class DeviceDocBatch:
                     tgt[di, :k] = arrs[f].astype(tgt.dtype)
                 host["valid"][di, :k] = True
                 batch.counts[di] = k
+                te_b = kv.get(b"doc/%08d/tombepoch" % di)
+                if te_b is not None:
+                    te = np.frombuffer(te_b, np.int64)
+                    if len(te) != k:
+                        raise DecodeError(
+                            "DeviceDocBatch state: tomb epoch column length"
+                        )
+                    batch.tomb_epoch[di, :k] = te
                 peer_full = (arrs["peer_hi"].astype(np.uint64) << np.uint64(32)) | arrs[
                     "peer_lo"
                 ].astype(np.uint64)

@@ -84,6 +84,16 @@ def main() -> None:
     print(f"sharded (docs x ops) LWW merge of {n_docs} docs: "
           f"{'consistent' if ok else 'DIVERGED'}")
 
+    # long-lived server lifecycle: auto_grow repacks past the initial
+    # capacity bucket, and once every client has acked an ingest epoch
+    # the server reclaims causally-stable tombstones in place
+    batch.auto_grow = True
+    stable = batch.epoch  # every round above was fully synced
+    reclaimed = batch.compact([stable] * batch.d)
+    ok = batch.texts() == [d.get_text("doc").to_string() for d in docs]
+    print(f"compaction: reclaimed {reclaimed} tombstone rows "
+          f"({'consistent' if ok else 'DIVERGED'})")
+
     # server restart: the resident state checkpoints through the LTKV
     # store and the restored batch keeps serving appends + rich reads
     blob = batch.export_state()

@@ -918,6 +918,22 @@ class DeviceDocBatch:
                 vals = row[:k][old_rows].copy()
                 row[:] = self._COL_FILLS[f]
                 row[:n_keep] = vals
+            # list batches: drop stranded values and rewrite the content
+            # ordinals over survivors (an empty store with content rows
+            # is the externally-indexed movable-slot use — those
+            # ordinals are NOT ours to rewrite, and there is no store
+            # to shrink)
+            if not self.as_text and self.value_store[di]:
+                cvals = host["content"][di, :n_keep].astype(np.int64)
+                uniq = np.unique(cvals[cvals >= 0])
+                vmap = np.full(len(self.value_store[di]), -1, np.int64)
+                vmap[uniq] = np.arange(len(uniq))
+                host["content"][di, :n_keep] = np.where(
+                    cvals >= 0, vmap[np.clip(cvals, 0, None)], cvals
+                ).astype(host["content"].dtype)
+                self.value_store[di] = [
+                    self.value_store[di][int(o)] for o in uniq
+                ]
             host["parent"][di, :n_keep] = new_parent
             host["side"][di, :n_keep] = new_side  # promoted rows inherit
             te_new = te[old_rows].copy()
@@ -1084,7 +1100,6 @@ class DeviceDocBatch:
         def n_of(r) -> int:
             return len(r["parent"]) if isinstance(r, dict) else len(r)
 
-        self.epoch += 1  # deletes in this append carry this epoch
         n_new = [n_of(r) for r in rows_per_doc]
         max_new = pad_bucket(max(n_new, default=0), floor=16) if any(n_new) else 0
         # validate BEFORE mutating: the scatter window is max_new wide,
@@ -1399,11 +1414,19 @@ class DeviceDocBatch:
         appends).  Each entry is (doc, row) or (doc, row_ndarray) — the
         columnar ingest path ships whole per-doc delete chunks.  Padded
         to buckets (idempotent repeats of the first pair) to bound
-        retraces."""
+        retraces.
+
+        Advances the epoch clock and dates the new tombstones with the
+        fresh epoch — including direct public calls, so an out-of-band
+        delete can never be stamped with an epoch replicas already
+        acked (which would let compact() reclaim a never-propagated
+        delete).  Runs after all ingest validation, so a failed append
+        leaves the clock untouched."""
         from ..ops.fugue_batch import pad_bucket
 
         if not pairs:
             return
+        self.epoch += 1
         d_parts: List[np.ndarray] = []
         r_parts: List[np.ndarray] = []
         for di, row in pairs:  # deactivate style pairs whose anchor died

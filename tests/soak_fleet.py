@@ -106,6 +106,12 @@ def run_fleet(label: str, use_payloads: bool):
         r = _epoch_rows(chs)
         epoch_rows.append(r)
         total_rows += r
+        if e == EPOCHS // 2:
+            # mid-soak tombstone compaction across the whole fleet (the
+            # single-writer scripts make every ingested epoch stable);
+            # the end-of-run oracle gate re-checks every doc after it
+            reclaimed = batch.compact([batch.epoch] * batch.d)
+            print(f"  compaction at epoch {e}: reclaimed {reclaimed} rows")
     ingest_dt = time.perf_counter() - t0
     assert batch.cap > cap0, (
         f"{label}: capacity boundary never crossed (cap {batch.cap} == "

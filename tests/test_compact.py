@@ -227,6 +227,47 @@ class TestCompact:
                 )
         assert total_reclaimed > 0, f"seed {seed}: fuzz never reclaimed a row"
 
+    def test_list_batch_value_store_shrinks(self):
+        """Review r5: as_text=False compaction must also drop stranded
+        values and rewrite content ordinals, or host memory grows
+        unboundedly with historical inserts."""
+        doc = LoroDoc(peer=1)
+        lst = doc.get_list("l")
+        for i in range(12):
+            lst.push(f"item-{i}")
+        doc.commit()
+        for _ in range(8):  # delete a run of 8 interior items
+            lst.delete(2, 1)
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=64, as_text=False)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], lst.id)
+        want = lst.get_value()
+        n_vals_before = len(batch.value_store[0])
+        assert batch.compact([batch.epoch]) > 0
+        assert len(batch.value_store[0]) < n_vals_before
+        assert batch.values() == [want]
+        # the compacted batch keeps ingesting
+        vv = doc.oplog_vv()
+        lst.push("after-gc")
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(vv, doc.oplog_vv())], lst.id)
+        assert batch.values() == [lst.get_value()]
+
+    def test_direct_mark_deleted_gets_fresh_epoch(self):
+        """Review r5: a public mark_deleted call advances the epoch
+        clock, so its tombstones are never dated with an epoch replicas
+        already acked."""
+        doc = LoroDoc(peer=1)
+        t = doc.get_text("t")
+        t.insert(0, "abc")
+        doc.commit()
+        batch = DeviceDocBatch(n_docs=1, capacity=32)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], t.id)
+        acked = batch.epoch
+        batch.mark_deleted([(0, 1)])  # out-of-band delete
+        assert batch.epoch > acked
+        assert batch.compact([acked]) == 0  # not reclaimable at old ack
+
     def test_multi_doc_selective(self):
         docs = [LoroDoc(peer=i + 1) for i in range(3)]
         cid = docs[0].get_text("t").id

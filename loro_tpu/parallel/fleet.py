@@ -2216,6 +2216,9 @@ class DeviceTreeBatch:
         self.node_cap = node_capacity
         self.auto_grow = auto_grow
         self.counts = np.zeros(self.d, np.int64)
+        # ingest epochs date move rows for compaction (see compact())
+        self.epoch = 0
+        self.move_epoch = np.full((self.d, move_capacity), -1, np.int64)
         # per-doc node dictionaries + host move metadata for sibling
         # positions: (lamport, peer, counter, target_ord, is_delete, pos)
         self.node_ids: List[Dict] = [dict() for _ in range(self.d)]
@@ -2399,6 +2402,9 @@ class DeviceTreeBatch:
                 move_capacity, fills, doc_sharding(self.mesh),
             )
             self.cols = TreeLogCols(**cols)
+            me = np.full((self.d, move_capacity), -1, np.int64)
+            me[:, : self.cap] = self.move_epoch
+            self.move_epoch = me
             self.cap = move_capacity
         if node_capacity is not None and node_capacity > self.node_cap:
             self.node_cap = node_capacity
@@ -2458,6 +2464,7 @@ class DeviceTreeBatch:
             "valid": np.zeros(blk_shape, bool),
         }
         offsets = np.zeros(self.d, np.int32)
+        self.epoch += 1  # post-validation: dates this append's rows
         for di, rows in enumerate(rows_per_doc):
             if not rows:
                 continue
@@ -2471,7 +2478,9 @@ class DeviceTreeBatch:
             blk["target"][di, :k] = arr[:, 2]
             blk["parent"][di, :k] = arr[:, 3]
             blk["valid"][di, :k] = True
-            offsets[di] = int(self.counts[di])
+            base = int(self.counts[di])
+            offsets[di] = base
+            self.move_epoch[di, base : base + k] = self.epoch
             self.counts[di] += k
             self.move_meta[di].extend(
                 (r[0], r[1], r[2], r[3], r[5], r[6]) for r in rows
@@ -2487,6 +2496,88 @@ class DeviceTreeBatch:
         from ..ops.tree_batch import tree_replay_log_batch
 
         return tree_replay_log_batch(self.cols, self.node_cap)
+
+    def compact(self, stable_epochs: Sequence[Optional[int]]) -> int:
+        """Collapse the move log over its causally-stable prefix: per
+        node, keep only the WINNING stable move (the last effected one
+        in global key order); drop every superseded or cycle-rejected
+        stable row.  Rows newer than the doc's stable epoch all stay.
+
+        Sound because (a) every future move's lamport exceeds every
+        stable move's lamport (its author's frontier dominates the
+        stable set), so future rows sort strictly after the stable
+        prefix, and (b) replaying only winners reproduces the stable
+        tree state: at any winner's position the reduced state is a
+        sub-chain of the full state per node (ABSENT where a superseded
+        move once pointed), and the ancestor cycle-walk over sub-chains
+        can only stop earlier — a move accepted in full replay is never
+        spuriously rejected in the reduced one.  move_meta stays
+        row-aligned (children_maps' sibling tiebreak uses relative key
+        order, which filtering preserves).  Node dictionaries are not
+        reclaimed (targets keep their ordinals).  Returns rows dropped.
+        Reference analog: loro's tree uses the same last-writer state
+        under its shallow-snapshot floor (shallow_snapshot.rs:16-40)."""
+        from ..ops.tree_batch import ROOT, TreeLogCols
+
+        if len(stable_epochs) > self.d:
+            raise ValueError(
+                f"compact: {len(stable_epochs)} stable_epochs for a "
+                f"{self.d}-doc batch"
+            )
+        stable_epochs = list(stable_epochs) + [None] * (self.d - len(stable_epochs))
+        fills = dict(lamport=0, peer_hi=0, peer_lo=0, counter=0,
+                     target=0, parent=ROOT, valid=False)
+        host = None
+        eff = None
+        reclaimed = 0
+        for di, stable_e in enumerate(stable_epochs):
+            if stable_e is None or not int(self.counts[di]):
+                continue
+            if host is None:
+                _parents, eff_dev = self._replay()
+                eff = np.asarray(eff_dev)
+                host = {f: np.asarray(getattr(self.cols, f)).copy()
+                        for f in self.cols._fields}
+            k = int(self.counts[di])
+            stable = self.move_epoch[di, :k] <= int(stable_e)
+            stable &= self.move_epoch[di, :k] >= 0  # undated rows stay
+            if not stable.any():
+                continue
+            lam = host["lamport"][di, :k]
+            phi = host["peer_hi"][di, :k]
+            plo = host["peer_lo"][di, :k]
+            ctr = host["counter"][di, :k]
+            tgt = host["target"][di, :k]
+            order = np.lexsort((ctr, plo, phi, lam))
+            winner: Dict[int, int] = {}
+            for r in order:
+                if stable[r] and eff[di, r]:
+                    winner[int(tgt[r])] = int(r)
+            win_rows = set(winner.values())
+            keep = ~stable  # unstable rows all stay
+            for r in win_rows:
+                keep[r] = True
+            n_keep = int(keep.sum())
+            if n_keep == k:
+                continue
+            reclaimed += k - n_keep
+            old_rows = np.flatnonzero(keep)  # original append order
+            for f in self.cols._fields:
+                row = host[f][di]
+                vals = row[:k][old_rows]  # fancy index: already a copy
+                row[:] = fills[f]
+                row[:n_keep] = vals
+            me = self.move_epoch[di, :k][old_rows]
+            self.move_epoch[di, :] = -1
+            self.move_epoch[di, :n_keep] = me
+            self.move_meta[di] = [self.move_meta[di][int(r)] for r in old_rows]
+            self.counts[di] = n_keep
+        if host is not None and reclaimed:
+            sh = doc_sharding(self.mesh)
+            self.cols = TreeLogCols(
+                **{f: jax.device_put(v, sh) for f, v in host.items()}
+            )
+        return reclaimed
 
     def parent_maps(self) -> List[dict]:
         """{TreeID: parent TreeID | None} of alive nodes per doc (one
@@ -2509,7 +2600,7 @@ class DeviceTreeBatch:
         return out
 
     # -- checkpoint/resume --------------------------------------------
-    STATE_VERSION = 1
+    STATE_VERSION = 2  # v2: + epoch clock, move-epoch columns
     _STATE_SCHEMA = (
         ("lamport", np.int32),
         ("peer_hi", np.uint32),
@@ -2534,6 +2625,7 @@ class DeviceTreeBatch:
         meta.varint(self.node_cap)
         for di in range(self.d):
             meta.varint(int(self.counts[di]))
+        meta.varint(self.epoch)  # v2
         kv.set(b"meta", bytes(meta.buf))
         cols = {f: np.asarray(getattr(self.cols, f)) for f, _ in self._STATE_SCHEMA}
         for di in range(self.d):
@@ -2542,6 +2634,11 @@ class DeviceTreeBatch:
             for f, dt in self._STATE_SCHEMA:
                 w.bytes_(cols[f][di, :k].astype(dt).tobytes())
             kv.set(b"doc/%08d/log" % di, bytes(w.buf))
+            if k:
+                kv.set(
+                    b"doc/%08d/moveepoch" % di,
+                    self.move_epoch[di, :k].astype(np.int64).tobytes(),
+                )
             w = Writer()
             w.varint(len(self.nodes[di]))
             for tid in self.nodes[di]:
@@ -2582,12 +2679,14 @@ class DeviceTreeBatch:
             n_docs, d_saved = r.varint(), r.varint()
             cap, node_cap = r.varint(), r.varint()
             counts = [r.varint() for _ in range(d_saved)]
+            epoch = r.varint() if version >= 2 else 0
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceTreeBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceTreeBatch", d_saved, move_capacity=cap, node_capacity=node_cap)
         if not 0 < n_docs <= d_saved:
             raise DecodeError("DeviceTreeBatch state: implausible n_docs")
         batch = cls(n_docs, cap, node_cap, mesh=mesh)
+        batch.epoch = epoch
         for di in range(batch.d, d_saved):
             if counts[di]:
                 raise DecodeError("DeviceTreeBatch state: importer mesh too narrow")
@@ -2609,6 +2708,14 @@ class DeviceTreeBatch:
                         host[f][di, :k] = buf.astype(host[f].dtype)
                     host["valid"][di, :k] = True
                     batch.counts[di] = k
+                    me_b = kv.get(b"doc/%08d/moveepoch" % di)
+                    if me_b is not None:
+                        me = np.frombuffer(me_b, np.int64)
+                        if len(me) != k:
+                            raise DecodeError(
+                                "DeviceTreeBatch state: move epoch column length"
+                            )
+                        batch.move_epoch[di, :k] = me
                 nodes_b = kv.get(b"doc/%08d/nodes" % di)
                 if nodes_b is not None:
                     r = Reader(nodes_b)

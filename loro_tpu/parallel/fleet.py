@@ -684,11 +684,14 @@ class DeviceDocBatch:
         self.auto_grow = auto_grow
         self._c_pad = 256  # chain budget (doubles on overflow)
         self.counts = np.zeros(n_docs, np.int64)  # used rows per doc
-        # ingest epochs date tombstones for compaction: a tombstone may
-        # be reclaimed once every replica has acked the epoch that
-        # ingested its delete (see compact())
+        # ingest epochs date rows + tombstones for compaction: a
+        # tombstone may be reclaimed once every replica has acked the
+        # epoch that ingested its delete; row dates let layered batches
+        # (DeviceMovableBatch) date supersessions by their winner's
+        # ingest epoch (see compact())
         self.epoch = 0
         self.tomb_epoch = np.full((n_docs, capacity), -1, np.int64)
+        self.row_epoch = np.full((n_docs, capacity), -1, np.int64)
         # host-side id -> row resolution per doc (C++ hash map when the
         # native lib is available; batch stage/lookup/commit contract —
         # see parallel/idmap.py)
@@ -759,15 +762,32 @@ class DeviceDocBatch:
             sh,
         )
         self.key_hi, self.key_lo = keys["key_hi"], keys["key_lo"]
-        te = np.full((self.d, new_capacity), -1, np.int64)
-        te[:, : self.cap] = self.tomb_epoch
-        self.tomb_epoch = te
+        for name in ("tomb_epoch", "row_epoch"):
+            ne = np.full((self.d, new_capacity), -1, np.int64)
+            ne[:, : self.cap] = getattr(self, name)
+            setattr(self, name, ne)
         self.cap = new_capacity
 
-    def compact(self, stable_epochs: Sequence[Optional[int]]) -> int:
+    def compact(
+        self,
+        stable_epochs: Sequence[Optional[int]],
+        extra_protect: Optional[Sequence[Optional[np.ndarray]]] = None,
+        extra_dead: Optional[Sequence[Optional[np.ndarray]]] = None,
+        return_remaps: bool = False,
+    ):
         """Reclaim causally-stable tombstones (resident lifecycle, r4
         verdict #6; the reference analog is the shallow-snapshot floor,
         crates/loro-internal/src/encoding/shallow_snapshot.rs:16-40).
+
+        ``extra_protect[di]`` (optional row arrays) marks rows a caller
+        layers external references onto (DeviceMovableBatch's winning
+        slot rows); ``extra_dead[di]`` marks rows the caller asserts
+        are invisible AND stably so (superseded movable slots whose
+        winner's ingest epoch every replica acked) — they join the
+        droppable set under the same protection/subtree rules;
+        ``return_remaps=True`` additionally returns {di: old-row ->
+        new-row int array, -1 = dropped} so such callers can rewrite
+        their references.
 
         ``stable_epochs[di]`` is the newest ingest epoch (``self.epoch``
         after an append) that EVERY replica of doc di has acknowledged
@@ -810,6 +830,7 @@ class DeviceDocBatch:
         host = None  # fetched lazily on the first doc that compacts
         key_hi = key_lo = None
         reclaimed = 0
+        remaps: Dict[int, np.ndarray] = {}
         for di, stable_e in enumerate(stable_epochs):
             if stable_e is None or not int(self.counts[di]):
                 continue
@@ -826,6 +847,10 @@ class DeviceDocBatch:
             deleted = host["deleted"][di, :k]
             side = host["side"][di, :k].astype(np.int64)
             te = self.tomb_epoch[di, :k]
+            dead = deleted.copy()  # invisible rows: tombstones + caller's
+            if extra_dead is not None and extra_dead[di] is not None:
+                rows_d = np.asarray(extra_dead[di], np.int64)
+                dead[rows_d[rows_d < k]] = True
             # attach-target protection from the standing total order
             order = np.lexsort((key_lo[di, :k], key_hi[di, :k]))
             protected = np.zeros(k, bool)
@@ -835,18 +860,22 @@ class DeviceDocBatch:
             has_r = np.zeros(k, bool)
             rmask = side == 1
             has_r[parent[rmask][parent[rmask] >= 0]] = True
-            tgt = np.flatnonzero((~deleted) & has_r & (succ_of >= 0))
+            tgt = np.flatnonzero((~dead) & has_r & (succ_of >= 0))
             protected[succ_of[tgt]] = True
-            # expand-walk targets: the last tombstone before any
-            # non-deleted row (the walk steps over tombstones and can
-            # attach to the final one)
-            nd_succ = np.flatnonzero(
-                (succ_of >= 0) & deleted & ~deleted[np.clip(succ_of, 0, k - 1)]
-            )
-            protected[nd_succ] = True
-            # ...including the end-of-document window, whose final
-            # tombstone has no successor
-            protected[order[-1]] = True
+            if self.as_text:
+                # expand-walk targets (TEXT only — style anchors can
+                # appear at any future time and the anchor-aware walk
+                # steps over tombstones, attaching to the LAST one of an
+                # invisible window; list containers never grow anchors,
+                # so their isolated slot tombstones stay reclaimable):
+                # the last tombstone before any non-deleted row...
+                nd_succ = np.flatnonzero(
+                    (succ_of >= 0) & dead & ~dead[np.clip(succ_of, 0, k - 1)]
+                )
+                protected[nd_succ] = True
+                # ...and the end-of-document window's final tombstone,
+                # which has no successor
+                protected[order[-1]] = True
             # anchor rows never drop, live OR dead: a dead END anchor
             # with a live start means "style runs to EOF" (richtexts'
             # dead-end-never-pops rule) — dropping the row would discard
@@ -856,9 +885,14 @@ class DeviceDocBatch:
                     self.anchor_by_row[di], np.int64, len(self.anchor_by_row[di])
                 )
                 protected[rows_a[rows_a < k]] = True
-            stable_dead = (
-                deleted & (te >= 0) & (te <= int(stable_e)) & ~protected
-            )
+            if extra_protect is not None and extra_protect[di] is not None:
+                rows_x = np.asarray(extra_protect[di], np.int64)
+                protected[rows_x[rows_x < k]] = True
+            stable_dead = deleted & (te >= 0) & (te <= int(stable_e))
+            if extra_dead is not None and extra_dead[di] is not None:
+                # caller-asserted stability: superseded rows join as-is
+                stable_dead |= dead & ~deleted
+            stable_dead &= ~protected
             # Reverse pass (children have higher indices than parents):
             # a stable tombstone drops when it anchors no live subtree —
             # either no live children at all (dead subtree), or exactly
@@ -908,6 +942,7 @@ class DeviceDocBatch:
             old_rows = np.flatnonzero(keep)
             remap = np.full(k, -1, np.int64)
             remap[old_rows] = np.arange(n_keep)
+            remaps[di] = remap
             new_parent = dparent[old_rows]
             pos = new_parent >= 0
             new_parent[pos] = remap[new_parent[pos]]
@@ -939,6 +974,9 @@ class DeviceDocBatch:
             te_new = te[old_rows].copy()
             self.tomb_epoch[di, :] = -1
             self.tomb_epoch[di, :n_keep] = te_new
+            re_new = self.row_epoch[di, :k][old_rows]
+            self.row_epoch[di, :] = -1
+            self.row_epoch[di, :n_keep] = re_new
             # rebuild the order engine + standing keys by replay
             self.order[di] = self._fresh_order()
             keys = self.order[di].append_arrays(
@@ -980,7 +1018,7 @@ class DeviceDocBatch:
             )
             self.key_hi = jax.device_put(key_hi, sh)
             self.key_lo = jax.device_put(key_lo, sh)
-        return reclaimed
+        return (reclaimed, remaps) if return_remaps else reclaimed
 
     def _fresh_order(self):
         """A new order engine of the configured kind (compaction
@@ -1121,6 +1159,7 @@ class DeviceDocBatch:
                     f"{required} rows > {self.cap} (pass auto_grow=True "
                     "or call grow())"
                 )
+        self.epoch += 1  # post-validation: dates this append's rows
         # commit staged id maps + anchor metadata
         for di, overlay in enumerate(overlays):
             if overlay is None:
@@ -1184,6 +1223,7 @@ class DeviceDocBatch:
                 blk["deleted"][di, :k] = False
                 blk["content"][di, :k] = content_a
                 blk["valid"][di, :k] = True
+                self.row_epoch[di, base : base + k] = self.epoch
                 keys = self.order[di].append_arrays(
                     parent, side_a, pu, ctr_a, base
                 )
@@ -1559,10 +1599,14 @@ class DeviceDocBatch:
                 w.bytes_(cols[f][di, :k].astype(dt).tobytes())
             kv.set(b"doc/%08d/rows" % di, bytes(w.buf))
             if k:
-                # v2: tombstone ingest epochs (compaction dating)
+                # v2: tombstone + row ingest epochs (compaction dating)
                 kv.set(
                     b"doc/%08d/tombepoch" % di,
                     self.tomb_epoch[di, :k].astype(np.int64).tobytes(),
+                )
+                kv.set(
+                    b"doc/%08d/rowepoch" % di,
+                    self.row_epoch[di, :k].astype(np.int64).tobytes(),
                 )
             w = Writer()
             _state_write_values(w, d, self.value_store[di])
@@ -1665,14 +1709,18 @@ class DeviceDocBatch:
                     tgt[di, :k] = arrs[f].astype(tgt.dtype)
                 host["valid"][di, :k] = True
                 batch.counts[di] = k
-                te_b = kv.get(b"doc/%08d/tombepoch" % di)
-                if te_b is not None:
-                    te = np.frombuffer(te_b, np.int64)
-                    if len(te) != k:
-                        raise DecodeError(
-                            "DeviceDocBatch state: tomb epoch column length"
-                        )
-                    batch.tomb_epoch[di, :k] = te
+                for key, attr in (
+                    (b"doc/%08d/tombepoch" % di, "tomb_epoch"),
+                    (b"doc/%08d/rowepoch" % di, "row_epoch"),
+                ):
+                    e_b = kv.get(key)
+                    if e_b is not None:
+                        ecol = np.frombuffer(e_b, np.int64)
+                        if len(ecol) != k:
+                            raise DecodeError(
+                                "DeviceDocBatch state: epoch column length"
+                            )
+                        getattr(batch, attr)[di, :k] = ecol
                 peer_full = (arrs["peer_hi"].astype(np.uint64) << np.uint64(32)) | arrs[
                     "peer_lo"
                 ].astype(np.uint64)
@@ -3130,6 +3178,79 @@ class DeviceMovableBatch:
             rows_per_doc, overlays, move_rows, set_rows,
             staged_elems, staged_vals, del_pairs,
         )
+
+    @property
+    def epoch(self) -> int:
+        """Ingest-epoch clock (rides the inner seq batch; snapshot after
+        an append, pass back to compact() once every replica acked it)."""
+        return self.seq.epoch
+
+    def compact(self, stable_epochs: Sequence[Optional[int]]) -> int:
+        """Reclaim stable dead SLOT rows: tombstoned ones (deleted
+        elements' history) AND superseded ones — a move's losing slot is
+        invisible forever but only droppable once the WINNING slot's
+        ingest epoch is acked everywhere (a replica that hasn't seen the
+        winner still treats the old slot as visible).  Slots are
+        sequence elements, so the seq batch's compaction rules apply;
+        every element's winning slot row (the moves fold stores device
+        ROW indices) is protected and the fold is rewritten through the
+        row remap afterwards.  Element registries and value stores are
+        untouched (ordinals, not rows)."""
+        from ..ops.lww import NEG
+
+        stable_list = list(stable_epochs) + [None] * (self.d - len(stable_epochs))
+        if all(e is None for e in stable_list):
+            return 0  # nothing to do: skip the device fetches
+        mh = np.asarray(self.moves.value).copy()
+        # untouched fold slots carry the value FILL (0) — only slots a
+        # move actually folded into (lamport != NEG) reference rows
+        folded = np.asarray(self.moves.lamport) != int(NEG)
+        mh[~folded] = -1
+        content = np.asarray(self.seq.cols.content)
+        protect: List[Optional[np.ndarray]] = []
+        extra_dead: List[Optional[np.ndarray]] = []
+        for di in range(self.d):
+            wr = mh[di][mh[di] >= 0].astype(np.int64)
+            protect.append(np.unique(wr) if len(wr) else None)
+            stable_e = stable_list[di]
+            k = int(self.seq.counts[di])
+            if stable_e is None or not k or not len(wr):
+                extra_dead.append(None)
+                continue
+            # superseded slot r (element e = content[r], winner w != r)
+            # is stable-dead when the winner's ingest epoch is acked
+            e_arr = content[di, :k].astype(np.int64)
+            valid_e = e_arr >= 0
+            w_of_row = np.where(valid_e, mh[di][np.clip(e_arr, 0, None)], -1)
+            w_epoch = np.where(
+                w_of_row >= 0,
+                self.seq.row_epoch[di][np.clip(w_of_row, 0, None)],
+                -1,
+            )
+            sup = (
+                valid_e
+                & (w_of_row >= 0)
+                & (w_of_row != np.arange(k))
+                & (w_epoch >= 0)
+                & (w_epoch <= int(stable_e))
+            )
+            rows_s = np.flatnonzero(sup)
+            extra_dead.append(rows_s if len(rows_s) else None)
+        reclaimed, remaps = self.seq.compact(
+            stable_epochs,
+            extra_protect=protect,
+            extra_dead=extra_dead,
+            return_remaps=True,
+        )
+        if reclaimed and remaps:
+            for di, remap in remaps.items():
+                row = mh[di]
+                mask = (row >= 0) & (row < len(remap))
+                row[mask] = remap[row[mask]]
+            self.moves = self.moves._replace(
+                value=jax.device_put(mh, doc_sharding(self.mesh))
+            )
+        return reclaimed
 
     def grow(self, capacity: int = None, elem_capacity: int = None) -> None:
         """Repack: slot rows grow through the inner seq batch; element

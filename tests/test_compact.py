@@ -285,6 +285,130 @@ class TestCompact:
         assert batch.texts() == [d.get_text("t").to_string() for d in docs]
 
 
+class TestListCompact:
+    """as_text=False compaction under CONCURRENT replicas: the expand-
+    walk protection is text-only (lists never grow style anchors), so
+    isolated list tombstones reclaim — this fuzz gates that narrowing
+    against the host oracle."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_concurrent(self, seed):
+        rng = random.Random(0x115 + seed)
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        la = a.get_list("l")
+        for i in range(6):
+            la.push(f"base{i}")
+        a.commit()
+        b.import_(a.export_snapshot())
+        cid = la.id
+        batch = DeviceDocBatch(n_docs=1, capacity=4096, as_text=False,
+                               auto_grow=True)
+        batch.append_changes([a.oplog.changes_in_causal_order()], cid)
+        mark = a.oplog_vv()
+        reclaimed = 0
+        for epoch in range(8):
+            for d in (a, b):
+                lst = d.get_list("l")
+                for _ in range(rng.randint(2, 8)):
+                    L = len(lst.get_value())
+                    r = rng.random()
+                    if L > 2 and r < 0.45:
+                        p0 = rng.randrange(L - 1)
+                        lst.delete(p0, min(rng.randint(1, 3), L - p0))
+                    else:
+                        lst.insert(rng.randint(0, L), rng.choice(
+                            [f"x{epoch}", 1.5, None, {"k": epoch}]
+                        ))
+                d.commit()
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+            batch.append_changes([a.oplog.changes_between(mark, a.oplog_vv())], cid)
+            mark = a.oplog_vv()
+            assert batch.values() == [la.get_value()], f"seed {seed} ep {epoch}"
+            if epoch % 2 == 1:
+                reclaimed += batch.compact([batch.epoch])
+                assert batch.values() == [la.get_value()], (
+                    f"seed {seed} ep {epoch} post-compact"
+                )
+        assert reclaimed > 0, f"seed {seed}: list fuzz never reclaimed"
+
+
+class TestMovableCompact:
+    """Slot-row compaction: the moves fold's device row references are
+    protected and rewritten through the remap."""
+
+    def test_churn_reclaims_and_preserves(self):
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("m")
+        ml.push(*[f"v{i}" for i in range(6)])
+        doc.commit()
+        batch = DeviceMovableBatch(n_docs=1, capacity=512, elem_capacity=64)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], ml.id)
+        vv = doc.oplog_vv()
+        for i in range(10):  # move churn: each move tombstones a slot
+            ml.move(i % len(ml.get_value()), (i * 3) % len(ml.get_value()))
+            ml.set(i % len(ml.get_value()), f"set{i}")
+        ml.delete(1, 2)
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(vv, doc.oplog_vv())], ml.id)
+        want = ml.get_value()
+        assert batch.value_lists() == [want]
+        before = int(batch.seq.counts[0])
+        n = batch.compact([batch.epoch])
+        assert n > 0 and int(batch.seq.counts[0]) == before - n
+        assert batch.value_lists() == [want]
+        # continued ingest after the remap
+        vv = doc.oplog_vv()
+        ml.push("post-gc")
+        ml.move(0, len(ml.get_value()) - 1)
+        doc.commit()
+        batch.append_changes([doc.oplog.changes_between(vv, doc.oplog_vv())], ml.id)
+        assert batch.value_lists() == [ml.get_value()]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz_concurrent(self, seed):
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        rng = random.Random(0x30AB + seed)
+        a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+        ma = a.get_movable_list("m")
+        ma.push(*[f"s{i}" for i in range(4)])
+        a.commit()
+        b.import_(a.export_snapshot())
+        cid = ma.id
+        batch = DeviceMovableBatch(n_docs=1, capacity=4096, elem_capacity=512,
+                                   auto_grow=True)
+        batch.append_changes([a.oplog.changes_in_causal_order()], cid)
+        mark = a.oplog_vv()
+        for epoch in range(6):
+            for d in (a, b):
+                m = d.get_movable_list("m")
+                for _ in range(rng.randint(1, 6)):
+                    L = len(m.get_value())
+                    r = rng.random()
+                    if L and r < 0.3:
+                        m.move(rng.randrange(L), rng.randrange(L))
+                    elif L and r < 0.5:
+                        m.set(rng.randrange(L), rng.random())
+                    elif L > 2 and r < 0.65:
+                        m.delete(rng.randrange(L - 1), 1)
+                    else:
+                        m.insert(rng.randint(0, L), f"e{epoch}{rng.random():.3f}")
+                d.commit()
+            a.import_(b.export_updates(a.oplog_vv()))
+            b.import_(a.export_updates(b.oplog_vv()))
+            batch.append_changes([a.oplog.changes_between(mark, a.oplog_vv())], cid)
+            mark = a.oplog_vv()
+            assert batch.value_lists() == [ma.get_value()], f"seed {seed} epoch {epoch}"
+            if epoch % 2 == 1:
+                batch.compact([batch.epoch])
+                assert batch.value_lists() == [ma.get_value()], (
+                    f"seed {seed} epoch {epoch} post-compact"
+                )
+
+
 class TestTreeCompact:
     """Move-log compaction: superseded/rejected stable moves drop, the
     materialized tree (parents AND child order) is unchanged, and

@@ -3433,6 +3433,14 @@ class DeviceMovableBatch:
                 h[:lim] = g[:lim]
             if name == "vals":
                 vals_host_value = host[3]
+            elif name == "moves":
+                # folded slot-row references must stay inside the seq
+                # buffer (compact's winner-epoch lookup and the kernel's
+                # row gathers index with them)
+                folded = host[0] != int(NEG)
+                wrow = host[3][folded].astype(np.int64)
+                if wrow.size and (wrow.min() < 0 or wrow.max() >= batch.seq.cap):
+                    raise DecodeError("DeviceMovableBatch state: winner row")
             setattr(batch, name, LwwResident(*[jax.device_put(h, sh) for h in host]))
         try:
             for di in range(lim):

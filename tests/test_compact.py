@@ -367,6 +367,26 @@ class TestMovableCompact:
         batch.append_changes([doc.oplog.changes_between(vv, doc.oplog_vv())], ml.id)
         assert batch.value_lists() == [ml.get_value()]
 
+    def test_corrupt_winner_row_rejected_at_import(self):
+        """Review r5: a checkpoint whose moves fold references a slot
+        row beyond the seq buffer must raise DecodeError, not IndexError
+        in a later compact()."""
+        from loro_tpu.errors import DecodeError
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("m")
+        ml.push("a", "b")
+        doc.commit()
+        batch = DeviceMovableBatch(n_docs=1, capacity=64, elem_capacity=8)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], ml.id)
+        batch.moves = batch.moves._replace(
+            value=batch.moves.value.at[0, 0].set(1 << 20),  # >> seq.cap
+            lamport=batch.moves.lamport.at[0, 0].set(5),  # folded slot
+        )
+        with pytest.raises(DecodeError, match="winner row"):
+            DeviceMovableBatch.import_state(batch.export_state())
+
     @pytest.mark.parametrize("seed", range(4))
     def test_fuzz_concurrent(self, seed):
         from loro_tpu.parallel.fleet import DeviceMovableBatch

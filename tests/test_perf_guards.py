@@ -234,3 +234,50 @@ def test_native_order_engine_floor():
     best = _best_of(one, k, reps=5)
     rate = k / best
     assert rate > 500_000, f"native order engine at {rate/1e6:.2f}M rows/s (< 0.5M floor)"
+
+
+def test_resident_ingest_floor():
+    """Full resident ingest floor (r5 host-funnel rebuild measured
+    ~1.1M rows/s/core steady at 768-row epochs): order maintenance +
+    native id maps + columnar staging + block scatter must stay above a
+    conservative floor, so per-row Python can't silently creep back
+    into the hot path.  Generous vs the measured rate — this guards
+    order-of-magnitude regressions, not session load variance."""
+    import random as _random
+
+    from loro_tpu import LoroDoc
+    from loro_tpu.doc import strip_envelope
+    from loro_tpu.parallel.fleet import DeviceDocBatch
+
+    rng = _random.Random(0xF100D)
+    doc = LoroDoc(peer=1)
+    t = doc.get_text("t")
+    eps = []
+    for _ in range(4):
+        vv = doc.oplog_vv()
+        made = 0
+        while made < 768:
+            L = len(t)
+            if L > 8 and rng.random() < 0.15:
+                p = rng.randrange(L - 1)
+                d = min(rng.randint(1, 3), L - p)
+                t.delete(p, d)
+                made += d
+            else:
+                run = rng.randint(1, 12)
+                t.insert(rng.randint(0, L), "abcdefghijkl"[:run])
+                made += run
+        doc.commit()
+        eps.append(strip_envelope(doc.export_updates(vv)))
+    batch = DeviceDocBatch(16, capacity=1 << 13)
+    rates = []
+    for pl in eps:
+        t0 = time.perf_counter()
+        batch.append_payloads([pl] * 16, doc.get_text("t").id)
+        rates.append(16 * 768 / (time.perf_counter() - t0))
+    best = max(rates)  # best epoch: least load/compile confounded
+    assert best > 150_000, (
+        f"resident ingest at {best/1e3:.0f}k rows/s best-epoch "
+        "(< 150k floor; steady-state measured ~1.1M on an idle core)"
+    )
+    assert batch.texts()[0] == t.to_string()

@@ -113,6 +113,66 @@ def test_escaping_decode_error_aborts_staged_ids():
     ]
 
 
+def test_fleet_cross_engine_differential(monkeypatch):
+    """The Python id map + order engine must produce byte-identical
+    fleet results to the native pair on the same concurrent trace
+    (the fallback IS the oracle — CLAUDE.md invariant)."""
+    import random
+
+    from loro_tpu import LoroDoc
+    from loro_tpu.doc import strip_envelope
+    from loro_tpu.parallel.fleet import DeviceDocBatch
+
+    rng = random.Random(0xD1FF)
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    ta = a.get_text("t")
+    ta.insert(0, "cross engine base")
+    a.commit()
+    b.import_(a.export_snapshot())
+    cid = ta.id
+    payloads = [strip_envelope(a.export_updates({}))]
+    mark = a.oplog_vv()
+    for _ in range(3):
+        for d in (a, b):
+            t = d.get_text("t")
+            for _ in range(5):
+                L = len(t)
+                if L > 6 and rng.random() < 0.35:
+                    p = rng.randrange(L - 1)
+                    t.delete(p, min(2, L - p))
+                else:
+                    t.insert(rng.randint(0, L), rng.choice(["ab", "c", "def"]))
+            t.mark(0, min(4, len(t)), "bold", True)
+            d.commit()
+        a.import_(b.export_updates(a.oplog_vv()))
+        b.import_(a.export_updates(b.oplog_vv()))
+        payloads.append(strip_envelope(a.export_updates(mark)))
+        mark = a.oplog_vv()
+
+    def run(py: bool):
+        if py:
+            monkeypatch.setenv("LORO_PY_IDMAP", "1")
+            monkeypatch.setenv("LORO_PY_ORDER", "1")
+        else:
+            monkeypatch.delenv("LORO_PY_IDMAP", raising=False)
+            monkeypatch.delenv("LORO_PY_ORDER", raising=False)
+        batch = DeviceDocBatch(n_docs=1, capacity=2048)
+        for pl in payloads:
+            batch.append_payloads([pl], cid)
+        batch.compact([batch.epoch])
+        out = (batch.texts(), batch.richtexts(),
+               np.asarray(batch.key_hi).tolist(), int(batch.counts[0]))
+        # continue after compaction too
+        return out
+
+    native = run(py=False)
+    pure = run(py=True)
+    assert native[0] == pure[0] == [ta.to_string()]
+    assert native[1] == pure[1]
+    assert native[3] == pure[3]
+    assert native[2] == pure[2]  # standing keys bit-identical
+
+
 def test_capacity_error_leaves_idmap_unstaged():
     """A capacity overflow during append must abort staged ids: the next
     (smaller) append still resolves parents against the committed view

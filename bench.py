@@ -1130,14 +1130,16 @@ def main_guarded() -> None:
     for stale in glob.glob(os.path.join(base, ".bench_out_*.jsonl")) + glob.glob(
         os.path.join(base, ".bench_checkpoint_*.json*")
     ):
-        # only reap files whose embedded owner pid is dead — a live pid
-        # means a CONCURRENT invocation (e.g. the watcher ladder) whose
-        # parent will still read this path by name
+        # only reap files whose embedded owner pid is provably dead — a
+        # live pid means a CONCURRENT invocation (e.g. the watcher
+        # ladder), and a non-pid name (a BENCH_CHECKPOINT override that
+        # happens to match the glob) is not ours to judge
         m = re.search(r"_(\d+)\.(?:jsonl|json(?:\.cpu)?)$", stale)
+        if not m:
+            continue
         try:
-            if m:
-                os.kill(int(m.group(1)), 0)  # raises if pid is gone
-                continue
+            os.kill(int(m.group(1)), 0)  # raises if pid is gone
+            continue
         except ProcessLookupError:
             pass
         except OSError:

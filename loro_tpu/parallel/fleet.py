@@ -639,6 +639,15 @@ def _grow_target(required: int, current: int) -> int:
     return pad_bucket(required, floor=max(16, 2 * current))
 
 
+def _lww_fills(value_fill: int) -> Dict[str, object]:
+    """Fill values for LwwResident columns — ONE table shared by the
+    grow()/import paths of the map and movable batches so they cannot
+    drift from each other (the value fill is the only per-use field)."""
+    from ..ops.lww import NEG
+
+    return dict(lamport=int(NEG), peer_hi=0, peer_lo=0, value=value_fill)
+
+
 def _resolve_row(overlay, idmap, key, di, what):
     """Overlay-then-idmap row lookup that raises a typed, actionable
     error for unknown ids (shared by every resident ingest walk)."""
@@ -1591,6 +1600,7 @@ class DeviceDocBatch:
         for di in range(self.d):
             meta.varint(int(self.counts[di]))
         meta.varint(self.epoch)  # v2: compaction epoch clock
+        meta.u8(1 if self.auto_grow else 0)  # v2: lifecycle flag
         kv.set(b"meta", bytes(meta.buf))
         for di in range(self.d):
             k = int(self.counts[di])
@@ -1657,12 +1667,13 @@ class DeviceDocBatch:
                 raise DecodeError("DeviceDocBatch state: bad chain budget")
             counts = [r.varint() for _ in range(d_saved)]
             epoch = r.varint() if version >= 2 else 0
+            auto_grow = (r.u8() == 1) if version >= 2 else False
         except (IndexError, ValueError, struct.error) as e:
             raise DecodeError(f"DeviceDocBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceDocBatch", d_saved, capacity=cap)
         if not 0 < n_docs <= d_saved:
             raise DecodeError("DeviceDocBatch state: implausible n_docs")
-        batch = cls(n_docs, cap, mesh=mesh, as_text=as_text)
+        batch = cls(n_docs, cap, mesh=mesh, as_text=as_text, auto_grow=auto_grow)
         batch._c_pad = c_pad
         batch.epoch = epoch
         # mesh-pad docs beyond the importer's width must be empty (they
@@ -1942,11 +1953,11 @@ class DeviceMapBatch:
     def grow(self, new_slot_capacity: int) -> None:
         """Repack the LWW winner columns to a larger slot capacity
         (resident lifecycle, r4 verdict #6)."""
-        from ..ops.lww import NEG, LwwResident
+        from ..ops.lww import LwwResident
 
         if new_slot_capacity <= self.s:
             return
-        fills = dict(lamport=int(NEG), peer_hi=0, peer_lo=0, value=-2)
+        fills = _lww_fills(-2)
         res = _pad_axis1(
             {f: getattr(self.res, f) for f in self.res._fields},
             new_slot_capacity, fills, doc_sharding(self.mesh),
@@ -2139,7 +2150,7 @@ class DeviceMapBatch:
         return out
 
     # -- checkpoint/resume --------------------------------------------
-    STATE_VERSION = 1
+    STATE_VERSION = 2  # v2: + auto_grow lifecycle flag
 
     def export_state(self) -> bytes:
         """Serialize the resident winners + slot/value dictionaries into
@@ -2154,6 +2165,7 @@ class DeviceMapBatch:
         meta.varint(self.n_docs)
         meta.varint(self.d)
         meta.varint(self.s)
+        meta.u8(1 if self.auto_grow else 0)  # v2
         kv.set(b"meta", bytes(meta.buf))
         _state_write_grid(kv, b"res", [np.asarray(a) for a in self.res])
         for di in range(self.d):
@@ -2188,13 +2200,14 @@ class DeviceMapBatch:
             if version > cls.STATE_VERSION:
                 raise DecodeError(f"DeviceMapBatch state v{version} too new")
             n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
+            auto_grow = (r.u8() == 1) if version >= 2 else False
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceMapBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceMapBatch", d_saved, slot_capacity=s)
         if not 0 < n_docs <= d_saved:
             raise DecodeError("DeviceMapBatch state: implausible n_docs")
         peers, cids = _state_read_dicts(dicts_b)
-        batch = cls(n_docs, s, mesh=mesh)
+        batch = cls(n_docs, s, mesh=mesh, auto_grow=auto_grow)
         res_b = kv.get(b"res")
         if res_b is None:
             raise DecodeError("DeviceMapBatch state: missing res")
@@ -2674,6 +2687,7 @@ class DeviceTreeBatch:
         for di in range(self.d):
             meta.varint(int(self.counts[di]))
         meta.varint(self.epoch)  # v2
+        meta.u8(1 if self.auto_grow else 0)  # v2
         kv.set(b"meta", bytes(meta.buf))
         cols = {f: np.asarray(getattr(self.cols, f)) for f, _ in self._STATE_SCHEMA}
         for di in range(self.d):
@@ -2728,12 +2742,13 @@ class DeviceTreeBatch:
             cap, node_cap = r.varint(), r.varint()
             counts = [r.varint() for _ in range(d_saved)]
             epoch = r.varint() if version >= 2 else 0
+            auto_grow = (r.u8() == 1) if version >= 2 else False
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceTreeBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceTreeBatch", d_saved, move_capacity=cap, node_capacity=node_cap)
         if not 0 < n_docs <= d_saved:
             raise DecodeError("DeviceTreeBatch state: implausible n_docs")
-        batch = cls(n_docs, cap, node_cap, mesh=mesh)
+        batch = cls(n_docs, cap, node_cap, mesh=mesh, auto_grow=auto_grow)
         batch.epoch = epoch
         for di in range(batch.d, d_saved):
             if counts[di]:
@@ -3243,19 +3258,23 @@ class DeviceMovableBatch:
             return_remaps=True,
         )
         if reclaimed and remaps:
+            # rewrite on a FRESH copy: mh is the protection scratch with
+            # unfolded slots forced to -1, and persisting that would
+            # change the documented fill (0) of untouched fold slots
+            out = np.asarray(self.moves.value).copy()
             for di, remap in remaps.items():
-                row = mh[di]
-                mask = (row >= 0) & (row < len(remap))
+                row = out[di]
+                mask = folded[di] & (row >= 0) & (row < len(remap))
                 row[mask] = remap[row[mask]]
             self.moves = self.moves._replace(
-                value=jax.device_put(mh, doc_sharding(self.mesh))
+                value=jax.device_put(out, doc_sharding(self.mesh))
             )
         return reclaimed
 
     def grow(self, capacity: int = None, elem_capacity: int = None) -> None:
         """Repack: slot rows grow through the inner seq batch; element
         winner columns re-pad here (resident lifecycle, r4 verdict #6)."""
-        from ..ops.lww import NEG, LwwResident
+        from ..ops.lww import LwwResident
 
         if capacity is not None:
             self.seq.grow(capacity)
@@ -3263,7 +3282,7 @@ class DeviceMovableBatch:
             sh = doc_sharding(self.mesh)
             for name, vfill in (("moves", 0), ("vals", -2)):
                 res = getattr(self, name)
-                fills = dict(lamport=int(NEG), peer_hi=0, peer_lo=0, value=vfill)
+                fills = _lww_fills(vfill)
                 setattr(
                     self,
                     name,
@@ -3340,7 +3359,7 @@ class DeviceMovableBatch:
             )
 
     # -- checkpoint/resume --------------------------------------------
-    STATE_VERSION = 1
+    STATE_VERSION = 2  # v2: + auto_grow lifecycle flag
 
     def export_state(self) -> bytes:
         """Serialize the movable batch: the nested slot-sequence batch
@@ -3356,6 +3375,7 @@ class DeviceMovableBatch:
         meta.varint(self.n_docs)
         meta.varint(self.d)
         meta.varint(self.e_cap)
+        meta.u8(1 if self.auto_grow else 0)  # v2
         kv.set(b"meta", bytes(meta.buf))
         kv.set(b"seq", self.seq.export_state())
         _state_write_grid(kv, b"moves", [np.asarray(a) for a in self.moves])
@@ -3392,6 +3412,7 @@ class DeviceMovableBatch:
             if version > cls.STATE_VERSION:
                 raise DecodeError(f"DeviceMovableBatch state v{version} too new")
             n_docs, d_saved, e_cap = r.varint(), r.varint(), r.varint()
+            auto_grow = (r.u8() == 1) if version >= 2 else False
         except (IndexError, ValueError) as e:
             raise DecodeError(
                 f"DeviceMovableBatch state: malformed meta ({e})"
@@ -3407,6 +3428,7 @@ class DeviceMovableBatch:
         batch.n_docs = n_docs
         batch.d = seq.d
         batch.e_cap = e_cap
+        batch.auto_grow = auto_grow  # review r5: __new__ skips __init__
         batch.elem_ids = [dict() for _ in range(batch.d)]
         batch.values = [[] for _ in range(batch.d)]
         sh = doc_sharding(batch.mesh)
@@ -3424,7 +3446,8 @@ class DeviceMovableBatch:
             )
             from ..ops.lww import NEG
 
-            defaults = (int(NEG), 0, 0, 0 if name == "moves" else -2)
+            _f = _lww_fills(0 if name == "moves" else -2)
+            defaults = (_f["lamport"], _f["peer_hi"], _f["peer_lo"], _f["value"])
             host = [
                 np.full((batch.d, e_cap), fill, dt)
                 for fill, dt in zip(defaults, (np.int32, np.uint32, np.uint32, np.int32))
@@ -3698,7 +3721,7 @@ class DeviceCounterBatch:
         ]
 
     # -- checkpoint/resume --------------------------------------------
-    STATE_VERSION = 1
+    STATE_VERSION = 2  # v2: + auto_grow lifecycle flag
 
     def export_state(self) -> bytes:
         from ..codec.binary import Writer, _Dicts
@@ -3711,6 +3734,7 @@ class DeviceCounterBatch:
         meta.varint(self.n_docs)
         meta.varint(self.d)
         meta.varint(self.s)
+        meta.u8(1 if self.auto_grow else 0)  # v2
         kv.set(b"meta", bytes(meta.buf))
         _state_write_grid(kv, b"sums", [np.asarray(self.sums)])
         for di in range(self.d):
@@ -3740,13 +3764,14 @@ class DeviceCounterBatch:
             if version > cls.STATE_VERSION:
                 raise DecodeError(f"DeviceCounterBatch state v{version} too new")
             n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
+            auto_grow = (r.u8() == 1) if version >= 2 else False
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceCounterBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceCounterBatch", d_saved, slot_capacity=s)
         if not 0 < n_docs <= d_saved:
             raise DecodeError("DeviceCounterBatch state: implausible n_docs")
         _peers, cids = _state_read_dicts(dicts_b)
-        batch = cls(n_docs, s, mesh=mesh)
+        batch = cls(n_docs, s, mesh=mesh, auto_grow=auto_grow)
         sums_b = kv.get(b"sums")
         if sums_b is None:
             raise DecodeError("DeviceCounterBatch state: missing sums")

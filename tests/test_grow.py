@@ -63,6 +63,87 @@ class TestSeqGrow:
         assert restored.texts() == ["persist me"]
 
 
+class TestLifecycleRestart:
+    """Review r5 (high pass): auto_grow must survive checkpoint/restore
+    for every family — a restarted auto-grow server must keep growing,
+    not die at the next capacity bucket."""
+
+    def test_seq_auto_grow_roundtrip(self):
+        from loro_tpu.parallel.fleet import DeviceDocBatch
+
+        doc = _text_doc(1, "restart me")
+        batch = DeviceDocBatch(n_docs=1, capacity=16, auto_grow=True)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], doc.get_text("t").id)
+        restored = DeviceDocBatch.import_state(batch.export_state())
+        assert restored.auto_grow is True
+        vv = doc.oplog_vv()
+        doc.get_text("t").insert(0, "x" * 40)  # crosses capacity 16
+        doc.commit()
+        restored.append_changes(
+            [doc.oplog.changes_between(vv, doc.oplog_vv())], doc.get_text("t").id
+        )
+        assert restored.cap > 16
+        assert restored.texts() == [doc.get_text("t").to_string()]
+
+    def test_movable_restored_batch_grows(self):
+        """The movable import path constructs via __new__ — it must
+        still carry the lifecycle flag (regression: AttributeError at
+        the first element-capacity check)."""
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("m")
+        ml.push("a", "b")
+        doc.commit()
+        batch = DeviceMovableBatch(n_docs=1, capacity=64, elem_capacity=4,
+                                   auto_grow=True)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], ml.id)
+        restored = DeviceMovableBatch.import_state(batch.export_state())
+        assert restored.auto_grow is True
+        vv = doc.oplog_vv()
+        for i in range(8):  # crosses elem_capacity=4
+            ml.push(i)
+        doc.commit()
+        restored.append_changes([doc.oplog.changes_between(vv, doc.oplog_vv())], ml.id)
+        assert restored.e_cap > 4
+        assert restored.value_lists() == [ml.get_value()]
+
+    def test_movable_restored_without_auto_grow_raises(self):
+        from loro_tpu.parallel.fleet import DeviceMovableBatch
+
+        doc = LoroDoc(peer=1)
+        ml = doc.get_movable_list("m")
+        ml.push("a")
+        doc.commit()
+        batch = DeviceMovableBatch(n_docs=1, capacity=64, elem_capacity=2)
+        batch.append_changes([doc.oplog.changes_in_causal_order()], ml.id)
+        restored = DeviceMovableBatch.import_state(batch.export_state())
+        assert restored.auto_grow is False
+        vv = doc.oplog_vv()
+        for i in range(6):
+            ml.push(i)
+        doc.commit()
+        with pytest.raises(RuntimeError, match="element capacity"):
+            restored.append_changes(
+                [doc.oplog.changes_between(vv, doc.oplog_vv())], ml.id
+            )
+
+    def test_map_tree_counter_flag_roundtrip(self):
+        from loro_tpu.parallel.fleet import (
+            DeviceCounterBatch,
+            DeviceMapBatch,
+            DeviceTreeBatch,
+        )
+
+        m = DeviceMapBatch(n_docs=1, slot_capacity=4, auto_grow=True)
+        assert DeviceMapBatch.import_state(m.export_state()).auto_grow is True
+        t = DeviceTreeBatch(n_docs=1, move_capacity=16, node_capacity=4,
+                            auto_grow=True)
+        assert DeviceTreeBatch.import_state(t.export_state()).auto_grow is True
+        c = DeviceCounterBatch(n_docs=1, slot_capacity=4, auto_grow=True)
+        assert DeviceCounterBatch.import_state(c.export_state()).auto_grow is True
+
+
 class TestMapGrow:
     def test_auto_grow_slots(self):
         from loro_tpu.parallel.fleet import DeviceMapBatch

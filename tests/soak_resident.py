@@ -128,6 +128,18 @@ for epoch in range(EPOCHS):
     ctr_b.append_changes(ups)
     ml_b.append_changes(ups, cid_ml)
 
+    if epoch % 2 == 1:
+        # compaction epochs: every pair is fully synced above, so all
+        # ingested epochs are stable — the oracle gates below re-check
+        # every family after reclamation (text/richtext through anchors,
+        # tree child order, movable slot remaps)
+        gc = (
+            docs_b.compact([docs_b.epoch] * docs_b.d)
+            + tree_b.compact([tree_b.epoch] * tree_b.d)
+            + ml_b.compact([ml_b.epoch] * ml_b.d)
+        )
+        print(f"  epoch {epoch}: compaction reclaimed {gc} rows")
+
     texts = docs_b.texts()
     segs = docs_b.richtexts()
     mvals = maps_b.root_value_maps("m")

@@ -1,0 +1,247 @@
+"""Batteries-included resident sync server: one device batch + the
+ack bookkeeping that makes its lifecycle (grow/compact) safe to use.
+
+The resident batches expose a precise but easy-to-misuse contract:
+``compact(stable_epochs)`` may only receive epochs that EVERY replica
+of a doc has acknowledged integrating — passing a too-new epoch can
+reclaim a tombstone some replica still references (see
+DeviceDocBatch.compact).  This wrapper owns that bookkeeping:
+
+- ``ingest(per_doc_updates)`` feeds a sync round into the batch and
+  returns the epoch to hand to clients with the round's fan-out;
+- ``ack(di, replica, epoch)`` records a replica's acknowledgment;
+- ``compact()`` reclaims with each doc's stability floor =
+  min over its registered replicas' acked epochs (docs with no
+  registered replicas never compact — safe default);
+- ``checkpoint()/restore()`` round-trip batch + acks through LTKV
+  bytes, so a restarted server resumes with its compaction floors.
+
+Reference analog: the two-round sync loop of the reference's README
+(crates/loro/README) plus its shallow-snapshot floor
+(crates/loro-internal/src/encoding/shallow_snapshot.rs:16-40), packaged
+server-side at fleet scale.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .fleet import (
+    DeviceCounterBatch,
+    DeviceDocBatch,
+    DeviceMapBatch,
+    DeviceMovableBatch,
+    DeviceTreeBatch,
+)
+
+# ONE table per family: (batch class for restore, constructor) — both
+# checkpoint/restore and __init__ resolve from it, so they cannot drift
+_FAMILIES = {
+    "text": (DeviceDocBatch, lambda n, mesh, auto_grow, kw: DeviceDocBatch(
+        n, kw.get("capacity", 1 << 14), mesh=mesh, auto_grow=auto_grow
+    )),
+    "list": (DeviceDocBatch, lambda n, mesh, auto_grow, kw: DeviceDocBatch(
+        n, kw.get("capacity", 1 << 14), mesh=mesh, as_text=False,
+        auto_grow=auto_grow,
+    )),
+    "map": (DeviceMapBatch, lambda n, mesh, auto_grow, kw: DeviceMapBatch(
+        n, kw.get("slot_capacity", 1 << 10), mesh=mesh, auto_grow=auto_grow
+    )),
+    "tree": (DeviceTreeBatch, lambda n, mesh, auto_grow, kw: DeviceTreeBatch(
+        n, kw.get("move_capacity", 1 << 12), kw.get("node_capacity", 1 << 10),
+        mesh=mesh, auto_grow=auto_grow,
+    )),
+    "movable": (DeviceMovableBatch, lambda n, mesh, auto_grow, kw: DeviceMovableBatch(
+        n, kw.get("capacity", 1 << 13), kw.get("elem_capacity", 1 << 10),
+        mesh=mesh, auto_grow=auto_grow,
+    )),
+    "counter": (DeviceCounterBatch, lambda n, mesh, auto_grow, kw: DeviceCounterBatch(
+        n, kw.get("slot_capacity", 1 << 6), mesh=mesh, auto_grow=auto_grow
+    )),
+}
+_COMPACTABLE = ("text", "list", "tree", "movable")
+
+
+class ResidentServer:
+    """One resident device batch + per-doc replica-ack bookkeeping.
+
+    ``family``: "text" | "list" | "map" | "tree" | "movable" |
+    "counter".  Capacity knobs pass through (capacity, slot_capacity,
+    move_capacity, node_capacity, elem_capacity).  The underlying batch
+    is ``self.batch`` — every read API (texts/richtexts/values/
+    value_lists/parent_maps/...) is used directly on it.
+    """
+
+    def __init__(self, family: str, n_docs: int, mesh=None,
+                 auto_grow: bool = True, **caps):
+        if family not in _FAMILIES:
+            raise ValueError(f"unknown family {family!r} (one of {sorted(_FAMILIES)})")
+        self.family = family
+        self.batch = _FAMILIES[family][1](n_docs, mesh, auto_grow, caps)
+        self.n_docs = n_docs
+        # acks[di][replica] = newest epoch that replica confirmed
+        self.acks: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
+        self._compacted_at: List[int] = [0] * n_docs
+
+    # -- sync rounds ---------------------------------------------------
+    def ingest(self, per_doc_updates: Sequence, cid=None) -> int:
+        """Feed one sync round (per-doc update payloads via the native
+        path when bytes, else change lists; None = no update) and
+        return the epoch clients must ack once they integrate the
+        round's fan-out."""
+        batch = self.batch
+        use_payloads = any(isinstance(u, (bytes, bytearray))
+                           for u in per_doc_updates if u is not None)
+        if use_payloads and not hasattr(batch, "append_payloads"):
+            # families without a native payload path (counter) decode
+            # host-side instead of mis-feeding raw bytes downstream
+            from ..codec.binary import decode_changes
+
+            per_doc_updates = [
+                decode_changes(u) if isinstance(u, (bytes, bytearray)) else u
+                for u in per_doc_updates
+            ]
+            use_payloads = False
+        if self.family in ("map", "counter"):
+            if use_payloads:
+                batch.append_payloads(per_doc_updates)
+            else:
+                batch.append_changes(per_doc_updates)
+        else:
+            if cid is None:
+                raise ValueError(f"{self.family} ingest needs the container id")
+            if use_payloads:
+                batch.append_payloads(per_doc_updates, cid)
+            else:
+                batch.append_changes(per_doc_updates, cid)
+        return self.epoch
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.batch, "epoch", 0)
+
+    # -- acknowledgment bookkeeping -----------------------------------
+    def register_replica(self, di: int, replica: str) -> None:
+        """A doc's replica set must be registered before its acks count
+        — an unregistered replica set means 'unknown readers', which
+        pins the doc's stability floor at 0 (never compact)."""
+        self.acks[di].setdefault(replica, 0)
+
+    def ack(self, di: int, replica: str, epoch: int) -> None:
+        """Record that `replica` integrated everything the server sent
+        up to `epoch` (monotone; stale acks are ignored).  The replica
+        must have been registered: silently admitting an unknown name
+        would let a PARTIAL replica set define the stability floor and
+        reclaim rows an unregistered reader still references."""
+        if replica not in self.acks[di]:
+            raise ValueError(
+                f"doc {di}: ack from unregistered replica {replica!r} — "
+                "call register_replica first (the full replica set "
+                "defines the compaction floor)"
+            )
+        if epoch > self.acks[di][replica]:
+            self.acks[di][replica] = epoch
+
+    def drop_replica(self, di: int, replica: str) -> None:
+        """Forget a departed replica so it stops pinning the floor.
+        Only do this once the replica is PERMANENTLY gone — a returning
+        replica that missed deletes may reference reclaimed rows."""
+        self.acks[di].pop(replica, None)
+
+    def stable_epoch(self, di: int) -> int:
+        """The doc's compaction floor: the newest epoch every
+        registered replica has acked (0 = no floor)."""
+        a = self.acks[di]
+        return min(a.values()) if a else 0
+
+    # -- lifecycle -----------------------------------------------------
+    def compact(self) -> int:
+        """Reclaim what the ack floors allow (no-op for map/counter —
+        their resident state is already a fold).  Returns rows
+        reclaimed."""
+        if self.family not in _COMPACTABLE:
+            return 0
+        floors: List[Optional[int]] = []
+        for di in range(self.n_docs):
+            e = self.stable_epoch(di)
+            # skip docs whose floor hasn't advanced since the last pass
+            floors.append(e if e > self._compacted_at[di] else None)
+        if all(f is None for f in floors):
+            return 0
+        n = self.batch.compact(floors)
+        for di, f in enumerate(floors):
+            if f is not None:
+                self._compacted_at[di] = f
+        return n
+
+    # -- checkpoint/resume --------------------------------------------
+    def checkpoint(self) -> bytes:
+        """Batch state + ack floors as one LTKV store."""
+        from ..codec.binary import Writer
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        meta = Writer()
+        meta.u8(1)  # server-state version
+        meta.str_(self.family)
+        meta.varint(self.n_docs)
+        meta.varint(len(self._compacted_at))
+        for e in self._compacted_at:
+            meta.varint(e)
+        kv.set(b"server", bytes(meta.buf))
+        w = Writer()
+        w.varint(len(self.acks))
+        for a in self.acks:
+            w.varint(len(a))
+            for rep, e in a.items():
+                w.str_(rep)
+                w.varint(e)
+        kv.set(b"acks", bytes(w.buf))
+        kv.set(b"batch", self.batch.export_state())
+        return kv.export_all()
+
+    @classmethod
+    def restore(cls, data: bytes, mesh=None) -> "ResidentServer":
+        from ..codec.binary import Reader
+        from ..errors import DecodeError
+        from ..storage import MemKvStore
+
+        kv = MemKvStore()
+        kv.import_all(data)
+        meta_b, acks_b, batch_b = kv.get(b"server"), kv.get(b"acks"), kv.get(b"batch")
+        if meta_b is None or acks_b is None or batch_b is None:
+            raise DecodeError("ResidentServer state: missing sections")
+        try:
+            r = Reader(meta_b)
+            version = r.u8()
+            if version > 1:
+                raise DecodeError(f"ResidentServer state v{version} too new")
+            family = r.str_()
+            n_docs = r.varint()
+            n_comp = r.varint()
+            compacted_at = [r.varint() for _ in range(n_comp)]
+            if family not in _FAMILIES or n_comp != n_docs:
+                raise DecodeError("ResidentServer state: malformed meta")
+            r = Reader(acks_b)
+            n_acks = r.varint()
+            if n_acks != n_docs:
+                raise DecodeError("ResidentServer state: ack table width")
+            acks: List[Dict[str, int]] = []
+            for _ in range(n_acks):
+                a: Dict[str, int] = {}
+                for _ in range(r.varint()):
+                    rep = r.str_()
+                    a[rep] = r.varint()
+                acks.append(a)
+        except (IndexError, ValueError, UnicodeDecodeError) as e:
+            raise DecodeError(f"ResidentServer state: malformed ({e})") from None
+        srv = cls.__new__(cls)
+        srv.family = family
+        srv.n_docs = n_docs
+        srv.acks = acks
+        srv._compacted_at = compacted_at
+        srv.batch = _FAMILIES[family][0].import_state(batch_b, mesh=mesh)
+        if srv.batch.n_docs < n_docs:
+            raise DecodeError(
+                "ResidentServer state: batch narrower than the ack table"
+            )
+        return srv

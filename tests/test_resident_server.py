@@ -1,0 +1,132 @@
+"""ResidentServer: the packaged ack -> stable-epoch -> compact
+lifecycle over a resident batch, including checkpoint/restore of the
+ack floors."""
+import random
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.doc import strip_envelope
+from loro_tpu.parallel.server import ResidentServer
+
+
+def _mk_pair():
+    a, b = LoroDoc(peer=1), LoroDoc(peer=2)
+    a.get_text("t").insert(0, "server base text")
+    a.commit()
+    b.import_(a.export_snapshot())
+    return a, b
+
+
+class TestResidentServer:
+    def test_round_trip_sync_and_compact(self):
+        a, b = _mk_pair()
+        cid = a.get_text("t").id
+        srv = ResidentServer("text", n_docs=1, capacity=1 << 12)
+        for rep in ("a", "b"):
+            srv.register_replica(0, rep)
+        e0 = srv.ingest([strip_envelope(a.export_updates({}))], cid)
+        # un-acked: nothing stable, nothing compacts
+        assert srv.stable_epoch(0) == 0
+        assert srv.compact() == 0
+        # both replicas ack; edit + delete a round, ack again
+        srv.ack(0, "a", e0)
+        srv.ack(0, "b", e0)
+        t = a.get_text("t")
+        vv = a.oplog_vv()
+        t.delete(0, 7)
+        a.commit()
+        b.import_(a.export_updates(b.oplog_vv()))
+        e1 = srv.ingest([strip_envelope(a.export_updates(vv))], cid)
+        assert srv.compact() == 0  # deletes not acked yet
+        srv.ack(0, "a", e1)
+        assert srv.compact() == 0  # b still behind: floor pinned
+        srv.ack(0, "b", e1)
+        n = srv.compact()
+        assert n > 0
+        assert srv.batch.texts() == [t.to_string()]
+        # floors don't re-compact until they advance
+        assert srv.compact() == 0
+
+    def test_unregistered_doc_never_compacts(self):
+        a, b = _mk_pair()
+        cid = a.get_text("t").id
+        srv = ResidentServer("text", n_docs=1, capacity=1 << 12)
+        e = srv.ingest([strip_envelope(a.export_updates({}))], cid)
+        vv = a.oplog_vv()
+        a.get_text("t").delete(0, 5)
+        a.commit()
+        srv.ingest([strip_envelope(a.export_updates(vv))], cid)
+        assert srv.compact() == 0  # no replica set registered
+
+    def test_stale_ack_ignored_and_drop_replica(self):
+        srv = ResidentServer("text", n_docs=1)
+        srv.register_replica(0, "x")
+        srv.register_replica(0, "y")
+        srv.ack(0, "x", 5)
+        srv.ack(0, "x", 3)  # stale: ignored
+        assert srv.acks[0]["x"] == 5
+        assert srv.stable_epoch(0) == 0  # y never acked
+        srv.drop_replica(0, "y")
+        assert srv.stable_epoch(0) == 5
+
+    def test_checkpoint_restore_keeps_floors(self):
+        a, b = _mk_pair()
+        cid = a.get_text("t").id
+        srv = ResidentServer("text", n_docs=1, capacity=1 << 12)
+        srv.register_replica(0, "a")
+        srv.register_replica(0, "b")
+        e = srv.ingest([strip_envelope(a.export_updates({}))], cid)
+        srv.ack(0, "a", e)
+        blob = srv.checkpoint()
+        back = ResidentServer.restore(blob)
+        assert back.family == "text"
+        assert back.acks == srv.acks
+        assert back.batch.texts() == srv.batch.texts()
+        # the restored server continues the lifecycle: ack + delete + compact
+        vv = a.oplog_vv()
+        a.get_text("t").delete(0, 7)
+        a.commit()
+        e2 = back.ingest([strip_envelope(a.export_updates(vv))], cid)
+        back.ack(0, "a", e2)
+        back.ack(0, "b", e2)
+        assert back.compact() > 0
+        assert back.batch.texts() == [a.get_text("t").to_string()]
+
+    def test_corrupt_state_raises(self):
+        from loro_tpu.errors import DecodeError
+
+        srv = ResidentServer("counter", n_docs=1)
+        blob = bytearray(srv.checkpoint())
+        blob[20] ^= 0xFF
+        with pytest.raises(DecodeError):
+            ResidentServer.restore(bytes(blob))
+
+    @pytest.mark.parametrize("family", ["map", "counter"])
+    def test_fold_families_compact_noop(self, family):
+        srv = ResidentServer(family, n_docs=1)
+        srv.register_replica(0, "r")
+        srv.ack(0, "r", 99)
+        assert srv.compact() == 0
+
+    def test_movable_family_end_to_end(self):
+        doc = LoroDoc(peer=3)
+        ml = doc.get_movable_list("m")
+        ml.push(*[f"i{k}" for k in range(5)])
+        doc.commit()
+        srv = ResidentServer("movable", n_docs=1, capacity=1 << 10,
+                             elem_capacity=256)
+        srv.register_replica(0, "solo")
+        cid = ml.id
+        e = srv.ingest([doc.oplog.changes_in_causal_order()], cid)
+        srv.ack(0, "solo", e)
+        vv = doc.oplog_vv()
+        for i in range(6):
+            ml.move(i % len(ml.get_value()), (i * 2) % len(ml.get_value()))
+        ml.delete(0, 1)
+        doc.commit()
+        e2 = srv.ingest([doc.oplog.changes_between(vv, doc.oplog_vv())], cid)
+        srv.ack(0, "solo", e2)
+        assert srv.batch.value_lists() == [ml.get_value()]
+        srv.compact()
+        assert srv.batch.value_lists() == [ml.get_value()]

@@ -173,6 +173,46 @@ def test_fleet_cross_engine_differential(monkeypatch):
     assert native[2] == pure[2]  # standing keys bit-identical
 
 
+def test_threaded_ingest_matches_single_thread(monkeypatch):
+    """The per-doc ingest fan-out (LORO_ORDER_THREADS) with native id
+    maps + order engines must be bit-identical to single-threaded
+    ingest (doc-disjoint writes; ctypes calls release the GIL)."""
+    import random
+
+    from loro_tpu import LoroDoc
+    from loro_tpu.doc import strip_envelope
+    from loro_tpu.parallel.fleet import DeviceDocBatch
+
+    rng = random.Random(0x7437)
+    docs = []
+    for i in range(6):
+        x = LoroDoc(peer=i + 1)
+        t = x.get_text("t")
+        t.insert(0, f"threaded doc {i} ")
+        for _ in range(30):
+            L = len(t)
+            if L > 5 and rng.random() < 0.3:
+                p = rng.randrange(L - 1)
+                t.delete(p, min(2, L - p))
+            else:
+                t.insert(rng.randint(0, L), rng.choice(["ab", "c"]))
+        x.commit()
+        docs.append(x)
+    cid = docs[0].get_text("t").id
+    payloads = [strip_envelope(x.export_updates({})) for x in docs]
+
+    def run(threads):
+        monkeypatch.setenv("LORO_ORDER_THREADS", str(threads))
+        b = DeviceDocBatch(n_docs=6, capacity=512)
+        b.append_payloads(payloads, cid)
+        return b.texts(), np.asarray(b.key_hi).tolist()
+
+    t1, k1 = run(1)
+    t4, k4 = run(4)
+    assert t1 == t4 == [x.get_text("t").to_string() for x in docs]
+    assert k1 == k4  # standing keys bit-identical across fan-outs
+
+
 def test_capacity_error_leaves_idmap_unstaged():
     """A capacity overflow during append must abort staged ids: the next
     (smaller) append still resolves parents against the committed view

@@ -177,7 +177,7 @@ class TestCompact:
         batch.compact([batch.epoch])
         assert batch.richtexts()[0] == before_rt
 
-    @pytest.mark.parametrize("seed", range(6))
+    @pytest.mark.parametrize("seed", range(8))
     def test_compact_fuzz_concurrent(self, seed):
         """Randomized soak: concurrent edits from two peers, full syncs
         (every ingested epoch becomes stable), compaction every other
@@ -387,7 +387,7 @@ class TestMovableCompact:
         with pytest.raises(DecodeError, match="winner row"):
             DeviceMovableBatch.import_state(batch.export_state())
 
-    @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.parametrize("seed", range(6))
     def test_fuzz_concurrent(self, seed):
         from loro_tpu.parallel.fleet import DeviceMovableBatch
 

@@ -72,10 +72,30 @@ def _ckpt_path() -> str | None:
 _CKPT: dict = {}
 
 
+def _metrics_sidecar() -> dict | None:
+    """The obs registry as a compact dict (docs/OBSERVABILITY.md
+    "bench sidecar"): pad-waste / jit-shape / launch / epoch counters
+    ride every BENCH_r*.json record from now on.  None when the obs
+    package is unavailable or empty (the parent process never merges
+    fleet work, so its sidecar would be noise)."""
+    try:
+        from loro_tpu.obs import sidecar
+
+        side = sidecar()
+        return side or None
+    except Exception:
+        return None
+
+
 def bank(phase: str, **fields) -> None:
     """Merge fields into the checkpoint and atomically persist it.  The
-    parent emits the newest checkpoint if this child never finishes."""
+    parent emits the newest checkpoint if this child never finishes.
+    Every bank refreshes the metrics sidecar so a timeout-abandoned
+    child still leaves its newest counters behind."""
     _CKPT.update(fields)
+    side = _metrics_sidecar()
+    if side:
+        _CKPT["metrics"] = side
     _CKPT["last_phase"] = phase
     _CKPT["elapsed_s"] = round(time.time() - T0, 1)
     p = _ckpt_path()
@@ -161,6 +181,8 @@ def assemble_record(ck: dict) -> dict:
         "richtext_value",
         "richtext_unit",
         "richtext_vs_baseline",
+        "trace",
+        "metrics",
         "elapsed_s",
     ):
         if k in ck and ck[k] is not None:
@@ -180,6 +202,9 @@ def _emit_simple(metric: str, ops_per_sec: float, extras: dict | None = None) ->
     }
     if extras:
         rec.update(extras)
+    side = _metrics_sidecar()
+    if side:
+        rec["metrics"] = side
     print(json.dumps(_ambient_fields(rec)), flush=True)
 
 
@@ -469,14 +494,11 @@ def main() -> None:
     bank("device_contact", device=f"{platform}:{device_kind}")
     import jax.numpy as jnp
 
-    tiny = jax.jit(lambda v: v + 1)
-    np.asarray(tiny(jnp.zeros(8, jnp.int32)))
-    rtts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(tiny(jnp.zeros(8, jnp.int32)))
-        rtts.append(time.perf_counter() - t0)
-    rtt = sorted(rtts)[1]
+    # the x+1-fetch probe now lives in obs (feeds the tunnel.rtt_ms
+    # gauge for the sidecar AND returns the median RTT for banking)
+    from loro_tpu.obs import measure_tunnel_rtt
+
+    rtt = measure_tunnel_rtt(reps=3)
     note(f"device: platform={platform} kind={device_kind}, tunnel RTT ~{rtt * 1e3:.0f}ms")
     bank("device_fetch", tunnel_rtt_ms=round(rtt * 1e3, 1))
 
@@ -488,7 +510,15 @@ def main() -> None:
     per_doc_ops = [n_ops] + [v["n_ops"] for v in variants]
     want0 = automerge_final_text(limit=limit)
     note(f"extraction done ({len(extracts)} distinct traces)")
-    bank("extraction")
+    import loro_tpu.bench_utils as _bu
+
+    if _bu.SYNTHETIC_FALLBACK:
+        # no automerge-perf file in this image: numbers are NOT
+        # comparable to real-trace rounds — tag the record
+        note("automerge trace file absent: SYNTHETIC fallback trace in use")
+        bank("extraction", trace="synthetic_fallback")
+    else:
+        bank("extraction")
 
     # the trace set is fixed for the whole run, so pad to the batch max
     # on a fine quantum instead of power-of-two buckets: ranking cost is
@@ -499,17 +529,25 @@ def main() -> None:
     pad_n = pad_to(max(e.n for e in extracts), 8192)
     pad_c = pad_to(max(contract_chains(e).n_chains for e in extracts), 1024)
     per_doc_cols = [chain_columns(e, pad_n=pad_n, pad_c=pad_c) for e in extracts]
+    per_doc_rows = [e.n for e in extracts]
     n_distinct = len(per_doc_cols)
     n_batches = max(1, -(-n_distinct // chunk))
     host_batches = []
     batch_ops = []
+    batch_rows = []
     for b in range(n_batches):
         idxs = [(b * chunk + j) % n_distinct for j in range(chunk)]
         docs = [per_doc_cols[i] for i in idxs]
         batch_ops.append(sum(per_doc_ops[i] for i in idxs))
+        batch_rows.append(sum(per_doc_rows[i] for i in idxs))
         host_batches.append(
             ChainColumns(*[np.stack([getattr(c, f) for c in docs]) for f in ChainColumns._fields])
         )
+    from loro_tpu.obs import metrics as obs_m
+
+    obs_m.unique("fleet.padded_shapes_distinct").add(
+        ("chain_text", pad_n, pad_c, chunk)
+    )
 
     def sync(o) -> None:
         # jax.block_until_ready does NOT synchronize under the axon
@@ -582,6 +620,18 @@ def main() -> None:
                     break
         sync(out)
         dt = time.perf_counter() - t0
+        # fleet accounting for the sidecar: the budget loop is the
+        # bench's merge front-end, so it ticks the same counters the
+        # Fleet API does (family chain_text = direct chain kernel)
+        rows_done = sum(batch_rows[j % n_batches] for j in range(i))
+        obs_m.counter("fleet.merge_calls_total").inc(i, family="chain_text")
+        obs_m.counter("fleet.device_launches_total").inc(i, family="chain_text")
+        obs_m.counter("fleet.docs_merged_total").inc(i * chunk, family="chain_text")
+        obs_m.counter("fleet.ops_merged_total").inc(rows_done, family="chain_text")
+        obs_m.counter("fleet.pad_waste_rows_total").inc(
+            i * chunk * pad_n - rows_done, family="chain_text"
+        )
+        obs_m.gauge("tunnel.drain_depth").set(drain)
         return ops_done / dt, i * chunk, flights
 
     def flight_median_rate(ops_s: float, flights) -> float | None:
@@ -938,7 +988,7 @@ def main() -> None:
 
             from loro_tpu import LoroDoc
             from loro_tpu.doc import strip_envelope
-            from loro_tpu.parallel.fleet import DeviceDocBatch
+            from loro_tpu.parallel.server import ResidentServer
 
             note("resident-fleet phase: 32 docs x 6 epochs x ~768 rows...")
             _rng = _random.Random(0x5E51DE17)
@@ -963,21 +1013,23 @@ def main() -> None:
                 _eps.append(strip_envelope(_doc.export_updates(_vv)))
             import jax.numpy as _jnp
 
-            _rb = DeviceDocBatch(32, capacity=1 << 14)
+            # ResidentServer (not the bare batch): the ingest rounds
+            # feed the server.epoch_seconds histogram the sidecar ships
+            _srv = ResidentServer("text", 32, capacity=1 << 14)
             _cid = _doc.get_text("t").id
             _rates = []
             _rows_ep = 32 * 768
             for _e, _pl in enumerate(_eps):
                 _t0 = time.perf_counter()
-                _rb.append_payloads([_pl] * 32, _cid)
+                _srv.ingest([_pl] * 32, _cid)
                 # scalar drain fetch: block_until_ready does NOT
                 # synchronize under the axon tunnel (CLAUDE.md) — the
                 # async scatter must drain through a fetch or the timed
                 # window excludes the device work
-                np.asarray(_jnp.count_nonzero(_rb.cols.valid))
+                np.asarray(_jnp.count_nonzero(_srv.batch.cols.valid))
                 _rates.append(_rows_ep / (time.perf_counter() - _t0))
             _rates.sort()
-            assert _rb.texts()[0] == _t.to_string()  # correctness gate
+            assert _srv.batch.texts()[0] == _t.to_string()  # correctness gate
             bank(
                 "resident",
                 resident_rows_per_sec=round(_rates[len(_rates) // 2]),

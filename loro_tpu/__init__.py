@@ -42,6 +42,7 @@ from .models.handlers import (
     TextHandler,
     TreeHandler,
 )
+from . import obs
 from .awareness import Awareness, EphemeralStore
 from .codec.json_schema import RedactError, redact_json_updates
 from .cursor import AbsolutePosition, Cursor, CursorSide, get_cursor, get_cursor_pos
@@ -96,4 +97,5 @@ __all__ = [
     "get_cursor_pos",
     "Awareness",
     "EphemeralStore",
+    "obs",
 ]

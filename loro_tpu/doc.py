@@ -35,6 +35,7 @@ from .models.handlers import (
     TreeHandler,
     make_handler,
 )
+from .obs import metrics as obs
 from .oplog.oplog import OpLog
 from .state import DocState, compose_many
 from .txn import Transaction
@@ -339,7 +340,17 @@ class LoroDoc:
     # ------------------------------------------------------------------
     def export(self, mode=None) -> bytes:
         """Export per ExportMode (reference: loro.rs:2096 dispatch)."""
-        tracing.instant("doc.export", mode=type(mode).__name__ if mode is not None else "Snapshot")
+        mode_name = (
+            getattr(mode, "__name__", None)
+            or (type(mode).__name__ if mode is not None else "Snapshot")
+        )
+        tracing.instant("doc.export", mode=mode_name)
+        data = self._export_dispatch(mode)
+        obs.counter("doc.export_calls_total").inc(mode=mode_name)
+        obs.counter("doc.export_bytes_total").inc(len(data), mode=mode_name)
+        return data
+
+    def _export_dispatch(self, mode) -> bytes:
         self._barrier()
         if mode is None or isinstance(mode, ExportMode.Snapshot) or mode is ExportMode.Snapshot:
             return self._export_fast_snapshot()
@@ -484,6 +495,8 @@ class LoroDoc:
     def import_(self, data: bytes, origin: str = "import") -> ImportStatus:
         """reference: loro.rs:568 LoroDoc::import (header parse + mode
         dispatch, loro.rs:584-649)."""
+        obs.counter("doc.import_calls_total").inc()
+        obs.counter("doc.import_bytes_total").inc(len(data))
         with tracing.span("doc.import", bytes=len(data)):
             self._barrier()
             mode, payload = self._parse_envelope(data)
@@ -867,6 +880,16 @@ class LoroDoc:
                 self.oplog.commit_backfill(backfill)
                 self._shallow_base = None
             applied, pending = self.oplog.commit_import(plan)
+        obs.counter("oplog.changes_applied_total").inc(len(applied))
+        obs.counter("oplog.ops_applied_total").inc(
+            sum(len(ch.ops) for ch in applied)
+        )
+        # gauge, not counter: the parked backlog is cumulative state
+        # carried across imports — a counter would re-add the whole
+        # backlog every round and grow without any new parks
+        obs.gauge("oplog.changes_pending").set(
+            sum(len(v) for v in self.oplog.pending.by_missing.values())
+        )
         success = VersionRange()
         for ch in applied:
             success.extend_to_include(ch.id_span())

@@ -16,6 +16,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import metrics as _obs
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "codec.cpp")
 _SO = os.path.join(_DIR, "codec.so")
@@ -27,6 +29,7 @@ _build_failed = False
 
 def _build() -> bool:
     tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process: concurrent builds don't race
+    _obs.counter("codec.native_build_total").inc()
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
@@ -37,11 +40,19 @@ def _build() -> bool:
         os.replace(tmp, _SO)
         return True
     except (subprocess.SubprocessError, OSError):
+        _obs.counter("codec.native_build_failed_total").inc()
         try:
             os.unlink(tmp)
         except OSError:
             pass
         return False
+
+
+def _obs_decode(fn: str, payload: bytes) -> None:
+    """Per-call decode accounting (docs/OBSERVABILITY.md): which native
+    explode entry ran and how many wire bytes it chewed."""
+    _obs.counter("codec.native_decode_calls_total").inc(fn=fn)
+    _obs.counter("codec.native_decode_bytes_total").inc(len(payload), fn=fn)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -205,6 +216,7 @@ def explode_seq_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
+    _obs_decode("seq", payload)
     n = lib.loro_count_seq_elements(payload, len(payload), target_cid_index)
     if n < 0:
         raise ValueError("native decode failed (malformed payload?)")
@@ -239,6 +251,7 @@ def explode_seq_delta_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
+    _obs_decode("seq_delta", payload)
     n = lib.loro_count_seq_delta_rows(payload, len(payload), target_cid_index)
     nd = lib.loro_count_seq_deletes(payload, len(payload), target_cid_index)
     if n < 0 or nd < 0:
@@ -298,6 +311,7 @@ def explode_seq_anchor_meta(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
+    _obs_decode("seq_anchor", payload)
     n = lib.loro_explode_seq_anchor_meta(
         payload, len(payload), target_cid_index, None, None, None, None, None, 0
     )
@@ -334,6 +348,7 @@ def explode_map_payload(payload: bytes):
     lib = _load()
     if lib is None:
         return None
+    _obs_decode("map", payload)
     n = lib.loro_count_map_ops(payload, len(payload))
     if n < 0:
         raise ValueError("native decode failed (malformed payload?)")
@@ -397,6 +412,7 @@ def explode_tree_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
+    _obs_decode("tree", payload)
     n = lib.loro_count_tree_ops(payload, len(payload), target_cid_index)
     if n < 0:
         raise ValueError("native decode failed (malformed payload?)")
@@ -432,6 +448,7 @@ def explode_movable_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
+    _obs_decode("movable", payload)
     n_slots = ctypes.c_longlong()
     n_sets = ctypes.c_longlong()
     n_dels = ctypes.c_longlong()
@@ -491,6 +508,7 @@ def explode_movable_delta_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
+    _obs_decode("movable_delta", payload)
     n_slots = ctypes.c_longlong()
     n_sets = ctypes.c_longlong()
     n_dels = ctypes.c_longlong()

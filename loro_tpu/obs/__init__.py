@@ -1,0 +1,105 @@
+"""loro_tpu.obs: metrics + profiling for the fleet merge path.
+
+Always-on process-wide registry (metrics.py), Prometheus/JSON/sidecar
+exposition (exposition.py), and a one-screen report (report.py; also
+``python -m loro_tpu.obs.report``).  See docs/OBSERVABILITY.md for the
+metric catalogue and how the pieces fit the tracing subsystem.
+
+Quick use::
+
+    from loro_tpu import obs
+    obs.counter("fleet.ops_merged_total").inc(1024, family="text")
+    print(obs.prometheus_text())       # /metrics text
+    print(obs.sidecar())               # compact dict for JSON records
+    obs.enable_span_metrics()          # tracing.span -> histograms
+"""
+from __future__ import annotations
+
+from .exposition import prometheus_text, serve, sidecar, snapshot_json
+from .metrics import (
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    registry,
+    reset,
+    snapshot,
+    unique,
+)
+
+__all__ = [
+    "Registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "registry",
+    "reset",
+    "snapshot",
+    "unique",
+    "prometheus_text",
+    "snapshot_json",
+    "sidecar",
+    "serve",
+    "enable_span_metrics",
+    "disable_span_metrics",
+    "measure_tunnel_rtt",
+]
+
+# -- tracing bridge ----------------------------------------------------
+# One instrumentation point, two sinks: a tracing.span() on a hot path
+# feeds the chrome-trace event list when tracing is enabled AND (when
+# this bridge is on) a duration histogram per span name.  The bridge is
+# opt-in so tracing.span keeps its zero-cost-when-off contract.
+_span_observer = None
+
+
+def _observe_span(name: str, dur_s: float) -> None:
+    histogram("trace.span_seconds").observe(dur_s, span=name)
+
+
+def enable_span_metrics() -> None:
+    """Feed every tracing.span duration into the
+    ``trace.span_seconds{span=...}`` histogram (works with chrome-trace
+    collection on or off)."""
+    global _span_observer
+    from ..utils import tracing
+
+    if _span_observer is None:
+        _span_observer = _observe_span
+        tracing.add_span_observer(_span_observer)
+
+
+def disable_span_metrics() -> None:
+    global _span_observer
+    from ..utils import tracing
+
+    if _span_observer is not None:
+        tracing.remove_span_observer(_span_observer)
+        _span_observer = None
+
+
+# -- tunnel health -----------------------------------------------------
+def measure_tunnel_rtt(reps: int = 3):
+    """The CLAUDE.md ``x+1``-fetch probe as a metric feeder: median of
+    ``reps`` scalar round trips through the device queue (the honest
+    sync primitive under the axon tunnel — block_until_ready lies).
+    Sets the ``tunnel.rtt_ms`` gauge, ticks ``tunnel.probes_total`` and
+    returns the RTT in seconds.  Uses whatever backend jax resolves, so
+    on the CPU mesh it measures dispatch overhead (~ms)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    tiny = jax.jit(lambda v: v + 1)
+    np.asarray(tiny(jnp.zeros(8, jnp.int32)))  # compile + warm
+    rtts = []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        np.asarray(tiny(jnp.zeros(8, jnp.int32)))
+        rtts.append(time.perf_counter() - t0)
+    rtt = sorted(rtts)[len(rtts) // 2]
+    gauge("tunnel.rtt_ms", "median scalar-fetch round trip").set(rtt * 1e3)
+    counter("tunnel.probes_total").inc()
+    return rtt

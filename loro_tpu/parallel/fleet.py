@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.change import Change
 from ..core.ids import ContainerID
+from ..obs import metrics as obs
 from ..utils import tracing
 from ..ops.columnar import MapExtract, SeqExtract, extract_seq_container
 from ..ops.fugue_batch import SeqColumns, materialize_content_batch, pad_bucket
@@ -42,6 +43,28 @@ def _mesh_pad(mesh, d: int) -> int:
     """Doc count padded up to a multiple of the mesh's doc dimension."""
     dm = mesh.shape[DOC_AXIS]
     return ((d + dm - 1) // dm) * dm
+
+
+def _obs_merge(family: str, docs: int, real_rows: int, padded_rows: int,
+               shape: Tuple[int, ...]) -> None:
+    """One accounting point per device merge launch (docs/OBSERVABILITY
+    .md): real vs padded rows quantify pad_bucket waste, the shape set
+    cardinality proxies the jit cache size."""
+    obs.counter("fleet.merge_calls_total").inc(family=family)
+    obs.counter("fleet.docs_merged_total").inc(docs, family=family)
+    obs.counter("fleet.ops_merged_total").inc(real_rows, family=family)
+    obs.counter("fleet.pad_waste_rows_total").inc(
+        max(0, padded_rows - real_rows), family=family
+    )
+    obs.counter("fleet.device_launches_total").inc(family=family)
+    obs.unique("fleet.padded_shapes_distinct").add((family,) + tuple(shape))
+
+
+def _obs_fallback(kind: str) -> None:
+    """Host-fallback hits: forced Python engines (LORO_PY_ORDER /
+    LORO_PY_IDMAP or missing native lib) and per-payload decode
+    fallbacks."""
+    obs.counter("fleet.host_fallback_total").inc(kind=kind)
 
 
 def _empty_seq_np(n: int):
@@ -101,6 +124,7 @@ class Fleet:
         n = pad_bucket(max(e.n for e in extracts))
         d = len(extracts)
         d_pad = pad_docs or _mesh_pad(self.mesh, d)
+        _obs_merge("text", d, sum(e.n for e in extracts), n * d_pad, (n, d_pad))
         cols_np = [e.to_seq_columns(pad_to=n) for e in extracts]
         empty = SeqColumns(
             parent=np.full(n, -1, np.int32),
@@ -156,6 +180,7 @@ class Fleet:
                 # referencing elements outside it): python fallback
                 ex = None
             if ex is None:
+                _obs_fallback("payload_extract")
                 try:
                     ex = extract_seq_container(decode_changes(p), cid)
                 except KeyError as e:
@@ -188,6 +213,13 @@ class Fleet:
         n_keys = pad_bucket(max(1, max(len(k) for _, k, _ in extracts)), floor=4)
         d = len(extracts)
         d_pad = _mesh_pad(self.mesh, d)
+        _obs_merge(
+            "richtext",
+            d,
+            sum(c.chain.chain_id.shape[0] for c, _, _ in extracts),
+            n * d_pad,
+            (n, cpad, p, n_keys, d_pad),
+        )
 
         padded = [
             pad_richtext_chain_cols(c, pad_n=n, pad_c=cpad, pad_p=p)
@@ -287,6 +319,7 @@ class Fleet:
             except ValueError:
                 ex = None
             if ex is None:
+                _obs_fallback("payload_extract")
                 try:
                     ex = extract_movable(decode_changes(p), cid)
                 except KeyError as e:
@@ -312,6 +345,13 @@ class Fleet:
         n_elems = pad_bucket(max(1, max(len(e) for _, e, _ in extracts)), floor=16)
         d = len(extracts)
         d_pad = _mesh_pad(self.mesh, d)
+        _obs_merge(
+            "movable",
+            d,
+            sum(c.seq.parent.shape[0] + c.set_elem.shape[0] for c, _, _ in extracts),
+            (s + k) * d_pad,
+            (s, k, n_elems, d_pad),
+        )
 
         def padk(a, fill, dtype):
             out = np.full(k, fill, dtype)
@@ -400,6 +440,7 @@ class Fleet:
             if ex is None:
                 # tree ops carry no intra-payload row references, so the
                 # Python fallback is total
+                _obs_fallback("payload_extract")
                 ex = extract_tree_ops(decode_changes(p), cid)
             extracted.append(ex)
         return self._merge_tree_extracted(extracted)
@@ -422,6 +463,10 @@ class Fleet:
         n = max(1, max(len(nodes) for _, nodes, _ in extracted))
         d = len(extracted)
         d_pad = _mesh_pad(self.mesh, d)
+        _obs_merge(
+            "tree", d, sum(c.target.shape[0] for c, _, _ in extracted),
+            m * d_pad, (m, n, d_pad),
+        )
         padded = [pad_tree_cols(c, m) for c, _, _ in extracted]
         empty = TreeOpCols(
             target=np.zeros(m, np.int32), parent=np.full(m, ROOT, np.int32), valid=np.zeros(m, bool)
@@ -467,6 +512,13 @@ class Fleet:
         n = max(1, max(len(nodes) for _, nodes, _ in extracted))
         d = len(extracted)
         d_pad = _mesh_pad(self.mesh, d)
+        # distinct family: the children materialization runs extra
+        # kernels, so its shapes must not alias _merge_tree_extracted's
+        # in the jit-cache proxy
+        _obs_merge(
+            "tree_children", d, sum(c.target.shape[0] for c, _, _ in extracted),
+            m * d_pad, (m, n, d_pad),
+        )
         padded = [pad_tree_cols(c, m) for c, _, _ in extracted]
         empty = TreeOpCols(
             target=np.zeros(m, np.int32), parent=np.full(m, ROOT, np.int32), valid=np.zeros(m, bool)
@@ -535,6 +587,10 @@ class Fleet:
         s = max(1, max(len(c) for c in cids_per_doc))
         d = len(docs_changes)
         d_pad = _mesh_pad(self.mesh, d)
+        _obs_merge(
+            "counter", d, sum(len(r) for r in rows_per_doc),
+            m * d_pad, (m, s, d_pad),
+        )
         slot = np.zeros((d_pad, m), np.int32)
         delta = np.zeros((d_pad, m), np.float32)
         valid = np.zeros((d_pad, m), bool)
@@ -580,6 +636,11 @@ class Fleet:
         {key: value} for root map containers."""
         m = pad_bucket(max(1, max(len(e.slot) for e in extracts)))
         s = max(1, max(len(e.slots) for e in extracts))
+        d_pad = _mesh_pad(self.mesh, len(extracts))
+        _obs_merge(
+            "map", len(extracts), sum(len(e.slot) for e in extracts),
+            m * d_pad, (m, s, d_pad),
+        )
         batched = self._batch_map_cols(extracts, m)
         sh = doc_sharding(self.mesh)
         batched = MapOpCols(*[jax.device_put(np.asarray(a), sh) for a in batched])
@@ -610,6 +671,11 @@ class Fleet:
         m = pad_bucket(max(1, max(len(e.slot) for e in extracts)))
         m = ((m + op_dim - 1) // op_dim) * op_dim  # divisible by the op axis
         s = max(1, max(len(e.slots) for e in extracts))
+        d_pad = _mesh_pad(self.mesh, len(extracts))
+        _obs_merge(
+            "map_sharded", len(extracts), sum(len(e.slot) for e in extracts),
+            m * d_pad, (m, s, d_pad, op_dim),
+        )
         batched = self._batch_map_cols(extracts, m)
         sh = NamedSharding(self.mesh, P(DOC_AXIS, OP_AXIS))
         batched = MapOpCols(*[jax.device_put(np.asarray(a), sh) for a in batched])
@@ -1042,6 +1108,7 @@ class DeviceDocBatch:
                 return nat
         from .order_maintenance import ShadowOrder
 
+        _obs_fallback("order")
         return ShadowOrder()
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
@@ -1187,6 +1254,16 @@ class DeviceDocBatch:
         if max_new:
             from .order_maintenance import split_keys
 
+            obs.counter("fleet.resident_rows_total").inc(
+                sum(n_new), family="text" if self.as_text else "list"
+            )
+            obs.counter("fleet.pad_waste_rows_total").inc(
+                self.d * max_new - sum(n_new), family="resident_seq"
+            )
+            obs.counter("fleet.device_launches_total").inc(family="resident_seq")
+            obs.unique("fleet.padded_shapes_distinct").add(
+                ("resident_seq", self.d, max_new, self.cap)
+            )
             blk_shape = (self.d, max_new)
             blk = {
                 "parent": np.full(blk_shape, -1, np.int32),
@@ -1329,6 +1406,8 @@ class DeviceDocBatch:
         if not available() or not self.as_text:
             # no native lib, or a value batch (the native explode only
             # understands text payloads): python decode per payload
+            if not available():
+                _obs_fallback("payload_decode")
             self.append_changes(
                 [decode_changes(p) if p else None for p in per_doc_payloads], cid
             )
@@ -1444,6 +1523,7 @@ class DeviceDocBatch:
             except (KeyError, ValueError):
                 # unresolvable refs or malformed input for the native
                 # path: python fallback for this payload only
+                _obs_fallback("payload_decode")
                 self.id2row[di].abort()
                 rows.clear()
                 rows_per_doc[di] = rows
@@ -1525,6 +1605,7 @@ class DeviceDocBatch:
         instead (bulk path; also the differential check in tests)."""
         from ..ops.fugue_batch import chain_merge_docs_u, materialize_by_key
 
+        obs.counter("fleet.device_launches_total").inc(family="resident_materialize")
         if not use_solver:
             codes, counts = materialize_by_key(self.cols, self.key_hi, self.key_lo)
             return np.asarray(codes), np.asarray(counts)

@@ -87,4 +87,7 @@ def make_idmap():
         m = native_idmap()
         if m is not None:
             return m
+    from ..obs import metrics as obs
+
+    obs.counter("fleet.host_fallback_total").inc(kind="idmap")
     return PyIdMap()

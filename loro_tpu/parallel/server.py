@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+from ..obs import metrics as obs
 from .fleet import (
     DeviceCounterBatch,
     DeviceDocBatch,
@@ -87,32 +88,66 @@ class ResidentServer:
         """Feed one sync round (per-doc update payloads via the native
         path when bytes, else change lists; None = no update) and
         return the epoch clients must ack once they integrate the
-        round's fan-out."""
+        round's fan-out.
+
+        Entries are normalized PER DOC (ADVICE r5 finding 1): a round
+        mixing bytes payloads and Change lists decodes the bytes
+        entries host-side instead of mis-routing the change lists
+        through the payload path (where a TypeError escaped the
+        per-doc fallback)."""
         batch = self.batch
-        use_payloads = any(isinstance(u, (bytes, bytearray))
-                           for u in per_doc_updates if u is not None)
-        if use_payloads and not hasattr(batch, "append_payloads"):
-            # families without a native payload path (counter) decode
-            # host-side instead of mis-feeding raw bytes downstream
+        per_doc_updates = list(per_doc_updates)
+        n_updated = sum(1 for u in per_doc_updates if u is not None)
+        obs.gauge("server.queue_depth").set(n_updated, family=self.family)
+        has_bytes = any(isinstance(u, (bytes, bytearray))
+                        for u in per_doc_updates if u is not None)
+        has_changes = any(u is not None and not isinstance(u, (bytes, bytearray))
+                          for u in per_doc_updates)
+        if has_bytes and (has_changes or not hasattr(batch, "append_payloads")):
+            # mixed round, or a family without a native payload path
+            # (counter): decode bytes entries host-side per doc
             from ..codec.binary import decode_changes
 
+            reason = "mixed_round" if has_changes else "no_payload_path"
+            n_decoded = sum(
+                1 for u in per_doc_updates if isinstance(u, (bytes, bytearray))
+            )
+            obs.counter("server.ingest_fallback_total").inc(
+                n_decoded, family=self.family, reason=reason
+            )
             per_doc_updates = [
                 decode_changes(u) if isinstance(u, (bytes, bytearray)) else u
                 for u in per_doc_updates
             ]
             use_payloads = False
-        if self.family in ("map", "counter"):
-            if use_payloads:
-                batch.append_payloads(per_doc_updates)
-            else:
-                batch.append_changes(per_doc_updates)
         else:
-            if cid is None:
-                raise ValueError(f"{self.family} ingest needs the container id")
-            if use_payloads:
-                batch.append_payloads(per_doc_updates, cid)
-            else:
-                batch.append_changes(per_doc_updates, cid)
+            use_payloads = has_bytes
+        route = "payloads" if use_payloads else "changes"
+        obs.counter("server.ingest_rounds_total").inc(
+            family=self.family, route=route
+        )
+        obs.counter("server.ingest_docs_total").inc(n_updated, family=self.family)
+        try:
+            with obs.histogram(
+                "server.epoch_seconds", "ingest wall time per sync round"
+            ).time(family=self.family):
+                if self.family in ("map", "counter"):
+                    if use_payloads:
+                        batch.append_payloads(per_doc_updates)
+                    else:
+                        batch.append_changes(per_doc_updates)
+                else:
+                    if cid is None:
+                        raise ValueError(
+                            f"{self.family} ingest needs the container id"
+                        )
+                    if use_payloads:
+                        batch.append_payloads(per_doc_updates, cid)
+                    else:
+                        batch.append_changes(per_doc_updates, cid)
+        except Exception:
+            obs.counter("server.errors_total").inc(family=self.family)
+            raise
         return self.epoch
 
     @property
@@ -167,7 +202,11 @@ class ResidentServer:
             floors.append(e if e > self._compacted_at[di] else None)
         if all(f is None for f in floors):
             return 0
-        n = self.batch.compact(floors)
+        with obs.histogram("server.compact_seconds").time(family=self.family):
+            n = self.batch.compact(floors)
+        obs.counter("server.compact_rows_reclaimed_total").inc(
+            n, family=self.family
+        )
         for di, f in enumerate(floors):
             if f is not None:
                 self._compacted_at[di] = f

@@ -5,6 +5,12 @@ reference: the `tracing` spans on loro's hot paths + dev-utils
 chrome://tracing when DEBUG is set).  Same contract here: zero overhead
 unless enabled (env LORO_TPU_TRACE=1 or enable()); `span(name)` context
 managers on import/merge/export paths; dump() writes the trace file.
+
+Span observers (obs bridge): loro_tpu.obs.enable_span_metrics()
+registers a callback that receives every span's (name, duration_s) so
+ONE instrumentation point feeds both the chrome trace and the metrics
+histograms.  With no observers and tracing disabled, span() keeps its
+zero-overhead contract.
 """
 from __future__ import annotations
 
@@ -13,12 +19,13 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _enabled = os.environ.get("LORO_TPU_TRACE", "") not in ("", "0")
 _events: List[Dict[str, Any]] = []
 _lock = threading.Lock()
 _t0 = time.perf_counter()
+_span_observers: List[Callable[[str, float], None]] = []
 
 
 def enable() -> None:
@@ -35,10 +42,25 @@ def is_enabled() -> bool:
     return _enabled
 
 
+def add_span_observer(fn: Callable[[str, float], None]) -> None:
+    """Register a (name, duration_seconds) callback fired at every span
+    exit, independent of chrome-trace collection (the obs bridge)."""
+    if fn not in _span_observers:
+        _span_observers.append(fn)
+
+
+def remove_span_observer(fn: Callable[[str, float], None]) -> None:
+    try:
+        _span_observers.remove(fn)
+    except ValueError:
+        pass
+
+
 @contextmanager
 def span(name: str, **args):
-    """Trace span; ~zero cost when tracing is off."""
-    if not _enabled:
+    """Trace span; ~zero cost when tracing is off and no observer is
+    registered."""
+    if not _enabled and not _span_observers:
         yield
         return
     start = (time.perf_counter() - _t0) * 1e6
@@ -46,18 +68,21 @@ def span(name: str, **args):
         yield
     finally:
         end = (time.perf_counter() - _t0) * 1e6
-        with _lock:
-            _events.append(
-                {
-                    "name": name,
-                    "ph": "X",
-                    "ts": start,
-                    "dur": end - start,
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident() % 0xFFFF,
-                    "args": {k: _safe(v) for k, v in args.items()} if args else {},
-                }
-            )
+        if _enabled:
+            with _lock:
+                _events.append(
+                    {
+                        "name": name,
+                        "ph": "X",
+                        "ts": start,
+                        "dur": end - start,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 0xFFFF,
+                        "args": {k: _safe(v) for k, v in args.items()} if args else {},
+                    }
+                )
+        for fn in _span_observers:
+            fn(name, (end - start) * 1e-6)
 
 
 def instant(name: str, **args) -> None:

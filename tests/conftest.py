@@ -14,3 +14,27 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    """Collection-time guard: no orphan .pyc may shadow a deleted
+    module.  Committed-era __pycache__ artifacts of removed modules
+    (e.g. a stale gateway.cpython-*.pyc) confuse greps, tooling and
+    coverage; fail fast with the offending paths."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    orphans = []
+    for pkg in (root / "loro_tpu", root / "tests"):
+        for pyc in pkg.rglob("__pycache__/*.pyc"):
+            mod = pyc.name.split(".", 1)[0]
+            src_dir = pyc.parent.parent
+            if not (src_dir / f"{mod}.py").exists():
+                orphans.append(str(pyc.relative_to(root)))
+    if orphans:
+        import pytest
+
+        raise pytest.UsageError(
+            "orphan .pyc artifacts shadow deleted modules (delete them): "
+            + ", ".join(sorted(orphans))
+        )

@@ -1,0 +1,320 @@
+"""loro_tpu.obs: registry semantics, exposition formats, the tracing
+bridge, and counters observed ticking through the real fleet/server
+paths — all on the CPU mesh, no device access."""
+import json
+import threading
+
+import pytest
+
+from loro_tpu import LoroDoc, obs
+from loro_tpu.doc import strip_envelope
+from loro_tpu.obs import metrics as m
+from loro_tpu.obs.report import render
+from loro_tpu.utils import tracing
+
+
+@pytest.fixture
+def reg():
+    """Isolated registry (the default registry is process-global and
+    other tests tick it)."""
+    return m.Registry()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_labels_and_totals(reg):
+    c = reg.counter("x.a_total", "help text")
+    c.inc()
+    c.inc(4, family="text")
+    c.inc(2, family="map")
+    assert c.get() == 1
+    assert c.get(family="text") == 4
+    assert c.total() == 7
+    # label order is normalized
+    c.inc(1, b="2", a="1")
+    assert c.get(a="1", b="2") == 1
+
+
+def test_gauge_set_inc_dec(reg):
+    g = reg.gauge("x.depth")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.get() == 6
+    g.set(1.5, family="tree")
+    assert g.get(family="tree") == 1.5
+
+
+def test_histogram_buckets_and_quantiles(reg):
+    h = reg.histogram("x.seconds", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(6.05)
+    assert 0.1 <= s["p50"] <= 1.0  # two obs in the (0.1, 1] bucket
+    assert 1.0 <= s["p99"] <= 10.0
+    rows = h.snapshot()["values"]
+    assert rows[0]["buckets"] == [[0.1, 1], [1.0, 3], [10.0, 4], ["+Inf", 4]]
+    # overflow bucket: beyond the last bound
+    h.observe(99.0)
+    assert h.snapshot()["values"][0]["buckets"][-1] == ["+Inf", 5]
+
+
+def test_unique_cardinality(reg):
+    u = reg.unique("x.shapes")
+    u.add(("text", 64, 8))
+    u.add(("text", 64, 8))
+    u.add(("text", 128, 8))
+    assert u.get() == 2
+    assert u.total() == 2
+
+
+def test_kind_conflict_raises(reg):
+    reg.counter("x.n")
+    with pytest.raises(TypeError):
+        reg.gauge("x.n")
+
+
+def test_histogram_time_context(reg):
+    h = reg.histogram("x.t_seconds")
+    with h.time(family="text"):
+        pass
+    assert h.summary()["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# exposition: prometheus text + JSON snapshot round trip + sidecar
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_exposition_format(reg):
+    from loro_tpu.obs.exposition import prometheus_text
+
+    reg.counter("fleet.ops_merged_total", "rows merged").inc(10, family="text")
+    reg.histogram("server.epoch_seconds", buckets=[1.0]).observe(0.5, family="t")
+    reg.unique("fleet.padded_shapes_distinct").add((64, 8))
+    text = prometheus_text(reg)
+    assert "# HELP fleet_ops_merged_total rows merged" in text
+    assert "# TYPE fleet_ops_merged_total counter" in text
+    assert 'fleet_ops_merged_total{family="text"} 10' in text
+    # histogram: cumulative buckets + sum + count, le label merged in
+    assert 'server_epoch_seconds_bucket{family="t",le="1.0"} 1' in text
+    assert 'server_epoch_seconds_bucket{family="t",le="+Inf"} 1' in text
+    assert 'server_epoch_seconds_sum{family="t"} 0.5' in text
+    assert 'server_epoch_seconds_count{family="t"} 1' in text
+    # unique exports as a gauge
+    assert "# TYPE fleet_padded_shapes_distinct gauge" in text
+    assert "fleet_padded_shapes_distinct 1" in text
+
+
+def test_json_snapshot_round_trip(reg):
+    from loro_tpu.obs.exposition import snapshot_json
+
+    reg.counter("a.b_total").inc(3, k="v")
+    reg.histogram("a.h", buckets=[1.0]).observe(0.2)
+    snap = reg.snapshot()
+    assert json.loads(snapshot_json(reg)) == snap
+    # render accepts the decoded snapshot (the report CLI path)
+    out = render(json.loads(snapshot_json(reg)))
+    assert "a.b_total" in out and "a.h" in out
+
+
+def test_sidecar_shape(reg):
+    from loro_tpu.obs.exposition import sidecar
+
+    reg.counter("fleet.ops_merged_total").inc(7, family="text")
+    reg.gauge("tunnel.rtt_ms").set(74.0)
+    reg.histogram("server.epoch_seconds").observe(0.25)
+    side = sidecar(reg)
+    assert side["fleet.ops_merged_total"] == 7
+    assert side["fleet.ops_merged_total{family=text}"] == 7
+    assert side["tunnel.rtt_ms"] == 74
+    hs = side["server.epoch_seconds"]
+    assert hs["count"] == 1 and hs["p50"] is not None
+
+
+def test_report_renders_live_registry():
+    # the module entry (python -m loro_tpu.obs.report) renders the
+    # process-global registry; make sure it never throws on real state
+    obs.counter("fleet.ops_merged_total").inc(0, family="text")
+    out = render()
+    assert "loro_tpu.obs" in out
+
+
+# ---------------------------------------------------------------------------
+# thread safety
+# ---------------------------------------------------------------------------
+
+
+def test_thread_safety_smoke(reg):
+    c = reg.counter("x.threads_total")
+    h = reg.histogram("x.threads_seconds", buckets=[0.5])
+    u = reg.unique("x.threads_shapes")
+
+    def work(tid):
+        for i in range(1000):
+            c.inc()
+            h.observe(0.1)
+            u.add((tid, i % 10))
+
+    ts = [threading.Thread(target=work, args=(t,)) for t in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.get() == 8000
+    assert h.summary()["count"] == 8000
+    assert u.get() == 80
+
+
+# ---------------------------------------------------------------------------
+# tracing bridge + overhead
+# ---------------------------------------------------------------------------
+
+
+def test_span_bridge_feeds_histogram():
+    obs.enable_span_metrics()
+    try:
+        with tracing.span("obs.bridge.probe"):
+            pass
+        h = obs.histogram("trace.span_seconds")
+        rows = {tuple(sorted(r["labels"].items())): r for r in h.snapshot()["values"]}
+        assert (("span", "obs.bridge.probe"),) in rows
+        # chrome-trace collection stays off: the bridge alone must not
+        # start recording events
+        assert not tracing.is_enabled()
+        assert tracing.events() == []
+    finally:
+        obs.disable_span_metrics()
+
+
+def test_zero_overhead_when_bridge_disabled():
+    """Mirror of test_zero_overhead_when_disabled (tracing): with the
+    bridge off and tracing off, span() must not record events, call
+    observers, or grow the span histogram."""
+    obs.disable_span_metrics()
+    tracing.disable()
+    tracing.clear()
+    h = obs.histogram("trace.span_seconds")
+    before = h.summary()["count"]
+    with tracing.span("obs.overhead.probe"):
+        pass
+    assert tracing.events() == []
+    assert h.summary()["count"] == before
+    # and the always-on registry itself is cheap: a counter hot loop
+    # stays far from pathological (structural smoke, generous bound)
+    import time
+
+    c = obs.counter("x.overhead_probe_total")
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        c.inc()
+    assert time.perf_counter() - t0 < 2.0
+    assert c.get() >= 10_000
+
+
+# ---------------------------------------------------------------------------
+# counters tick through the real merge/ingest paths (CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+def _two_docs():
+    a, b = LoroDoc(peer=11), LoroDoc(peer=12)
+    a.get_text("t").insert(0, "observable text")
+    a.commit()
+    b.import_(a.export_snapshot())
+    b.get_text("t").insert(5, "XYZ")
+    a.import_(b.export_updates(a.oplog_vv()))
+    a.commit()
+    b.commit()
+    return a, b
+
+
+def test_fleet_merge_ticks_counters():
+    from loro_tpu.parallel.fleet import Fleet
+
+    a, b = _two_docs()
+    cid = a.get_text("t").id
+    ops0 = obs.counter("fleet.ops_merged_total").get(family="text")
+    calls0 = obs.counter("fleet.merge_calls_total").get(family="text")
+    launches0 = obs.counter("fleet.device_launches_total").get(family="text")
+    waste0 = obs.counter("fleet.pad_waste_rows_total").get(family="text")
+    fleet = Fleet()
+    res = fleet.merge_text_changes(
+        [a.oplog.changes_in_causal_order(), b.oplog.changes_in_causal_order()], cid
+    )
+    assert res.texts[0] == a.get_text("t").to_string()
+    assert obs.counter("fleet.merge_calls_total").get(family="text") == calls0 + 1
+    assert obs.counter("fleet.device_launches_total").get(family="text") == launches0 + 1
+    assert obs.counter("fleet.ops_merged_total").get(family="text") > ops0
+    assert obs.counter("fleet.pad_waste_rows_total").get(family="text") > waste0
+    assert obs.unique("fleet.padded_shapes_distinct").total() >= 1
+
+
+def test_resident_server_epoch_ticks_counters():
+    from loro_tpu.parallel.server import ResidentServer
+
+    a, _ = _two_docs()
+    cid = a.get_text("t").id
+    h = obs.histogram("server.epoch_seconds")
+    n0 = h.summary()["count"]
+    rounds0 = obs.counter("server.ingest_rounds_total").get(
+        family="text", route="payloads"
+    )
+    srv = ResidentServer("text", 2, capacity=1 << 10)
+    srv.ingest([strip_envelope(a.export_updates({})), None], cid)
+    assert srv.batch.texts()[0] == a.get_text("t").to_string()
+    assert h.summary()["count"] == n0 + 1
+    assert (
+        obs.counter("server.ingest_rounds_total").get(family="text", route="payloads")
+        == rounds0 + 1
+    )
+    assert obs.gauge("server.queue_depth").get(family="text") == 1
+    assert obs.counter("server.ingest_docs_total").get(family="text") >= 1
+
+
+def test_doc_io_and_codec_counters_tick():
+    imp0 = obs.counter("doc.import_calls_total").get()
+    impb0 = obs.counter("doc.import_bytes_total").get()
+    exp0 = obs.counter("doc.export_calls_total").get(mode="Updates")
+    ops0 = obs.counter("oplog.ops_applied_total").get()
+    a, b = LoroDoc(peer=21), LoroDoc(peer=22)
+    a.get_text("t").insert(0, "wire")
+    blob = a.export_updates()
+    b.import_(blob)
+    assert obs.counter("doc.import_calls_total").get() == imp0 + 1
+    assert obs.counter("doc.import_bytes_total").get() == impb0 + len(blob)
+    assert obs.counter("doc.export_calls_total").get(mode="Updates") == exp0 + 1
+    assert obs.counter("oplog.ops_applied_total").get() > ops0
+
+
+def test_native_decode_counters_tick():
+    from loro_tpu import native
+    from loro_tpu.core.ids import ContainerID, ContainerType
+    from loro_tpu.ops.columnar import extract_seq_from_payload
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    a = LoroDoc(peer=31)
+    a.get_text("t").insert(0, "native bytes")
+    a.commit()
+    pl = strip_envelope(a.export_updates())
+    calls0 = obs.counter("codec.native_decode_calls_total").total()
+    bytes0 = obs.counter("codec.native_decode_bytes_total").total()
+    cid = ContainerID.root("t", ContainerType.Text)
+    assert extract_seq_from_payload(pl, cid) is not None
+    assert obs.counter("codec.native_decode_calls_total").total() > calls0
+    assert obs.counter("codec.native_decode_bytes_total").total() >= bytes0 + len(pl)
+
+
+def test_host_fallback_counter_ticks(monkeypatch):
+    from loro_tpu.parallel.idmap import PyIdMap, make_idmap
+
+    monkeypatch.setenv("LORO_PY_IDMAP", "1")
+    n0 = obs.counter("fleet.host_fallback_total").get(kind="idmap")
+    assert isinstance(make_idmap(), PyIdMap)
+    assert obs.counter("fleet.host_fallback_total").get(kind="idmap") == n0 + 1

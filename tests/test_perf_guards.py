@@ -1,11 +1,27 @@
 """Anti-quadratic perf guards (reference: crates/loro/tests/
 perf_import_quadratic.rs + perf_text_insert_quadratic.rs — asserting
-scaling shape, not absolute numbers)."""
+scaling shape, not absolute numbers).
+
+The wall-clock RATIO guards are load-sensitive on shared runners
+(ADVICE r5 finding 3): PERF_GUARD_RATIO widens the scaling bound
+(default 11; CI under heavy ambient load can export e.g. 20), and
+PERF_GUARD_SKIP=1 skips the timing-based guards entirely — the
+structural (counted, not timed) guards always run."""
+import os
 import time
 
 import pytest
 
 from loro_tpu import LoroDoc
+
+# quadratic would be ~16x for 4x work; n log n with noise stays well
+# under the default 11 — overridable for noisy shared runners
+RATIO_BOUND = float(os.environ.get("PERF_GUARD_RATIO", "11"))
+
+timing_guard = pytest.mark.skipif(
+    os.environ.get("PERF_GUARD_SKIP", "0") in ("1", "true", "yes"),
+    reason="PERF_GUARD_SKIP=1: wall-clock guards disabled (noisy runner)",
+)
 
 
 def _time_text_insert(n: int) -> float:
@@ -41,20 +57,28 @@ def _best_of(fn, n, reps=4) -> float:
     return min(fn(n) for _ in range(reps))
 
 
+@timing_guard
 def test_text_insert_not_quadratic():
     # sizes large enough that interpreter warmup noise doesn't dominate
     small = max(_best_of(_time_text_insert, 4000), 1e-3)
     big = _best_of(_time_text_insert, 16000)
-    # 4x work: quadratic would be ~16x; n log n with noise stays well under
-    assert big / small < 11, f"text insert scaling {big/small:.1f}x for 4x work"
+    assert big / small < RATIO_BOUND, (
+        f"text insert scaling {big/small:.1f}x for 4x work "
+        f"(bound {RATIO_BOUND}; widen via PERF_GUARD_RATIO if load-noise)"
+    )
 
 
+@timing_guard
 def test_import_not_quadratic():
     small = max(_best_of(_time_import, 100), 1e-4)
     big = _best_of(_time_import, 400)
-    assert big / small < 11, f"import scaling {big/small:.1f}x for 4x work"
+    assert big / small < RATIO_BOUND, (
+        f"import scaling {big/small:.1f}x for 4x work "
+        f"(bound {RATIO_BOUND}; widen via PERF_GUARD_RATIO if load-noise)"
+    )
 
 
+@timing_guard
 def test_checkout_bounded():
     """Checkout cost stays proportional to history, not history^2."""
     doc = LoroDoc(peer=1)
@@ -204,6 +228,7 @@ def test_diff_delta_vs_fullscan_equivalence():
         )
 
 
+@timing_guard
 def test_native_order_engine_floor():
     """Resident-fleet host ceiling guard (tests/soak_fleet.py measures
     ~3M rows/s/core isolated): the native order engine must stay above
@@ -236,6 +261,7 @@ def test_native_order_engine_floor():
     assert rate > 500_000, f"native order engine at {rate/1e6:.2f}M rows/s (< 0.5M floor)"
 
 
+@timing_guard
 def test_resident_ingest_floor():
     """Full resident ingest floor (r5 host-funnel rebuild measured
     ~1.1M rows/s/core steady at 768-row epochs): order maintenance +

@@ -102,6 +102,55 @@ class TestResidentServer:
         with pytest.raises(DecodeError):
             ResidentServer.restore(bytes(blob))
 
+    def test_mixed_round_bytes_and_changes(self):
+        """Regression (ADVICE r5 finding 1): a round mixing bytes
+        payloads and Change lists must normalize PER DOC instead of
+        routing the whole round through append_payloads, where the
+        change list raised a TypeError that escaped the per-doc
+        (KeyError, ValueError) fallback."""
+        from loro_tpu.obs import metrics as obs
+
+        a, _ = _mk_pair()
+        c = LoroDoc(peer=5)
+        c.get_text("t").insert(0, "changes-list doc")
+        c.commit()
+        cid = a.get_text("t").id
+        srv = ResidentServer("text", n_docs=2, capacity=1 << 12)
+        n0 = obs.counter("server.ingest_fallback_total").get(
+            family="text", reason="mixed_round"
+        )
+        srv.ingest(
+            [strip_envelope(a.export_updates({})),
+             c.oplog.changes_in_causal_order()],
+            cid,
+        )
+        got = srv.batch.texts()
+        assert got[0] == a.get_text("t").to_string()
+        assert got[1] == c.get_text("t").to_string()
+        # the one bytes entry was decoded host-side and counted
+        assert obs.counter("server.ingest_fallback_total").get(
+            family="text", reason="mixed_round"
+        ) == n0 + 1
+
+    def test_counter_family_bytes_round(self):
+        """Counter has no native payload path: an all-bytes round takes
+        the host-decode route and is counted as no_payload_path."""
+        from loro_tpu.obs import metrics as obs
+
+        doc = LoroDoc(peer=7)
+        doc.get_counter("c").increment(5)
+        doc.commit()
+        srv = ResidentServer("counter", n_docs=1)
+        n0 = obs.counter("server.ingest_fallback_total").get(
+            family="counter", reason="no_payload_path"
+        )
+        srv.ingest([strip_envelope(doc.export_updates({}))])
+        vals = srv.batch.value_maps()[0]
+        assert list(vals.values()) == [5.0]
+        assert obs.counter("server.ingest_fallback_total").get(
+            family="counter", reason="no_payload_path"
+        ) == n0 + 1
+
     @pytest.mark.parametrize("family", ["map", "counter"])
     def test_fold_families_compact_noop(self, family):
         srv = ResidentServer(family, n_docs=1)

@@ -87,15 +87,34 @@ def _metrics_sidecar() -> dict | None:
         return None
 
 
+def _resilience_sidecar() -> dict | None:
+    """Supervisor outcome dict (retries, degradations, drain budget)
+    plus the parent's probe outcome — so BENCH_r*.json records capture
+    flaky-pool sessions (docs/RESILIENCE.md) instead of losing them."""
+    try:
+        from loro_tpu.resilience import get_supervisor
+
+        rep = get_supervisor().report()
+        probe = os.environ.get("BENCH_PROBE_OUTCOME")
+        if probe:
+            rep["probe"] = probe
+        return rep if (rep.get("launches") or probe) else None
+    except Exception:
+        return None
+
+
 def bank(phase: str, **fields) -> None:
     """Merge fields into the checkpoint and atomically persist it.  The
     parent emits the newest checkpoint if this child never finishes.
-    Every bank refreshes the metrics sidecar so a timeout-abandoned
-    child still leaves its newest counters behind."""
+    Every bank refreshes the metrics + resilience sidecars so a
+    timeout-abandoned child still leaves its newest counters behind."""
     _CKPT.update(fields)
     side = _metrics_sidecar()
     if side:
         _CKPT["metrics"] = side
+    res = _resilience_sidecar()
+    if res:
+        _CKPT["resilience"] = res
     _CKPT["last_phase"] = phase
     _CKPT["elapsed_s"] = round(time.time() - T0, 1)
     p = _ckpt_path()
@@ -183,6 +202,7 @@ def assemble_record(ck: dict) -> dict:
         "richtext_vs_baseline",
         "trace",
         "metrics",
+        "resilience",
         "elapsed_s",
     ):
         if k in ck and ck[k] is not None:
@@ -482,6 +502,17 @@ def main() -> None:
     def remaining() -> float:
         return child_deadline - time.time()
 
+    # every device phase below routes through one DeviceSupervisor:
+    # bounded in-flight budget (drain_every=8, the post-mortem rule),
+    # cooperative deadline at the child deadline minus a drain margin
+    # (checked BETWEEN launches — an expiry surfaces as a typed
+    # DeadlineExceeded at a launch boundary, never a signal), and its
+    # report() banks as the `resilience` sidecar on every checkpoint
+    from loro_tpu.resilience import DeviceSupervisor, set_supervisor
+
+    sup = DeviceSupervisor(drain_every=8, deadline_s=max(30.0, remaining() - 15))
+    set_supervisor(sup)
+
     # ---- phase 0: device contact (banked BEFORE anything else) -------
     # A wedged axon tunnel hangs on the FIRST device op; banking a
     # device-provenance record immediately lets the parent distinguish
@@ -596,8 +627,12 @@ def main() -> None:
         """Timed throughput loop: flights of `drain` launches with a
         fetch-sync between flights (bounds the in-device queue; the
         queue drains through the final fetch so wall-clock spans real
-        work).  Returns (ops/s, docs_done, flight_times)."""
-        drain = 8
+        work).  Launches route through the DeviceSupervisor, whose
+        drain_every matches the flight size — the supervisor's
+        auto-drain IS the between-flight sync, so the in-flight queue
+        provably never exceeds the budget.  Returns (ops/s, docs_done,
+        flight_times)."""
+        drain = sup.drain_every
         n_chunks_req = max(1, docs_total // chunk)
         n_chunks = max(1, min(n_chunks_req, int(secs / max(t_pilot / 4, 1e-9))))
         flights = []
@@ -607,18 +642,21 @@ def main() -> None:
         i = 0
         tf = t0
         while i < n_chunks:
-            out = fn(batches[i % n_batches])
+            b = batches[i % n_batches]
+            out = sup.launch(lambda b=b: fn(b), label=f"bench.{label}")
             ops_done += batch_ops[i % n_batches]
             i += 1
             if i % drain == 0:
-                sync(out)
+                # the supervisor auto-drained at this boundary (depth
+                # hit drain_every on the launch above); flight is timed
+                # against that fetch-sync
                 now = time.perf_counter()
                 flights.append(now - tf)
                 tf = now
                 if (now - t0) > secs or remaining() < 30:
                     note(f"{label}: budget expired after {i}/{n_chunks} chunks")
                     break
-        sync(out)
+        sup.drain(lambda: sync(out))
         dt = time.perf_counter() - t0
         # fleet accounting for the sidecar: the budget loop is the
         # bench's merge front-end, so it ticks the same counters the
@@ -1059,11 +1097,18 @@ def main() -> None:
 
 
 def _tunnel_alive(timeout_s: float = 75.0) -> bool:
-    """Fast liveness probe: a tiny jit + host fetch in a subprocess.
-    A wedged axon tunnel (see CLAUDE.md) hangs on the FIRST device op,
-    so probing with a 75s cap fails fast instead of burning the full
-    watchdog budget (and avoids SIGTERMing a large mid-flight upload,
-    which is what wedges tunnels in the first place)."""
+    """Fast liveness probe: a tiny jit + host fetch in a subprocess,
+    NEVER signaled on timeout (a signal mid-launch is what wedges the
+    tunnel — the probe must not cause the wedge it detects).  The
+    canonical implementation lives in loro_tpu.resilience.probe; the
+    inline twin below keeps the parent working even if the repo import
+    itself is broken (the parent must ALWAYS emit a JSON line)."""
+    try:
+        from loro_tpu.resilience.probe import tunnel_alive
+
+        return tunnel_alive(timeout_s)
+    except Exception:
+        pass
     import subprocess
 
     code = (
@@ -1080,10 +1125,6 @@ def _tunnel_alive(timeout_s: float = 75.0) -> bool:
     try:
         return proc.wait(timeout=timeout_s) == 0
     except subprocess.TimeoutExpired:
-        # Do NOT signal it: even a tiny op can be mid-launch, and a
-        # SIGTERM mid-launch is what wedges the tunnel (CLAUDE.md) —
-        # the probe must not cause the wedge it detects.  Abandon the
-        # child (own session); it exits on its own when the op resolves.
         return False
 
 
@@ -1258,12 +1299,15 @@ def main_guarded() -> None:
     fallback_reason = None
     if probe_wanted and not _tunnel_alive():
         fallback_reason = "ambient device failed the 75s liveness probe (wedged tunnel?)"
+        os.environ["BENCH_PROBE_OUTCOME"] = env["BENCH_PROBE_OUTCOME"] = "dead"
         print(
             "bench: ambient device failed the 75s liveness probe "
             "(wedged tunnel?); cpu fallback without burning the watchdog",
             file=sys.stderr,
         )
     else:
+        # the child banks the probe outcome into its resilience sidecar
+        env["BENCH_PROBE_OUTCOME"] = "alive" if probe_wanted else "skipped"
         # child stdout -> devnull: the parent is the only JSON emitter
         # (the child's record arrives via the checkpoint file).  stderr
         # -> log file, NOT inherited: an abandoned child dumping its

@@ -43,6 +43,7 @@ from .models.handlers import (
     TreeHandler,
 )
 from . import obs
+from . import resilience
 from .awareness import Awareness, EphemeralStore
 from .codec.json_schema import RedactError, redact_json_updates
 from .cursor import AbsolutePosition, Cursor, CursorSide, get_cursor, get_cursor_pos
@@ -98,4 +99,5 @@ __all__ = [
     "Awareness",
     "EphemeralStore",
     "obs",
+    "resilience",
 ]

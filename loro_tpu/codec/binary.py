@@ -464,15 +464,46 @@ def _read_parent(r: Reader, peers: List[int]):
 def read_tables(buf: bytes):
     """Parse just the payload prelude dictionaries.  Returns
     (peers, keys, cids, reader-positioned-after-tables) — the single
-    place that knows the header layout besides encode_changes."""
+    place that knows the header layout besides encode_changes.
+    Truncated/corrupt preludes raise a typed CodecDecodeError (a
+    ValueError subclass, so every per-payload ``except ValueError``
+    fallback path catches it)."""
+    from ..errors import CodecDecodeError
+
     r = Reader(buf)
-    peers = [r.u64le() for _ in range(r.varint())]
-    keys = [r.str_() for _ in range(r.varint())]
-    cids = [_read_cid(r, peers) for _ in range(r.varint())]
+    try:
+        peers = [r.u64le() for _ in range(r.varint())]
+        keys = [r.str_() for _ in range(r.varint())]
+        cids = [_read_cid(r, peers) for _ in range(r.varint())]
+    except CodecDecodeError:
+        raise
+    except (IndexError, struct.error, UnicodeDecodeError, ValueError,
+            OverflowError) as e:
+        raise CodecDecodeError(
+            f"malformed payload tables ({type(e).__name__}: {e})"
+        ) from e
     return peers, keys, cids, r
 
 
 def decode_changes(buf: bytes) -> List[Change]:
+    """Decode a bare (envelope-stripped) updates payload.  Truncated or
+    bit-flipped input raises a typed CodecDecodeError (a ValueError and
+    DecodeError subclass) — never an untyped IndexError/struct.error
+    escaping from the Reader."""
+    from ..errors import CodecDecodeError
+
+    try:
+        return _decode_changes_inner(buf)
+    except CodecDecodeError:
+        raise
+    except (IndexError, struct.error, UnicodeDecodeError, ValueError,
+            KeyError, OverflowError) as e:
+        raise CodecDecodeError(
+            f"malformed updates payload ({type(e).__name__}: {e})"
+        ) from e
+
+
+def _decode_changes_inner(buf: bytes) -> List[Change]:
     peers, keys, cids, r = read_tables(buf)
     n_changes = r.varint()
     metas = []

@@ -7,3 +7,45 @@ class LoroError(Exception):
 
 class DecodeError(LoroError):
     pass
+
+
+class CodecDecodeError(DecodeError, ValueError):
+    """Truncated / bit-flipped / otherwise malformed wire bytes.
+
+    Subclasses ValueError on purpose: every ingest path that falls back
+    to the Python decoder on `except ValueError` (fleet payload extract,
+    resident append_payloads) keeps working unchanged, while callers
+    that want the typed contract can catch CodecDecodeError (or
+    DecodeError) specifically.
+    """
+
+
+class ResilienceError(LoroError):
+    """Base for the resilience subsystem (loro_tpu/resilience/)."""
+
+
+class DeviceFailure(ResilienceError):
+    """Supervisor-declared device failure: a launch raised a
+    non-recoverable runtime error, or exhausted its retry budget on
+    transient ``UNAVAILABLE``-class errors.  Callers degrade to the
+    host ``models/`` engine or surface this typed error — never an
+    untyped crash, never a hang."""
+
+    def __init__(self, label: str, attempts: int = 1, cause: str = ""):
+        self.label = label
+        self.attempts = attempts
+        super().__init__(
+            f"device failure at {label!r} after {attempts} attempt(s)"
+            + (f": {cause}" if cause else "")
+        )
+
+
+class BackendUnavailable(DeviceFailure):
+    """Backend init never came up within the probe deadline (the
+    rounds-4/5 TPU-pool lottery, as a typed error instead of a hang)."""
+
+
+class DeadlineExceeded(ResilienceError):
+    """A cooperative deadline expired BETWEEN launches.  Raised only at
+    launch boundaries — never by signaling a process mid-compile or
+    mid-transfer (the tunnel-wedge post-mortems in docs/RESILIENCE.md)."""

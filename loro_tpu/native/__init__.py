@@ -16,7 +16,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..errors import CodecDecodeError
 from ..obs import metrics as _obs
+from ..resilience import faultinject as _fi
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "codec.cpp")
@@ -48,11 +50,16 @@ def _build() -> bool:
         return False
 
 
-def _obs_decode(fn: str, payload: bytes) -> None:
+def _obs_decode(fn: str, payload: bytes) -> bytes:
     """Per-call decode accounting (docs/OBSERVABILITY.md): which native
-    explode entry ran and how many wire bytes it chewed."""
+    explode entry ran and how many wire bytes it chewed.  Also the
+    fault-injection choke point: an armed ``decode`` fault truncates or
+    bit-flips the payload here, before the C++ parser sees it — the
+    parser must answer with a typed CodecDecodeError, never a crash."""
+    payload = _fi.mangle("decode", payload)
     _obs.counter("codec.native_decode_calls_total").inc(fn=fn)
     _obs.counter("codec.native_decode_bytes_total").inc(len(payload), fn=fn)
+    return payload
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -216,10 +223,10 @@ def explode_seq_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
-    _obs_decode("seq", payload)
+    payload = _obs_decode("seq", payload)
     n = lib.loro_count_seq_elements(payload, len(payload), target_cid_index)
     if n < 0:
-        raise ValueError("native decode failed (malformed payload?)")
+        raise CodecDecodeError("native decode failed (malformed payload?)")
     parent = np.empty(n, np.int32)
     side = np.empty(n, np.int32)
     peer = np.empty(n, np.int32)
@@ -239,7 +246,7 @@ def explode_seq_payload(payload: bytes, target_cid_index: int):
         n,
     )
     if wrote != n:
-        raise ValueError("native decode failed (unresolvable refs or count mismatch)")
+        raise CodecDecodeError("native decode failed (unresolvable refs or count mismatch)")
     return parent, side, peer, counter, deleted.astype(bool), content
 
 
@@ -251,11 +258,11 @@ def explode_seq_delta_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
-    _obs_decode("seq_delta", payload)
+    payload = _obs_decode("seq_delta", payload)
     n = lib.loro_count_seq_delta_rows(payload, len(payload), target_cid_index)
     nd = lib.loro_count_seq_deletes(payload, len(payload), target_cid_index)
     if n < 0 or nd < 0:
-        raise ValueError("native decode failed (malformed payload?)")
+        raise CodecDecodeError("native decode failed (malformed payload?)")
     parent = np.empty(n, np.int32)
     side = np.empty(n, np.int32)
     peer = np.empty(n, np.int32)
@@ -286,7 +293,7 @@ def explode_seq_delta_payload(payload: bytes, target_cid_index: int):
         ctypes.byref(n_del_out),
     )
     if wrote != n:
-        raise ValueError("native delta decode failed")
+        raise CodecDecodeError("native delta decode failed")
     return {
         "parent": parent,
         "side": side,
@@ -311,12 +318,12 @@ def explode_seq_anchor_meta(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
-    _obs_decode("seq_anchor", payload)
+    payload = _obs_decode("seq_anchor", payload)
     n = lib.loro_explode_seq_anchor_meta(
         payload, len(payload), target_cid_index, None, None, None, None, None, 0
     )
     if n < 0:
-        raise ValueError("native anchor decode failed (malformed payload?)")
+        raise CodecDecodeError("native anchor decode failed (malformed payload?)")
     row = np.empty(n, np.int64)
     key = np.empty(n, np.int32)
     voff = np.empty(n, np.int64)
@@ -334,7 +341,7 @@ def explode_seq_anchor_meta(payload: bytes, target_cid_index: int):
         n,
     )
     if wrote != n:
-        raise ValueError("native anchor decode failed")
+        raise CodecDecodeError("native anchor decode failed")
     return {"row": row, "key_idx": key, "voffset": voff, "lamport": lam, "flags": flags}
 
 
@@ -348,10 +355,10 @@ def explode_map_payload(payload: bytes):
     lib = _load()
     if lib is None:
         return None
-    _obs_decode("map", payload)
+    payload = _obs_decode("map", payload)
     n = lib.loro_count_map_ops(payload, len(payload))
     if n < 0:
-        raise ValueError("native decode failed (malformed payload?)")
+        raise CodecDecodeError("native decode failed (malformed payload?)")
     cid = np.empty(n, np.int32)
     key = np.empty(n, np.int32)
     lamport = np.empty(n, np.int32)
@@ -370,9 +377,10 @@ def explode_map_payload(payload: bytes):
         n,
     )
     if wrote != n:
-        raise ValueError("native decode failed (count mismatch)")
+        raise CodecDecodeError("native decode failed (count mismatch)")
     # wire peer table is registration-ordered; remap to sorted ranks
-    # (same contract handling as extract_seq_from_payload)
+    # (same contract handling as extract_seq_from_payload).  read_tables
+    # raises a typed CodecDecodeError itself on truncated preludes.
     from ..codec.binary import read_tables
 
     peers_wire, keys, cids, _r = read_tables(payload)
@@ -412,10 +420,10 @@ def explode_tree_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
-    _obs_decode("tree", payload)
+    payload = _obs_decode("tree", payload)
     n = lib.loro_count_tree_ops(payload, len(payload), target_cid_index)
     if n < 0:
-        raise ValueError("native decode failed (malformed payload?)")
+        raise CodecDecodeError("native decode failed (malformed payload?)")
     cols = {
         "lamport": np.empty(n, np.int32),
         "peer_idx": np.empty(n, np.int32),
@@ -436,7 +444,7 @@ def explode_tree_payload(payload: bytes, target_cid_index: int):
         n,
     )
     if wrote != n:
-        raise ValueError("native decode failed (count mismatch)")
+        raise CodecDecodeError("native decode failed (count mismatch)")
     return cols
 
 
@@ -448,7 +456,7 @@ def explode_movable_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
-    _obs_decode("movable", payload)
+    payload = _obs_decode("movable", payload)
     n_slots = ctypes.c_longlong()
     n_sets = ctypes.c_longlong()
     n_dels = ctypes.c_longlong()
@@ -461,7 +469,7 @@ def explode_movable_payload(payload: bytes, target_cid_index: int):
         ctypes.byref(n_dels),
     )
     if rc < 0:
-        raise ValueError("native decode failed (malformed payload?)")
+        raise CodecDecodeError("native decode failed (malformed payload?)")
     ns, nv, nd = n_slots.value, n_sets.value, n_dels.value
     slots = {
         "parent": np.empty(ns, np.int32),
@@ -496,7 +504,7 @@ def explode_movable_payload(payload: bytes, target_cid_index: int):
         nd,
     )
     if wrote != ns:
-        raise ValueError("native decode failed (unresolvable refs or count mismatch)")
+        raise CodecDecodeError("native decode failed (unresolvable refs or count mismatch)")
     return {"slots": slots, "sets": sets, "dels": dels}
 
 
@@ -508,7 +516,7 @@ def explode_movable_delta_payload(payload: bytes, target_cid_index: int):
     lib = _load()
     if lib is None:
         return None
-    _obs_decode("movable_delta", payload)
+    payload = _obs_decode("movable_delta", payload)
     n_slots = ctypes.c_longlong()
     n_sets = ctypes.c_longlong()
     n_dels = ctypes.c_longlong()
@@ -521,7 +529,7 @@ def explode_movable_delta_payload(payload: bytes, target_cid_index: int):
         ctypes.byref(n_dels),
     )
     if rc < 0:
-        raise ValueError("native decode failed (malformed payload?)")
+        raise CodecDecodeError("native decode failed (malformed payload?)")
     ns, nv, nd = n_slots.value, n_sets.value, n_dels.value
     slots = {
         "parent": np.empty(ns, np.int32),
@@ -560,7 +568,7 @@ def explode_movable_delta_payload(payload: bytes, target_cid_index: int):
         ext_ctr.ctypes.data_as(ctypes.c_void_p),
     )
     if wrote != ns:
-        raise ValueError("native delta decode failed")
+        raise CodecDecodeError("native delta decode failed")
     slots["ext_peer_idx"] = ext_peer
     slots["ext_counter"] = ext_ctr
     return {"slots": slots, "sets": sets, "dels": dels}

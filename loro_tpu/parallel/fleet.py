@@ -26,7 +26,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.change import Change
 from ..core.ids import ContainerID
+from ..errors import DeviceFailure
 from ..obs import metrics as obs
+from ..resilience import get_supervisor
 from ..utils import tracing
 from ..ops.columnar import MapExtract, SeqExtract, extract_seq_container
 from ..ops.fugue_batch import SeqColumns, materialize_content_batch, pad_bucket
@@ -65,6 +67,32 @@ def _obs_fallback(kind: str) -> None:
     LORO_PY_IDMAP or missing native lib) and per-payload decode
     fallbacks."""
     obs.counter("fleet.host_fallback_total").inc(kind=kind)
+
+
+def _sup_launch(label: str, thunk):
+    """Route one merge launch through the process DeviceSupervisor:
+    bounded retry on transient UNAVAILABLE errors, typed DeviceFailure
+    on anything terminal, in-flight accounting (docs/RESILIENCE.md).
+    Fleet merge thunks are pure (fresh device_put inputs, no donated
+    buffers) so retry is safe."""
+    return get_supervisor().launch(thunk, label=label)
+
+
+def _sup_fetch(label: str, value):
+    """Supervised host fetch: the merge's sync point (drains the
+    in-flight queue through it)."""
+    return get_supervisor().fetch(value, label=label)
+
+
+def _host_degrade(family: str, docs_changes, cid=None):
+    """Graceful degradation: re-run a failed device merge on the host
+    ``models/`` engine (byte-identical by the differential-fuzz
+    contract).  One obs counter per degraded merge."""
+    from ..resilience import hostpath
+
+    get_supervisor().note_degradation(f"fleet.{family}")
+    obs.counter("fleet.degraded_merges_total").inc(family=family)
+    return hostpath.host_merge_changes(family, docs_changes, cid)
 
 
 def _empty_seq_np(n: int):
@@ -140,10 +168,16 @@ class Fleet:
             *[np.stack([getattr(c, f) for c in cols_np]) for f in SeqColumns._fields]
         )
         sh = doc_sharding(self.mesh)
-        batched = SeqColumns(*[jax.device_put(a, sh) for a in batched])
-        codes, counts = self._text_fn(batched)
-        codes = np.asarray(codes)
-        counts = np.asarray(counts)
+        # the upload is supervised too: a dead tunnel raises
+        # synchronously at device_put, and that must be a typed
+        # DeviceFailure for the degradation handlers, not a raw crash
+        batched = _sup_launch(
+            "fleet.text",
+            lambda: SeqColumns(*[jax.device_put(a, sh) for a in batched]),
+        )
+        codes, counts = _sup_launch("fleet.text", lambda: self._text_fn(batched))
+        codes = _sup_fetch("fleet.text", codes)
+        counts = _sup_fetch("fleet.text", counts)
         texts = [
             "".join(map(chr, codes[i, : counts[i]])) for i in range(d)
         ]
@@ -152,9 +186,14 @@ class Fleet:
     def merge_text_changes(
         self, docs_changes: Sequence[Sequence[Change]], cid: ContainerID
     ) -> TextMergeResult:
-        """Convenience: decode + merge each doc's change list."""
+        """Convenience: decode + merge each doc's change list.  On a
+        supervisor-declared device failure the merge transparently
+        re-runs on the host engine (same bytes out, typed counters)."""
         extracts = [extract_seq_container(chs, cid) for chs in docs_changes]
-        return self.merge_text_docs(extracts)
+        try:
+            return self.merge_text_docs(extracts)
+        except DeviceFailure:
+            return TextMergeResult(_host_degrade("text", docs_changes, cid))
 
     def merge_text_payloads(
         self, payloads: Sequence[bytes], cid: ContainerID
@@ -190,7 +229,12 @@ class Fleet:
                         "history payloads — use DeviceDocBatch for deltas"
                     ) from e
             extracts.append(ex)
-        return self.merge_text_docs(extracts)
+        try:
+            return self.merge_text_docs(extracts)
+        except DeviceFailure:
+            return TextMergeResult(
+                _host_degrade("text", [decode_changes(p) for p in payloads], cid)
+            )
 
     # ------------------------------------------------------------------
     # rich text merge
@@ -252,24 +296,33 @@ class Fleet:
             )
             padded.extend([empty] * (d_pad - len(padded)))
         sh = doc_sharding(self.mesh)
-        cols = RichtextChainCols(
-            chain=ChainColumns(
-                *[
-                    jax.device_put(np.stack([getattr(q.chain, f) for q in padded]), sh)
-                    for f in ChainColumns._fields
-                ]
-            ),
-            **{
-                f: jax.device_put(np.stack([getattr(q, f) for q in padded]), sh)
-                for f in RichtextChainCols._fields
-                if f != "chain"
-            },
-        )
-        codes, counts, bounds, win = richtext_chain_merge_batch(cols, n_keys)
-        codes = np.asarray(codes)
-        counts = np.asarray(counts)
-        bounds = np.asarray(bounds)
-        win = np.asarray(win)
+
+        def upload():
+            return RichtextChainCols(
+                chain=ChainColumns(
+                    *[
+                        jax.device_put(np.stack([getattr(q.chain, f) for q in padded]), sh)
+                        for f in ChainColumns._fields
+                    ]
+                ),
+                **{
+                    f: jax.device_put(np.stack([getattr(q, f) for q in padded]), sh)
+                    for f in RichtextChainCols._fields
+                    if f != "chain"
+                },
+            )
+
+        try:
+            cols = _sup_launch("fleet.richtext", upload)
+            codes, counts, bounds, win = _sup_launch(
+                "fleet.richtext", lambda: richtext_chain_merge_batch(cols, n_keys)
+            )
+            codes = _sup_fetch("fleet.richtext", codes)
+            counts = _sup_fetch("fleet.richtext", counts)
+            bounds = _sup_fetch("fleet.richtext", bounds)
+            win = _sup_fetch("fleet.richtext", win)
+        except DeviceFailure:
+            return _host_degrade("richtext", docs_changes, cid)
         results = []
         for i, (_, keys, values) in enumerate(extracts):
             text = "".join(map(chr, codes[i, : counts[i]]))
@@ -301,9 +354,12 @@ class Fleet:
         value lists (one vmapped launch)."""
         from ..ops.movable_batch import extract_movable
 
-        return self._merge_movable_extracted(
-            [extract_movable(chs, cid) for chs in docs_changes]
-        )
+        try:
+            return self._merge_movable_extracted(
+                [extract_movable(chs, cid) for chs in docs_changes]
+            )
+        except DeviceFailure:
+            return _host_degrade("movable", docs_changes, cid)
 
     def merge_movable_payloads(self, payloads: Sequence[bytes], cid) -> List[list]:
         """Native ingest: envelope-stripped update payloads -> C++
@@ -329,7 +385,12 @@ class Fleet:
                         "history payloads — use DeviceDocBatch for deltas"
                     ) from e
             extracts.append(ex)
-        return self._merge_movable_extracted(extracts)
+        try:
+            return self._merge_movable_extracted(extracts)
+        except DeviceFailure:
+            return _host_degrade(
+                "movable", [decode_changes(p) for p in payloads], cid
+            )
 
     def _merge_movable_extracted(self, extracts) -> List[list]:
         import jax.numpy as jnp
@@ -383,7 +444,7 @@ class Fleet:
             sv.append(np.zeros(k, np.int32))
             svd.append(np.zeros(k, bool))
         sh = doc_sharding(self.mesh)
-        cols = MovableCols(
+        cols = _sup_launch("fleet.movable", lambda: MovableCols(
             seq=SeqColumns(
                 *[
                     jax.device_put(np.stack([getattr(q, f) for q in seq_stack]), sh)
@@ -396,10 +457,12 @@ class Fleet:
             set_peer=jax.device_put(np.stack(sp), sh),
             set_value=jax.device_put(np.stack(sv), sh),
             set_valid=jax.device_put(np.stack(svd), sh),
+        ))
+        out, counts = _sup_launch(
+            "fleet.movable", lambda: movable_merge_batch(cols, n_elems)
         )
-        out, counts = movable_merge_batch(cols, n_elems)
-        out = np.asarray(out)
-        counts = np.asarray(counts)
+        out = _sup_fetch("fleet.movable", out)
+        counts = _sup_fetch("fleet.movable", counts)
         results = []
         for i, (_, _, values) in enumerate(extracts):
             idxs = out[i, : counts[i]]
@@ -420,9 +483,12 @@ class Fleet:
         maps {TreeID: parent TreeID | None} of alive nodes."""
         from ..ops.tree_batch import extract_tree_ops
 
-        return self._merge_tree_extracted(
-            [extract_tree_ops(chs, cid) for chs in docs_changes]
-        )
+        try:
+            return self._merge_tree_extracted(
+                [extract_tree_ops(chs, cid) for chs in docs_changes]
+            )
+        except DeviceFailure:
+            return _host_degrade("tree", docs_changes, cid)
 
     def merge_tree_payloads(self, payloads: Sequence[bytes], cid) -> List[dict]:
         """Native ingest: envelope-stripped update payloads -> C++ tree
@@ -443,7 +509,10 @@ class Fleet:
                 _obs_fallback("payload_extract")
                 ex = extract_tree_ops(decode_changes(p), cid)
             extracted.append(ex)
-        return self._merge_tree_extracted(extracted)
+        try:
+            return self._merge_tree_extracted(extracted)
+        except DeviceFailure:
+            return _host_degrade("tree", [decode_changes(p) for p in payloads], cid)
 
     def _merge_tree_extracted(self, extracted) -> List[dict]:
         import jax.numpy as jnp
@@ -473,13 +542,17 @@ class Fleet:
         )
         padded += [empty] * (d_pad - d)
         sh = doc_sharding(self.mesh)
-        cols = TreeOpCols(
+        cols = _sup_launch("fleet.tree", lambda: TreeOpCols(
             *[jax.device_put(np.stack([getattr(c, f) for c in padded]), sh) for f in TreeOpCols._fields]
+        ))
+        parents, eff = _sup_launch(
+            "fleet.tree", lambda: tree_merge_batch(cols, n)
         )
-        parents, eff = tree_merge_batch(cols, n)
-        deleted = np.asarray(is_deleted_batch(parents))
-        parents = np.asarray(parents)
-        eff = np.asarray(eff)
+        deleted = _sup_fetch(
+            "fleet.tree", _sup_launch("fleet.tree", lambda: is_deleted_batch(parents))
+        )
+        parents = _sup_fetch("fleet.tree", parents)
+        eff = _sup_fetch("fleet.tree", eff)
         out = []
         for i, (c, nodes, row_pos) in enumerate(extracted):
             res = {}
@@ -525,13 +598,21 @@ class Fleet:
         )
         padded += [empty] * (d_pad - d)
         sh = doc_sharding(self.mesh)
-        cols = TreeOpCols(
-            *[jax.device_put(np.stack([getattr(c, f) for c in padded]), sh) for f in TreeOpCols._fields]
-        )
-        parents, eff = tree_merge_batch(cols, n)
-        deleted = np.asarray(is_deleted_batch(parents))
-        parents = np.asarray(parents)
-        eff = np.asarray(eff)
+        try:
+            cols = _sup_launch("fleet.tree_children", lambda: TreeOpCols(
+                *[jax.device_put(np.stack([getattr(c, f) for c in padded]), sh) for f in TreeOpCols._fields]
+            ))
+            parents, eff = _sup_launch(
+                "fleet.tree_children", lambda: tree_merge_batch(cols, n)
+            )
+            deleted = _sup_fetch(
+                "fleet.tree_children",
+                _sup_launch("fleet.tree_children", lambda: is_deleted_batch(parents)),
+            )
+            parents = _sup_fetch("fleet.tree_children", parents)
+            eff = _sup_fetch("fleet.tree_children", eff)
+        except DeviceFailure:
+            return _host_degrade("tree_children", docs_changes, cid)
         out = []
         for i, (c, nodes, row_pos) in enumerate(extracted):
             n_rows = c.target.shape[0]
@@ -600,11 +681,19 @@ class Fleet:
                 delta[di, j] = dv
                 valid[di, j] = True
         sh = doc_sharding(self.mesh)
-        sums = np.asarray(
-            counter_merge_batch(
-                jax.device_put(slot, sh), jax.device_put(delta, sh), jax.device_put(valid, sh), s
+        try:
+            sums = _sup_fetch(
+                "fleet.counter",
+                _sup_launch(
+                    "fleet.counter",
+                    lambda: counter_merge_batch(
+                        jax.device_put(slot, sh), jax.device_put(delta, sh),
+                        jax.device_put(valid, sh), s,
+                    ),
+                ),
             )
-        )
+        except DeviceFailure:
+            return _host_degrade("counter", docs_changes)
         return [
             {cid: float(sums[di, j]) for j, cid in enumerate(cids_per_doc[di])}
             for di in range(d)
@@ -643,10 +732,12 @@ class Fleet:
         )
         batched = self._batch_map_cols(extracts, m)
         sh = doc_sharding(self.mesh)
-        batched = MapOpCols(*[jax.device_put(np.asarray(a), sh) for a in batched])
+        batched = _sup_launch("fleet.map", lambda: MapOpCols(
+            *[jax.device_put(np.asarray(a), sh) for a in batched]
+        ))
         fn = _lww_batch_fn(self.mesh, s)
-        vi, _, _ = fn(batched)
-        return self._map_winner_values(np.asarray(vi), extracts)
+        vi, _, _ = _sup_launch("fleet.map", lambda: fn(batched))
+        return self._map_winner_values(_sup_fetch("fleet.map", vi), extracts)
 
     def _map_winner_values(self, vi: np.ndarray, extracts) -> List[Dict[str, object]]:
         out: List[Dict[str, object]] = []
@@ -678,10 +769,12 @@ class Fleet:
         )
         batched = self._batch_map_cols(extracts, m)
         sh = NamedSharding(self.mesh, P(DOC_AXIS, OP_AXIS))
-        batched = MapOpCols(*[jax.device_put(np.asarray(a), sh) for a in batched])
+        batched = _sup_launch("fleet.map_sharded", lambda: MapOpCols(
+            *[jax.device_put(np.asarray(a), sh) for a in batched]
+        ))
         fn = _lww_sharded_fn(self.mesh, s)
-        vi, _, _ = fn(batched)
-        return self._map_winner_values(np.asarray(vi), extracts)
+        vi, _, _ = _sup_launch("fleet.map_sharded", lambda: fn(batched))
+        return self._map_winner_values(_sup_fetch("fleet.map_sharded", vi), extracts)
 
 
 def _pad_axis1(arrays: Dict[str, "jax.Array"], new_n: int, fills: Dict[str, object], sh) -> Dict[str, "jax.Array"]:

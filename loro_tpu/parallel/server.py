@@ -16,6 +16,15 @@ DeviceDocBatch.compact).  This wrapper owns that bookkeeping:
 - ``checkpoint()/restore()`` round-trip batch + acks through LTKV
   bytes, so a restarted server resumes with its compaction floors.
 
+Resilience (docs/RESILIENCE.md): every device append routes through
+the DeviceSupervisor; the server auto-checkpoints before its first
+risky (first-compile) launch; a data error in one round isolates to
+the offending doc (host-decode fallback, then poison-skip with a
+typed record); a supervisor-declared DeviceFailure transparently
+degrades the epoch to the host ``models/`` engine (byte-identical by
+the differential-fuzz contract) and ``recover()`` replays the round
+journal back onto a fresh device batch.
+
 Reference analog: the two-round sync loop of the reference's README
 (crates/loro/README) plus its shallow-snapshot floor
 (crates/loro-internal/src/encoding/shallow_snapshot.rs:16-40), packaged
@@ -25,7 +34,9 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+from ..errors import DeviceFailure, ResilienceError
 from ..obs import metrics as obs
+from ..resilience import faultinject, get_supervisor
 from .fleet import (
     DeviceCounterBatch,
     DeviceDocBatch,
@@ -61,6 +72,13 @@ _FAMILIES = {
 }
 _COMPACTABLE = ("text", "list", "tree", "movable")
 
+# host-side data errors: poison payloads / bad change lists.  These
+# route to the per-doc isolation pass — anything else escaping an
+# append is a config/logic error that must surface to the caller.
+import struct as _struct  # noqa: E402  (stdlib, for _struct.error)
+
+_DATA_ERRORS = (ValueError, TypeError, KeyError, IndexError, _struct.error)
+
 
 class ResidentServer:
     """One resident device batch + per-doc replica-ack bookkeeping.
@@ -69,11 +87,28 @@ class ResidentServer:
     "counter".  Capacity knobs pass through (capacity, slot_capacity,
     move_capacity, node_capacity, elem_capacity).  The underlying batch
     is ``self.batch`` — every read API (texts/richtexts/values/
-    value_lists/parent_maps/...) is used directly on it.
+    value_lists/parent_maps/...) is available directly on it, or
+    through the same-named delegating methods here, which keep working
+    when the server is degraded to the host engine.
+
+    ``host_fallback=True`` keeps a round journal (every ingest since
+    birth, frozen as encoded wire bytes) so a supervisor-declared
+    device failure can rebuild the state host-side.  The journal grows
+    for the server's life — it is the CRDT oplog, compactly encoded —
+    and the host mirror fundamentally needs it from birth (folded
+    checkpoint state cannot seed per-doc replicas); memory-constrained
+    deployments pass ``host_fallback=False`` (degradation then
+    surfaces as a typed DeviceFailure instead).  Re-anchoring
+    ``recover()`` on the last checkpoint to bound REPLAY (not mirror)
+    cost is a roadmap item.  ``auto_checkpoint=True`` snapshots the
+    server into ``last_checkpoint`` right before the first risky
+    (first-compile) device launch.
     """
 
     def __init__(self, family: str, n_docs: int, mesh=None,
-                 auto_grow: bool = True, **caps):
+                 auto_grow: bool = True, supervisor=None,
+                 host_fallback: bool = True, auto_checkpoint: bool = True,
+                 **caps):
         if family not in _FAMILIES:
             raise ValueError(f"unknown family {family!r} (one of {sorted(_FAMILIES)})")
         self.family = family
@@ -82,6 +117,47 @@ class ResidentServer:
         # acks[di][replica] = newest epoch that replica confirmed
         self.acks: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
         self._compacted_at: List[int] = [0] * n_docs
+        self._init_resilience(
+            mesh=mesh, auto_grow=auto_grow, caps=dict(caps),
+            supervisor=supervisor, host_fallback=host_fallback,
+            auto_checkpoint=auto_checkpoint, history_complete=True,
+        )
+
+    def _init_resilience(self, mesh, auto_grow, caps, supervisor,
+                         host_fallback, auto_checkpoint,
+                         history_complete) -> None:
+        self._mesh = mesh
+        self._auto_grow = auto_grow
+        self._caps = caps
+        self._supervisor = supervisor
+        self._host_fallback = host_fallback
+        # journal of (updates, cid, use_payloads) rounds since birth;
+        # complete only for servers born via __init__ (a restore()d
+        # server misses pre-checkpoint rounds, so it cannot seed a host
+        # mirror — degradation surfaces typed instead)
+        self._history: List[tuple] = []
+        self._history_complete = history_complete
+        self._degraded = False
+        self._host = None
+        self._epoch_base = 0
+        self._host_rounds = 0
+        # visible epoch = batch-internal epoch + offset: a degrade/
+        # recover cycle may replay fewer internal epochs than clients
+        # already acked (the failed round can commit on device but land
+        # in the journal only once), so the offset keeps the VISIBLE
+        # epoch monotone across recovery
+        self._epoch_offset = 0
+        self._cid = None
+        self._auto_ckpt_pending = auto_checkpoint
+        self.last_checkpoint: Optional[bytes] = None
+        self.last_poison_docs: List[int] = []
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def _sup(self):
+        return self._supervisor if self._supervisor is not None else get_supervisor()
 
     # -- sync rounds ---------------------------------------------------
     def ingest(self, per_doc_updates: Sequence, cid=None) -> int:
@@ -96,18 +172,23 @@ class ResidentServer:
         through the payload path (where a TypeError escaped the
         per-doc fallback)."""
         batch = self.batch
-        per_doc_updates = list(per_doc_updates)
+        per_doc_updates = [
+            faultinject.mangle("poison_doc", u, doc=di) if u is not None else None
+            for di, u in enumerate(per_doc_updates)
+        ]
         n_updated = sum(1 for u in per_doc_updates if u is not None)
         obs.gauge("server.queue_depth").set(n_updated, family=self.family)
+        self.last_poison_docs = []
         has_bytes = any(isinstance(u, (bytes, bytearray))
                         for u in per_doc_updates if u is not None)
         has_changes = any(u is not None and not isinstance(u, (bytes, bytearray))
                           for u in per_doc_updates)
         if has_bytes and (has_changes or not hasattr(batch, "append_payloads")):
             # mixed round, or a family without a native payload path
-            # (counter): decode bytes entries host-side per doc
-            from ..codec.binary import decode_changes
-
+            # (counter): decode bytes entries host-side per doc.  A
+            # bytes entry that won't decode is poison for THAT doc only
+            # — skipped with a typed record, never an uncaught error
+            # for the round.
             reason = "mixed_round" if has_changes else "no_payload_path"
             n_decoded = sum(
                 1 for u in per_doc_updates if isinstance(u, (bytes, bytearray))
@@ -115,44 +196,331 @@ class ResidentServer:
             obs.counter("server.ingest_fallback_total").inc(
                 n_decoded, family=self.family, reason=reason
             )
-            per_doc_updates = [
-                decode_changes(u) if isinstance(u, (bytes, bytearray)) else u
-                for u in per_doc_updates
-            ]
+            per_doc_updates = self._decode_bytes_entries(per_doc_updates)
             use_payloads = False
         else:
             use_payloads = has_bytes
+        if self.family not in ("map", "counter") and cid is None:
+            # API misuse, not a poison round: surface it before the
+            # isolation machinery can misread it as per-doc poison
+            raise ValueError(f"{self.family} ingest needs the container id")
+        if cid is not None:
+            self._cid = cid
         route = "payloads" if use_payloads else "changes"
         obs.counter("server.ingest_rounds_total").inc(
             family=self.family, route=route
         )
         obs.counter("server.ingest_docs_total").inc(n_updated, family=self.family)
+        if self._degraded:
+            # decode EVERYTHING first (per-doc poison -> skip, typed),
+            # then apply: a poison doc never half-applies a mirror round
+            per_doc_updates = self._decode_bytes_entries(per_doc_updates)
+            with obs.histogram(
+                "server.epoch_seconds", "ingest wall time per sync round"
+            ).time(family=self.family):
+                self._host.apply(per_doc_updates, cid)
+            self._host_rounds += 1
+            self._record_round(per_doc_updates, cid)
+            obs.counter("server.degraded_rounds_total").inc(family=self.family)
+            return self.epoch
+        sup = self._sup()
+        if self._auto_ckpt_pending:
+            # the FIRST device append compiles the scatter kernels — the
+            # riskiest launch of a server's life (a wedge here loses the
+            # epoch).  Snapshot first so the round is recoverable via
+            # checkpoint()/restore().  The checkpoint itself reads
+            # device state, so it is guarded too: a failure HERE is
+            # already a device failure and takes the degradation path.
+            self._auto_ckpt_pending = False
+            try:
+                self.last_checkpoint = sup.guard(
+                    self.checkpoint, label=f"server.checkpoint.{self.family}"
+                )
+            except DeviceFailure as e:
+                return self._degrade_round(per_doc_updates, cid, e)
+            obs.counter("server.auto_checkpoints_total").inc(family=self.family)
         try:
             with obs.histogram(
                 "server.epoch_seconds", "ingest wall time per sync round"
             ).time(family=self.family):
-                if self.family in ("map", "counter"):
-                    if use_payloads:
-                        batch.append_payloads(per_doc_updates)
-                    else:
-                        batch.append_changes(per_doc_updates)
-                else:
-                    if cid is None:
-                        raise ValueError(
-                            f"{self.family} ingest needs the container id"
-                        )
-                    if use_payloads:
-                        batch.append_payloads(per_doc_updates, cid)
-                    else:
-                        batch.append_changes(per_doc_updates, cid)
+                sup.launch(
+                    lambda: self._append(batch, per_doc_updates, cid, use_payloads),
+                    label=f"server.ingest.{self.family}",
+                    retry=False,  # appends donate buffers: never re-run
+                    drain=self._drain_fetch,
+                )
+        except DeviceFailure as e:
+            return self._degrade_round(per_doc_updates, cid, e)
+        except _DATA_ERRORS:
+            # data error (poison payload / bad change list): the
+            # columnar walk raises BEFORE any device commit, so
+            # re-attempting per doc is safe — isolate the offender
+            self._ingest_isolated(per_doc_updates, cid, sup)
+            return self.epoch
         except Exception:
+            # host-side config/logic error (e.g. capacity exceeded with
+            # auto_grow=False): surface it loudly, don't misread it as
+            # poison or degrade on it
             obs.counter("server.errors_total").inc(family=self.family)
             raise
+        self._record_round(per_doc_updates, cid)
         return self.epoch
+
+    def _append(self, batch, updates, cid, use_payloads: bool) -> None:
+        if self.family in ("map", "counter"):
+            if use_payloads:
+                batch.append_payloads(updates)
+            else:
+                batch.append_changes(updates)
+        else:
+            if cid is None:
+                raise ValueError(
+                    f"{self.family} ingest needs the container id"
+                )
+            if use_payloads:
+                batch.append_payloads(updates, cid)
+            else:
+                batch.append_changes(updates, cid)
+
+    def _decode_bytes_entries(self, updates):
+        """Bytes entries -> Change lists, per doc.  An entry that will
+        not decode is poison for that doc only: skipped (None) with a
+        typed record + counter, never an uncaught decode error."""
+        from ..codec.binary import decode_changes
+
+        out = list(updates)
+        for di, u in enumerate(out):
+            if isinstance(u, (bytes, bytearray)):
+                try:
+                    out[di] = decode_changes(bytes(u))
+                except _DATA_ERRORS:
+                    out[di] = None
+                    self.last_poison_docs.append(di)
+                    obs.counter("server.poison_docs_total").inc(family=self.family)
+        return out
+
+    def _record_round(self, updates, cid) -> None:
+        """Journal one APPLIED round.  Change-list entries are FROZEN
+        as encoded bytes: the live Change objects are aliased with the
+        producing doc's oplog, which extends them in place on later
+        commits (change RLE) — journaling the objects themselves would
+        double-apply those ops on replay.  Bytes entries are immutable
+        already and stored as-is."""
+        if not self._host_fallback:
+            return
+        from ..codec.binary import encode_changes
+
+        frozen = [
+            u if u is None or isinstance(u, (bytes, bytearray))
+            else bytes(encode_changes(list(u)))
+            for u in updates
+        ]
+        self._history.append((frozen, cid))
+
+    def _replay_round(self, batch, updates, cid) -> None:
+        """Re-apply a journaled round to `batch` with the same routing
+        rule ingest used (all-bytes + payload path -> payloads; mixed
+        or no payload path -> decode host-side).  Journaled bytes were
+        applied once already, so they are known-decodable."""
+        from ..codec.binary import decode_changes
+
+        has_bytes = any(isinstance(u, (bytes, bytearray))
+                        for u in updates if u is not None)
+        has_changes = any(u is not None and not isinstance(u, (bytes, bytearray))
+                          for u in updates)
+        if has_bytes and (has_changes or not hasattr(batch, "append_payloads")):
+            updates = [
+                decode_changes(bytes(u)) if isinstance(u, (bytes, bytearray)) else u
+                for u in updates
+            ]
+            has_bytes = False
+        self._append(batch, updates, cid, has_bytes)
+
+    def _drain_fetch(self) -> None:
+        """Tiny host fetch that drains the async device queue (the
+        honest sync — block_until_ready lies under the axon tunnel):
+        fetch the smallest device array the batch holds."""
+        import jax
+        import numpy as np
+
+        leaves = []
+        for v in self.batch.__dict__.values():
+            for leaf in jax.tree_util.tree_leaves(v):
+                if isinstance(leaf, jax.Array):
+                    leaves.append(leaf)
+        if leaves:
+            np.asarray(min(leaves, key=lambda a: a.size))
+
+    # -- per-doc error isolation --------------------------------------
+    def _ingest_isolated(self, updates, cid, sup) -> None:
+        """Re-apply a failed round one doc at a time: good docs commit,
+        bytes entries that misparse get one host-decode fallback, and
+        a doc that still fails is poison — skipped with a typed record
+        (``last_poison_docs`` + the server.poison_docs_total counter),
+        never an uncaught exception for the whole round."""
+        from ..codec.binary import decode_changes
+
+        obs.counter("server.isolation_rounds_total").inc(family=self.family)
+        for di, u in enumerate(updates):
+            if u is None:
+                continue
+            one = [None] * len(updates)
+            one[di] = u
+            use_payloads = isinstance(u, (bytes, bytearray)) and hasattr(
+                self.batch, "append_payloads"
+            )
+            try:
+                sup.launch(
+                    lambda one=one, up=use_payloads: self._append(
+                        self.batch, one, cid, up
+                    ),
+                    label=f"server.ingest.{self.family}",
+                    retry=False,
+                    drain=self._drain_fetch,
+                )
+                # each per-doc append bumps batch.epoch once, so it is
+                # journaled as its OWN round — recovery replay then
+                # reproduces the same epoch numbering clients acked
+                self._record_round(one, cid)
+                continue
+            except DeviceFailure:
+                raise  # double fault: device died mid-isolation — typed
+            except _DATA_ERRORS:
+                pass
+            if isinstance(u, (bytes, bytearray)):
+                # host-decode fallback for THIS doc only (extends the
+                # mixed-round fallback to per-doc poison isolation)
+                try:
+                    chs = decode_changes(bytes(u))
+                    one[di] = chs
+                    sup.launch(
+                        lambda one=one: self._append(self.batch, one, cid, False),
+                        label=f"server.ingest.{self.family}",
+                        retry=False,
+                        drain=self._drain_fetch,
+                    )
+                    self._record_round(one, cid)
+                    obs.counter("server.ingest_fallback_total").inc(
+                        family=self.family, reason="doc_isolated"
+                    )
+                    continue
+                except DeviceFailure:
+                    raise
+                except _DATA_ERRORS:
+                    pass
+            self.last_poison_docs.append(di)
+            obs.counter("server.poison_docs_total").inc(family=self.family)
+
+    # -- graceful degradation -----------------------------------------
+    def _degrade_round(self, updates, cid, cause: DeviceFailure) -> int:
+        """Supervisor declared the device dead mid-epoch: re-run the
+        epoch on the host engine (journal replay + this round) and stay
+        degraded until ``recover()``."""
+        if not (self._host_fallback and self._history_complete):
+            obs.counter("server.errors_total").inc(family=self.family)
+            raise cause
+        from ..resilience.hostpath import HostEngine
+
+        self._sup().note_degradation(f"server.{self.family}")
+        obs.counter("server.degraded_rounds_total").inc(family=self.family)
+        obs.gauge("server.degraded").set(1, family=self.family)
+        # base = the VISIBLE epoch (batch.epoch may already include the
+        # failed round if it committed before the drain raised)
+        self._epoch_base = self.epoch
+        host = HostEngine(self.family, self.n_docs)
+        for ups, c in self._history:
+            host.apply(ups, c)
+        if self._cid is not None and cid is None:
+            host._cid = self._cid
+        # the failed round's bytes never committed anywhere, so they
+        # are NOT known-decodable: poison-skip per doc before applying
+        self._host = host
+        self._degraded = True
+        updates = self._decode_bytes_entries(updates)
+        host.apply(updates, cid)
+        self._host_rounds = 1
+        self._record_round(updates, cid)
+        return self.epoch
+
+    def recover(self, mesh=None) -> bool:
+        """Rebuild a fresh device batch and replay the round journal
+        through it.  Replay launches pass ``retry=False`` on purpose: a
+        transiently-failed append may have half-mutated the new batch's
+        order engines / donated buffers, so the only safe unit of retry
+        is this whole method (the failed batch is discarded — call
+        ``recover()`` again).  Returns True and switches reads back to
+        the device on success; stays degraded and returns False if the
+        device is still failing."""
+        if not self._degraded:
+            return True
+        if self._caps is None:
+            raise ResilienceError(
+                "cannot recover a restore()d server (no construction caps); "
+                "build a fresh server and restore() the checkpoint into it"
+            )
+        sup = self._sup()
+        batch = _FAMILIES[self.family][1](
+            self.n_docs, mesh if mesh is not None else self._mesh,
+            self._auto_grow, self._caps,
+        )
+        try:
+            for ups, c in self._history:
+                sup.launch(
+                    lambda ups=ups, c=c: self._replay_round(batch, ups, c),
+                    label=f"server.recover.{self.family}",
+                    retry=False,
+                )
+        except DeviceFailure:
+            obs.counter("server.recovery_failures_total").inc(family=self.family)
+            return False
+        prev_visible = self.epoch
+        self.batch = batch
+        self._degraded = False
+        self._host = None
+        self._host_rounds = 0
+        # epochs clients acked must stay reachable: never regress the
+        # visible epoch below what the degraded server handed out
+        self._epoch_offset = max(
+            0, prev_visible - getattr(batch, "epoch", 0)
+        )
+        obs.counter("server.recoveries_total").inc(family=self.family)
+        obs.gauge("server.degraded").set(0, family=self.family)
+        return True
+
+    # -- reads (device batch, or the host mirror when degraded) --------
+    def _read(self, name: str, *args, **kw):
+        target = self._host if self._degraded else self.batch
+        return getattr(target, name)(*args, **kw)
+
+    def texts(self) -> List[str]:
+        return self._read("texts")
+
+    def richtexts(self) -> List[list]:
+        return self._read("richtexts")
+
+    def values(self) -> List[list]:
+        return self._read("values")
+
+    def value_maps(self):
+        return self._read("value_maps")
+
+    def root_value_maps(self, name: str):
+        return self._read("root_value_maps", name)
+
+    def parent_maps(self) -> List[dict]:
+        return self._read("parent_maps")
+
+    def children_maps(self) -> List[dict]:
+        return self._read("children_maps")
+
+    def value_lists(self) -> List[list]:
+        return self._read("value_lists")
 
     @property
     def epoch(self) -> int:
-        return getattr(self.batch, "epoch", 0)
+        if self._degraded:
+            return self._epoch_base + self._host_rounds
+        return getattr(self.batch, "epoch", 0) + self._epoch_offset
 
     # -- acknowledgment bookkeeping -----------------------------------
     def register_replica(self, di: int, replica: str) -> None:
@@ -191,13 +559,18 @@ class ResidentServer:
     # -- lifecycle -----------------------------------------------------
     def compact(self) -> int:
         """Reclaim what the ack floors allow (no-op for map/counter —
-        their resident state is already a fold).  Returns rows
+        their resident state is already a fold — and while degraded:
+        the host mirror holds no device rows to reclaim).  Returns rows
         reclaimed."""
-        if self.family not in _COMPACTABLE:
+        if self.family not in _COMPACTABLE or self._degraded:
             return 0
         floors: List[Optional[int]] = []
         for di in range(self.n_docs):
-            e = self.stable_epoch(di)
+            # acks live on the VISIBLE epoch scale; the batch compares
+            # floors against its INTERNAL epochs — translate, clamping
+            # at 0 (a too-new floor could reclaim a tombstone a replica
+            # still references)
+            e = max(0, self.stable_epoch(di) - self._epoch_offset)
             # skip docs whose floor hasn't advanced since the last pass
             floors.append(e if e > self._compacted_at[di] else None)
         if all(f is None for f in floors):
@@ -214,18 +587,28 @@ class ResidentServer:
 
     # -- checkpoint/resume --------------------------------------------
     def checkpoint(self) -> bytes:
-        """Batch state + ack floors as one LTKV store."""
+        """Batch state + ack floors as one LTKV store.  Unavailable
+        while degraded (the device state is gone — ``recover()``
+        first, or restore the pre-failure ``last_checkpoint``)."""
+        if self._degraded:
+            raise ResilienceError(
+                "cannot checkpoint a degraded server (device state lost); "
+                "recover() first or restore() the last_checkpoint"
+            )
         from ..codec.binary import Writer
         from ..storage import MemKvStore
 
         kv = MemKvStore()
         meta = Writer()
-        meta.u8(1)  # server-state version
+        meta.u8(2)  # server-state version (v2: + epoch offset)
         meta.str_(self.family)
         meta.varint(self.n_docs)
         meta.varint(len(self._compacted_at))
         for e in self._compacted_at:
             meta.varint(e)
+        # acks are visible-scale; the batch state is internal-scale —
+        # the offset must survive restore or floors skew (see epoch)
+        meta.varint(self._epoch_offset)
         kv.set(b"server", bytes(meta.buf))
         w = Writer()
         w.varint(len(self.acks))
@@ -252,12 +635,13 @@ class ResidentServer:
         try:
             r = Reader(meta_b)
             version = r.u8()
-            if version > 1:
+            if version > 2:
                 raise DecodeError(f"ResidentServer state v{version} too new")
             family = r.str_()
             n_docs = r.varint()
             n_comp = r.varint()
             compacted_at = [r.varint() for _ in range(n_comp)]
+            epoch_offset = r.varint() if version >= 2 else 0
             if family not in _FAMILIES or n_comp != n_docs:
                 raise DecodeError("ResidentServer state: malformed meta")
             r = Reader(acks_b)
@@ -283,4 +667,15 @@ class ResidentServer:
             raise DecodeError(
                 "ResidentServer state: batch narrower than the ack table"
             )
+        # a restored server misses its pre-checkpoint rounds: no
+        # journal could ever seed a mirror or a recovery replay, so
+        # host_fallback is OFF (journaling would be an unbounded leak
+        # with zero consumers) and a later device failure surfaces as
+        # a typed DeviceFailure — build fresh + restore() instead
+        srv._init_resilience(
+            mesh=mesh, auto_grow=True, caps=None, supervisor=None,
+            host_fallback=False, auto_checkpoint=False,
+            history_complete=False,
+        )
+        srv._epoch_offset = epoch_offset
         return srv

@@ -1,0 +1,61 @@
+"""loro_tpu.resilience: supervised device execution, fault injection,
+and graceful host degradation for the fleet merge path.
+
+Four pieces (docs/RESILIENCE.md has the full rules and rationale):
+
+- ``supervisor``  — DeviceSupervisor: bounded in-flight launch budget
+  with periodic fetch-drains, cooperative deadlines (checked BETWEEN
+  launches, never signaling mid-compile/mid-transfer), bounded retry
+  with exponential backoff for transient ``UNAVAILABLE`` errors, and
+  typed DeviceFailure for everything terminal.
+- ``probe``       — the staggered never-signaled backend-init probe
+  ladder (``wait_for_backend``) + the cheap pre-upload
+  ``tunnel_alive`` check.
+- ``faultinject`` — env (``LORO_FAULT=...``) + programmatic fault
+  hooks: backend-init hang/raise, launch exceptions, slow fetches,
+  truncated codec bytes, per-doc poison payloads — every degradation
+  path runs on the 8-device CPU mesh in CI.
+- ``hostpath``    — the host ``models/`` mirror that degraded resident
+  epochs and Fleet merges re-run on (byte-identical by the
+  differential-fuzz contract).
+
+All outcomes report through the ``obs`` registry (``resilience.*``,
+``probe.*``, ``faultinject.*``) and ``DeviceSupervisor.report()``
+feeds bench.py's ``resilience`` sidecar.
+"""
+from __future__ import annotations
+
+from ..errors import (
+    BackendUnavailable,
+    DeadlineExceeded,
+    DeviceFailure,
+    ResilienceError,
+)
+from . import faultinject, hostpath, probe
+from .probe import read_status, start_probe, tunnel_alive, wait_for_backend
+from .supervisor import (
+    DeviceSupervisor,
+    RetryPolicy,
+    default_transient,
+    get_supervisor,
+    set_supervisor,
+)
+
+__all__ = [
+    "BackendUnavailable",
+    "DeadlineExceeded",
+    "DeviceFailure",
+    "DeviceSupervisor",
+    "ResilienceError",
+    "RetryPolicy",
+    "default_transient",
+    "faultinject",
+    "get_supervisor",
+    "hostpath",
+    "probe",
+    "read_status",
+    "set_supervisor",
+    "start_probe",
+    "tunnel_alive",
+    "wait_for_backend",
+]

@@ -1,0 +1,257 @@
+"""Fault-injection harness for the fleet merge path.
+
+Every degradation path in docs/RESILIENCE.md is exercisable on the
+8-device CPU mesh in CI by arming faults at named *sites* — the
+instrumented choke points of the device pipeline:
+
+- ``launch``       — DeviceSupervisor.launch: raise before the device
+                     call (transient ``UNAVAILABLE`` or fatal)
+- ``fetch``        — DeviceSupervisor.fetch/drain: slow fetch (delay)
+- ``decode``       — native explode entries: truncate / bit-flip the
+                     wire bytes before the C++ parser sees them
+- ``poison_doc``   — ResidentServer.ingest: corrupt one doc's payload
+                     in a round (per-doc isolation test)
+- ``backend_init`` — resilience.probe subprocesses: hang or raise
+                     during backend init (the TPU-pool lottery)
+
+Arm programmatically::
+
+    from loro_tpu.resilience import faultinject as fi
+    fi.inject("launch", exc=RuntimeError("UNAVAILABLE: injected"), times=2)
+    try:
+        ...  # exercised path
+    finally:
+        fi.clear()
+
+or from the environment (processes you can't reach, e.g. probe
+subprocesses): ``LORO_FAULT="launch:raise:times=2;decode:truncate=16"``.
+Entries are ``;``-separated ``site:action[:k=v]*`` specs; actions are
+``raise`` (optional ``msg=``, default transient ``UNAVAILABLE``),
+``delay`` (``s=`` seconds), ``hang`` (delay with a 60s safety clamp),
+``truncate`` (``=N`` bytes to keep, default half), ``bitflip``
+(``=OFFSET``, default middle byte), and ``poison`` (``docs=1+3``).
+
+Every fire ticks ``faultinject.fired_total{site=...}`` in the obs
+registry.  Tier-1 hygiene: tests arming faults carry the
+``faultinject`` marker and the conftest guard asserts ``active()`` is
+empty after every test — a leaked fault fails the leaking test's
+teardown, not some unrelated test three files later.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..obs import metrics as _obs
+
+
+class InjectedFault(Exception):
+    """Default exception for ``raise`` faults.  The message decides
+    transience the same way real backend errors do (the supervisor
+    greps for ``UNAVAILABLE``-class markers)."""
+
+
+@dataclass
+class Fault:
+    site: str
+    action: str = "raise"          # raise | delay | hang | truncate | bitflip | poison
+    exc: Optional[BaseException] = None   # for raise: exception instance to throw
+    exc_factory: Optional[Callable[[], BaseException]] = None
+    delay_s: float = 0.0           # for delay/hang
+    keep_bytes: Optional[int] = None      # for truncate: prefix length to keep
+    flip_at: Optional[int] = None  # for bitflip: byte offset (None = middle)
+    docs: Optional[frozenset] = None      # for poison: doc indexes to hit
+    times: Optional[int] = None    # fire at most N times (None = unlimited)
+    fired: int = 0
+
+
+_lock = threading.Lock()
+_faults: Dict[str, List[Fault]] = {}
+_sleep: Callable[[float], None] = None  # injectable for tests (None = time.sleep)
+_env_loaded = False
+
+
+def set_sleep(fn: Optional[Callable[[float], None]]) -> None:
+    """Replace the sleeper delay/hang faults use (fake clocks in tests;
+    None restores time.sleep)."""
+    global _sleep
+    _sleep = fn
+
+
+def _do_sleep(s: float) -> None:
+    if s <= 0:
+        return
+    if _sleep is not None:
+        _sleep(s)
+    else:
+        import time
+
+        time.sleep(s)
+
+
+def inject(site: str, *, action: str = "raise", exc: Optional[BaseException] = None,
+           exc_factory: Optional[Callable[[], BaseException]] = None,
+           delay_s: float = 0.0, keep_bytes: Optional[int] = None,
+           flip_at: Optional[int] = None, docs=None,
+           times: Optional[int] = None) -> Fault:
+    """Arm one fault.  Returns the Fault (its ``fired`` counter is live)."""
+    f = Fault(
+        site=site, action=action, exc=exc, exc_factory=exc_factory,
+        delay_s=delay_s, keep_bytes=keep_bytes, flip_at=flip_at,
+        docs=frozenset(docs) if docs is not None else None, times=times,
+    )
+    with _lock:
+        _faults.setdefault(site, []).append(f)
+    return f
+
+
+def clear(site: Optional[str] = None) -> None:
+    with _lock:
+        if site is None:
+            _faults.clear()
+        else:
+            _faults.pop(site, None)
+
+
+def active() -> Dict[str, int]:
+    """Armed (non-exhausted) fault counts per site — the conftest
+    leak guard's view."""
+    with _lock:
+        out = {}
+        for site, fs in _faults.items():
+            n = sum(1 for f in fs if f.times is None or f.fired < f.times)
+            if n:
+                out[site] = n
+        return out
+
+
+def fired(site: str) -> int:
+    with _lock:
+        return sum(f.fired for f in _faults.get(site, ()))
+
+
+def _take(site: str, doc: Optional[int] = None) -> Optional[Fault]:
+    """First armed fault at `site` that matches `doc`; ticks counters.
+
+    Disarmed fast path: with the env parsed and no faults in the
+    table, return without touching the lock — production ingest calls
+    mangle() once per doc per round and must pay ~nothing when
+    LORO_FAULT is unset (reading a dict's truthiness is atomic in
+    CPython)."""
+    if _env_loaded and not _faults:
+        return None
+    _load_env()
+    with _lock:
+        for f in _faults.get(site, ()):
+            if f.times is not None and f.fired >= f.times:
+                continue
+            if f.docs is not None and (doc is None or doc not in f.docs):
+                continue
+            f.fired += 1
+            _obs.counter("faultinject.fired_total").inc(site=site, action=f.action)
+            return f
+    return None
+
+
+def _hang_delay(f: Fault) -> float:
+    """A 'hang' with no explicit delay must actually hang (clamped to
+    the 60s safety cap), not no-op — a vacuous hang fault would let
+    every init-hang degradation test pass without exercising anything."""
+    return min(f.delay_s, 60.0) if f.delay_s > 0 else 60.0
+
+
+def check(site: str, doc: Optional[int] = None, **ctx) -> bool:
+    """Called at instrumented sites.  Raises / sleeps per the armed
+    fault; returns True iff a fault fired (False = clean pass)."""
+    f = _take(site, doc)
+    if f is None:
+        return False
+    if f.action in ("delay", "hang"):
+        _do_sleep(_hang_delay(f) if f.action == "hang" else f.delay_s)
+        return True
+    if f.action == "raise":
+        if f.exc_factory is not None:
+            raise f.exc_factory()
+        raise (f.exc if f.exc is not None else InjectedFault(
+            f"UNAVAILABLE: injected fault at {site}"))
+    return True  # truncate/bitflip/poison fire through mangle()
+
+
+def mangle(site: str, payload, doc: Optional[int] = None):
+    """Corrupt wire bytes at an instrumented decode site.  Non-bytes
+    payloads and clean passes come back unchanged."""
+    if not isinstance(payload, (bytes, bytearray)):
+        return payload
+    f = _take(site, doc)
+    if f is None:
+        return payload
+    b = bytes(payload)
+    if f.action == "truncate":
+        keep = f.keep_bytes if f.keep_bytes is not None else len(b) // 2
+        return b[: max(0, min(keep, len(b)))]
+    if f.action in ("bitflip", "poison"):
+        if not b:
+            return b
+        at = f.flip_at if f.flip_at is not None else len(b) // 2
+        at = max(0, min(at, len(b) - 1))
+        return b[:at] + bytes([b[at] ^ 0x5A]) + b[at + 1:]
+    if f.action == "raise":
+        if f.exc_factory is not None:
+            raise f.exc_factory()
+        raise (f.exc if f.exc is not None else InjectedFault(
+            f"UNAVAILABLE: injected fault at {site}"))
+    if f.action in ("delay", "hang"):
+        _do_sleep(_hang_delay(f) if f.action == "hang" else f.delay_s)
+    return b
+
+
+# -- env wiring (LORO_FAULT) -------------------------------------------
+def _load_env() -> None:
+    """Parse LORO_FAULT once per process (probe subprocesses and CI
+    runs arm faults without touching Python)."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+    spec = os.environ.get("LORO_FAULT", "").strip()
+    if spec:
+        for entry in spec.replace(",", ";").split(";"):
+            entry = entry.strip()
+            if entry:
+                try:
+                    _install_env_entry(entry)
+                except Exception:
+                    pass  # a typo'd spec must not take the process down
+
+
+def _install_env_entry(entry: str) -> None:
+    parts = entry.split(":")
+    site = parts[0]
+    action = parts[1] if len(parts) > 1 else "raise"
+    kw: dict = {}
+    base, _, val = action.partition("=")
+    if base == "truncate":
+        kw["keep_bytes"] = int(val) if val else None
+    elif base == "bitflip":
+        kw["flip_at"] = int(val) if val else None
+    for p in parts[2:]:
+        k, _, v = p.partition("=")
+        if k == "times":
+            kw["times"] = int(v)
+        elif k in ("s", "delay"):
+            kw["delay_s"] = float(v)
+        elif k == "msg":
+            kw["exc"] = InjectedFault(v)
+        elif k == "docs":
+            kw["docs"] = frozenset(int(x) for x in v.split("+") if x)
+    inject(site, action=base, **kw)
+
+
+def _reset_env_cache_for_tests() -> None:
+    global _env_loaded
+    _env_loaded = False

@@ -1,0 +1,186 @@
+"""Backend-init probe API: the `.probe_stagger.sh` pattern, codified.
+
+The TPU pool can refuse allocations for a whole session (rounds 4 and
+5: every backend init hung 25-40 min in ``jax.devices()`` then raised
+``UNAVAILABLE``).  It is a lottery, and the winning pattern is:
+
+- a **fresh, detached, NEVER-signaled probe subprocess** every ~2 min
+  (``start_probe``) — each writes a status JSON as it advances
+  (``step``: spawned -> init -> done | error);
+- a cooperative ``wait_for_backend(deadline)`` that polls the status
+  file and keeps re-spawning stale probes until one reports ``done``
+  or the deadline passes — it NEVER signals a probe (a SIGTERM/SIGKILL
+  mid-backend-init can wedge the axon tunnel for the whole session);
+- ``tunnel_alive()`` — the cheap pre-upload liveness check: tiny jit +
+  host fetch in an abandonable subprocess with a hard wait cap.
+
+Probes honor ``LORO_FAULT=backend_init:...`` (hang / raise) so the
+whole ladder is testable on the CPU mesh without a TPU in sight, and
+``LORO_PROBE_FAKE`` (``ok`` | ``hang:S`` | ``raise``) to skip backend
+init entirely in unit tests.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+from ..errors import BackendUnavailable
+from ..obs import metrics as obs
+
+DEFAULT_STATUS = ".probe_device.json"
+DEFAULT_STAGGER_S = 120.0
+
+# The probe body. Runs in a fresh interpreter: writes status JSON at
+# each step so the parent can distinguish "never started" from "hung in
+# backend init" from "done".  Never signaled by anyone.
+_PROBE_BODY = r"""
+import json, os, sys, time
+path = sys.argv[1]
+def write(step, **kw):
+    kw.update(step=step, pid=os.getpid(), t=time.time())
+    tmp = path + ".%d.tmp" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(kw, f)
+    os.replace(tmp, path)
+write("spawned")
+fake = os.environ.get("LORO_PROBE_FAKE", "")
+try:
+    if fake:
+        write("init")
+        if fake.startswith("hang"):
+            s = float(fake.split(":", 1)[1]) if ":" in fake else 3600.0
+            time.sleep(min(s, 3600.0))
+            write("done", platform="fake")
+        elif fake == "raise":
+            raise RuntimeError("UNAVAILABLE: fake backend init error")
+        else:
+            write("done", platform="fake")
+    else:
+        try:
+            from loro_tpu.resilience import faultinject as fi
+            fi.check("backend_init")
+        except ImportError:
+            pass
+        write("init")
+        import jax, jax.numpy as jnp, numpy as np
+        dev = jax.devices()[0]
+        x = jax.jit(lambda v: v + 1)(jnp.zeros(8, jnp.int32))
+        int(np.asarray(x)[0])
+        write("done", platform=dev.platform,
+              kind=str(getattr(dev, "device_kind", dev.platform)))
+except BaseException as e:
+    write("error", error="%s: %s" % (type(e).__name__, e))
+    raise
+"""
+
+
+def start_probe(status_path: str = DEFAULT_STATUS,
+                log_path: Optional[str] = None) -> subprocess.Popen:
+    """Spawn one detached probe (own session — abandonable, never
+    signaled).  Its stdout/stderr go to `log_path` (default: status
+    path + ``.log``, appended so the ladder's history accumulates)."""
+    obs.counter("probe.spawns_total").inc()
+    log = open(log_path or (status_path + ".log"), "ab")
+    try:
+        env = dict(os.environ)
+        env.setdefault("PYTHONPATH", os.pathsep.join(sys.path))
+        return subprocess.Popen(
+            [sys.executable, "-c", _PROBE_BODY, status_path],
+            stdout=log, stderr=log, start_new_session=True, env=env,
+        )
+    finally:
+        log.close()
+
+
+def read_status(status_path: str = DEFAULT_STATUS) -> Optional[dict]:
+    try:
+        with open(status_path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def wait_for_backend(deadline_s: float,
+                     status_path: str = DEFAULT_STATUS,
+                     stagger_s: float = DEFAULT_STAGGER_S,
+                     poll_s: float = 2.0,
+                     clock: Callable[[], float] = time.monotonic,
+                     sleep: Callable[[float], None] = time.sleep,
+                     spawn: Callable[..., object] = start_probe,
+                     raise_on_timeout: bool = False) -> dict:
+    """Run the staggered probe ladder until a probe reports ``done`` or
+    ``deadline_s`` elapses.  Returns the final status dict augmented
+    with ``ok`` (bool), ``probes`` (spawn count) and ``waited_s``.
+
+    Probes are never signaled: a hung probe is simply left behind and a
+    fresh one is spawned every ``stagger_s``.  With
+    ``raise_on_timeout`` the timeout becomes a typed
+    BackendUnavailable instead of ``ok=False``."""
+    t0 = clock()
+    deadline = t0 + deadline_s
+    try:
+        # a stale step=done from a PREVIOUS session must not pass for a
+        # live backend — only status written by this ladder's probes
+        # counts
+        os.unlink(status_path)
+    except OSError:
+        pass
+    spawn(status_path)
+    probes = 1
+    last_spawn = t0
+    while True:
+        st = read_status(status_path)
+        if st is not None and st.get("step") == "done":
+            out = dict(st, ok=True, probes=probes, waited_s=clock() - t0)
+            obs.gauge("probe.backend_up").set(1)
+            return out
+        now = clock()
+        if now >= deadline:
+            break
+        if now - last_spawn >= stagger_s:
+            # the previous probe is stale (hung init or died): abandon
+            # it unsignaled and start a fresh attempt — the lottery
+            spawn(status_path)
+            probes += 1
+            last_spawn = now
+        sleep(min(poll_s, max(deadline - now, 0.0)))
+    st = read_status(status_path) or {}
+    obs.gauge("probe.backend_up").set(0)
+    out = dict(st, ok=False, probes=probes, waited_s=clock() - t0)
+    if raise_on_timeout:
+        raise BackendUnavailable(
+            "backend_init", probes,
+            f"no probe reported done within {deadline_s:.0f}s "
+            f"(last step: {st.get('step')!r})",
+        )
+    return out
+
+
+def tunnel_alive(timeout_s: float = 75.0) -> bool:
+    """Fast liveness probe: tiny jit + host fetch in a subprocess.  A
+    wedged axon tunnel hangs on the FIRST device op, so a hard wait cap
+    fails fast.  The child is NEVER signaled on timeout — even a tiny
+    op can be mid-launch, and a signal mid-launch is what wedges
+    tunnels in the first place; it is abandoned in its own session and
+    exits on its own when (if) the op resolves."""
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "x = jax.jit(lambda v: v + 1)(jnp.zeros(8, jnp.int32));"
+        "print(int(np.asarray(x)[0]))"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    obs.counter("probe.tunnel_probes_total").inc()
+    try:
+        ok = proc.wait(timeout=timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        ok = False  # abandoned, not signaled
+    obs.gauge("probe.tunnel_alive").set(1 if ok else 0)
+    return ok

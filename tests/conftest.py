@@ -21,6 +21,11 @@ def pytest_configure(config):
     module.  Committed-era __pycache__ artifacts of removed modules
     (e.g. a stale gateway.cpython-*.pyc) confuse greps, tooling and
     coverage; fail fast with the offending paths."""
+    config.addinivalue_line(
+        "markers",
+        "faultinject: test arms loro_tpu.resilience.faultinject faults "
+        "(the conftest guard asserts they are cleared afterwards)",
+    )
     import pathlib
 
     root = pathlib.Path(__file__).resolve().parent.parent
@@ -38,3 +43,24 @@ def pytest_configure(config):
             "orphan .pyc artifacts shadow deleted modules (delete them): "
             + ", ".join(sorted(orphans))
         )
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _faultinject_leak_guard():
+    """Tier-1 hygiene: a test that arms a fault and leaks it would make
+    some unrelated test three files later fail mysteriously.  Assert
+    the fault table is empty after EVERY test; clear it regardless so
+    one leak produces exactly one failure (the leaking test's)."""
+    from loro_tpu.resilience import faultinject
+
+    yield
+    leaked = faultinject.active()
+    faultinject.clear()
+    faultinject.set_sleep(None)
+    assert not leaked, (
+        f"faultinject faults leaked by this test: {leaked} — wrap arms in "
+        "try/finally faultinject.clear() (see the 'faultinject' marker)"
+    )

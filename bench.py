@@ -197,6 +197,9 @@ def assemble_record(ck: dict) -> dict:
         "resident_rows_per_sec",
         "resident_rows_per_sec_best",
         "resident_note",
+        "resident_durable_rows_per_sec",
+        "resident_durable_replayed_rounds",
+        "resident_durable_note",
         "richtext_value",
         "richtext_unit",
         "richtext_vs_baseline",
@@ -1084,6 +1087,55 @@ def main() -> None:
                 f"resident ingest: median {_rates[len(_rates)//2]/1e3:.0f}k "
                 f"rows/s (best {_rates[-1]/1e3:.0f}k)"
             )
+            if os.environ.get("BENCH_DURABLE") == "1":
+                # durable sub-phase: same epochs on a smaller fleet
+                # through the WAL (fsync'd per round) + one mid-run
+                # checkpoint, then a reopen with bounded replay — the
+                # `persist` sidecar banks the wal/fsync histograms
+                import shutil as _shutil
+                import tempfile as _tempfile
+
+                from loro_tpu.persist import recover_server as _recover
+
+                _ddir = _tempfile.mkdtemp(prefix=".durable_bench_")
+                try:
+                    _dsrv = ResidentServer(
+                        "text", 8, capacity=1 << 14, durable_dir=_ddir
+                    )
+                    _d0 = time.perf_counter()
+                    for _e, _pl in enumerate(_eps):
+                        _dsrv.ingest([_pl] * 8, _cid)
+                        if _e == len(_eps) // 2:
+                            _dsrv.checkpoint()
+                    np.asarray(_jnp.count_nonzero(_dsrv.batch.cols.valid))
+                    _dsec = time.perf_counter() - _d0
+                    _dsrv.close()
+                    _rec = _recover(_ddir)
+                    assert _rec.batch.texts()[0] == _t.to_string()
+                    _rec.close()
+                    bank(
+                        "resident_durable",
+                        resident_durable_rows_per_sec=round(
+                            8 * 768 * len(_eps) / _dsec
+                        ),
+                        resident_durable_replayed_rounds=(
+                            _rec.last_recovery.rounds_replayed
+                        ),
+                        resident_durable_note=(
+                            "resident ingest with durable_dir (per-round "
+                            "WAL fsync + one mid-run checkpoint), then "
+                            "recover_server reopen gated on the oracle; "
+                            "the persist.* entries of the metrics "
+                            "sidecar carry the wal/fsync histograms"
+                        ),
+                    )
+                    note(
+                        f"durable resident ingest: {8*768*len(_eps)/_dsec/1e3:.0f}k "
+                        f"rows/s; reopen replayed "
+                        f"{_rec.last_recovery.rounds_replayed} rounds"
+                    )
+                finally:
+                    _shutil.rmtree(_ddir, ignore_errors=True)
         except Exception as e:
             note(f"resident phase failed ({type(e).__name__}: {e})")
 

@@ -43,6 +43,7 @@ from .models.handlers import (
     TreeHandler,
 )
 from . import obs
+from . import persist
 from . import resilience
 from .awareness import Awareness, EphemeralStore
 from .codec.json_schema import RedactError, redact_json_updates
@@ -99,5 +100,6 @@ __all__ = [
     "Awareness",
     "EphemeralStore",
     "obs",
+    "persist",
     "resilience",
 ]

@@ -20,6 +20,14 @@ class CodecDecodeError(DecodeError, ValueError):
     """
 
 
+class PersistError(LoroError):
+    """Durability-layer failure (loro_tpu/persist/): a WAL append or
+    checkpoint write did not reach disk, or a durable directory is in a
+    state the requested operation cannot honor (e.g. opening an
+    existing log as a fresh server).  Corrupt *reads* raise DecodeError
+    subclasses instead — this type is for the write/lifecycle side."""
+
+
 class ResilienceError(LoroError):
     """Base for the resilience subsystem (loro_tpu/resilience/)."""
 

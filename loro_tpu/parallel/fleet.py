@@ -2123,6 +2123,10 @@ class DeviceMapBatch:
         )
         self.slot_of: List[Dict[Tuple[ContainerID, str], int]] = [dict() for _ in range(self.d)]
         self.values: List[List] = [[] for _ in range(self.d)]
+        # ingest-epoch clock (parity with the seq/tree batches: the
+        # server journals rounds against it; folds have no rows to
+        # reclaim, so unlike theirs it never gates a compact())
+        self.epoch = 0
 
     def grow(self, new_slot_capacity: int) -> None:
         """Repack the LWW winner columns to a larger slot capacity
@@ -2267,6 +2271,7 @@ class DeviceMapBatch:
         from ..ops.fugue_batch import pad_bucket
         from ..ops.lww import lww_update_resident
 
+        self.epoch += 1  # post-validation: dates this append (journal clock)
         m = pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16)
         if not any(rows_per_doc):
             return
@@ -2324,7 +2329,7 @@ class DeviceMapBatch:
         return out
 
     # -- checkpoint/resume --------------------------------------------
-    STATE_VERSION = 2  # v2: + auto_grow lifecycle flag
+    STATE_VERSION = 3  # v3: + ingest epoch clock
 
     def export_state(self) -> bytes:
         """Serialize the resident winners + slot/value dictionaries into
@@ -2340,6 +2345,7 @@ class DeviceMapBatch:
         meta.varint(self.d)
         meta.varint(self.s)
         meta.u8(1 if self.auto_grow else 0)  # v2
+        meta.varint(self.epoch)  # v3
         kv.set(b"meta", bytes(meta.buf))
         _state_write_grid(kv, b"res", [np.asarray(a) for a in self.res])
         for di in range(self.d):
@@ -2375,6 +2381,7 @@ class DeviceMapBatch:
                 raise DecodeError(f"DeviceMapBatch state v{version} too new")
             n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
             auto_grow = (r.u8() == 1) if version >= 2 else False
+            epoch = r.varint() if version >= 3 else 0
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceMapBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceMapBatch", d_saved, slot_capacity=s)
@@ -2382,6 +2389,7 @@ class DeviceMapBatch:
             raise DecodeError("DeviceMapBatch state: implausible n_docs")
         peers, cids = _state_read_dicts(dicts_b)
         batch = cls(n_docs, s, mesh=mesh, auto_grow=auto_grow)
+        batch.epoch = epoch
         res_b = kv.get(b"res")
         if res_b is None:
             raise DecodeError("DeviceMapBatch state: missing res")
@@ -3815,6 +3823,9 @@ class DeviceCounterBatch:
         self.sums = jax.device_put(
             np.zeros((self.d, self.s), np.float32), doc_sharding(self.mesh)
         )
+        # ingest-epoch clock (parity with the seq/tree batches — the
+        # server journals rounds against it; folds never compact)
+        self.epoch = 0
 
     def grow(self, new_slot_capacity: int) -> None:
         """Repack counter sums to a larger slot capacity (resident
@@ -3870,6 +3881,7 @@ class DeviceCounterBatch:
                     f"DeviceCounterBatch slot capacity exceeded: a doc needs "
                     f"{req} slots > {self.s}"
                 )
+        self.epoch += 1  # post-validation: dates this append (journal clock)
         if not any(rows_per_doc):
             return
         for di, order in enumerate(staged_slots):
@@ -3895,7 +3907,7 @@ class DeviceCounterBatch:
         ]
 
     # -- checkpoint/resume --------------------------------------------
-    STATE_VERSION = 2  # v2: + auto_grow lifecycle flag
+    STATE_VERSION = 3  # v3: + ingest epoch clock
 
     def export_state(self) -> bytes:
         from ..codec.binary import Writer, _Dicts
@@ -3909,6 +3921,7 @@ class DeviceCounterBatch:
         meta.varint(self.d)
         meta.varint(self.s)
         meta.u8(1 if self.auto_grow else 0)  # v2
+        meta.varint(self.epoch)  # v3
         kv.set(b"meta", bytes(meta.buf))
         _state_write_grid(kv, b"sums", [np.asarray(self.sums)])
         for di in range(self.d):
@@ -3939,6 +3952,7 @@ class DeviceCounterBatch:
                 raise DecodeError(f"DeviceCounterBatch state v{version} too new")
             n_docs, d_saved, s = r.varint(), r.varint(), r.varint()
             auto_grow = (r.u8() == 1) if version >= 2 else False
+            epoch = r.varint() if version >= 3 else 0
         except (IndexError, ValueError) as e:
             raise DecodeError(f"DeviceCounterBatch state: malformed meta ({e})") from None
         _state_sane_sizes("DeviceCounterBatch", d_saved, slot_capacity=s)
@@ -3946,6 +3960,7 @@ class DeviceCounterBatch:
             raise DecodeError("DeviceCounterBatch state: implausible n_docs")
         _peers, cids = _state_read_dicts(dicts_b)
         batch = cls(n_docs, s, mesh=mesh, auto_grow=auto_grow)
+        batch.epoch = epoch
         sums_b = kv.get(b"sums")
         if sums_b is None:
             raise DecodeError("DeviceCounterBatch state: missing sums")

@@ -91,23 +91,33 @@ class ResidentServer:
     through the same-named delegating methods here, which keep working
     when the server is degraded to the host engine.
 
-    ``host_fallback=True`` keeps a round journal (every ingest since
-    birth, frozen as encoded wire bytes) so a supervisor-declared
-    device failure can rebuild the state host-side.  The journal grows
-    for the server's life — it is the CRDT oplog, compactly encoded —
-    and the host mirror fundamentally needs it from birth (folded
-    checkpoint state cannot seed per-doc replicas); memory-constrained
-    deployments pass ``host_fallback=False`` (degradation then
-    surfaces as a typed DeviceFailure instead).  Re-anchoring
-    ``recover()`` on the last checkpoint to bound REPLAY (not mirror)
-    cost is a roadmap item.  ``auto_checkpoint=True`` snapshots the
-    server into ``last_checkpoint`` right before the first risky
-    (first-compile) device launch.
+    ``host_fallback=True`` keeps a round journal (frozen as encoded
+    wire bytes) so a supervisor-declared device failure can rebuild
+    the state host-side.  The journal is BOUNDED by checkpoints: every
+    ``checkpoint()`` folds the journaled rounds into a per-doc
+    shallow-snapshot *mirror anchor* (persist.MirrorAnchor) and drops
+    rounds at/under the checkpoint epoch, so journal length stays
+    O(rounds since the last checkpoint) and both the host mirror and
+    ``recover()`` re-anchor on the checkpoint instead of on birth.
+    Memory-constrained deployments pass ``host_fallback=False``
+    (degradation then surfaces as a typed DeviceFailure instead).
+    ``auto_checkpoint=True`` snapshots the server into
+    ``last_checkpoint`` right before the first risky (first-compile)
+    device launch.
+
+    ``durable_dir=`` makes the journal crash-durable: rounds append to
+    a segmented WAL (``loro_tpu/persist/``), checkpoints land on a
+    retention ladder and rotate/prune the WAL segments;
+    ``persist.recover_server(durable_dir)`` reopens after a crash with
+    bounded replay (docs/PERSISTENCE.md).
     """
 
     def __init__(self, family: str, n_docs: int, mesh=None,
                  auto_grow: bool = True, supervisor=None,
                  host_fallback: bool = True, auto_checkpoint: bool = True,
+                 durable_dir: Optional[str] = None,
+                 durable_fsync: bool = True,
+                 mirror_anchor: bool = True,
                  **caps):
         if family not in _FAMILIES:
             raise ValueError(f"unknown family {family!r} (one of {sorted(_FAMILIES)})")
@@ -117,26 +127,67 @@ class ResidentServer:
         # acks[di][replica] = newest epoch that replica confirmed
         self.acks: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
         self._compacted_at: List[int] = [0] * n_docs
+        durable = None
+        if durable_dir is not None:
+            from ..errors import PersistError
+            from ..persist import DurableLog, WalMeta
+
+            durable = DurableLog(durable_dir, fsync=durable_fsync)
+            try:
+                if durable.in_use():
+                    raise PersistError(
+                        f"{durable_dir}: directory already holds journaled "
+                        "rounds or checkpoints — use persist.recover_server()"
+                        "/open_server() instead of constructing a fresh "
+                        "server over them"
+                    )
+                durable.ensure_meta(WalMeta(
+                    family=family, n_docs=n_docs, caps=dict(caps),
+                    auto_grow=auto_grow, host_fallback=host_fallback,
+                ))
+            except BaseException:
+                durable.close()  # never leak the active segment handle
+                raise
+        anchor = None
+        if host_fallback and mirror_anchor:
+            from ..persist import MirrorAnchor
+
+            anchor = MirrorAnchor(family, n_docs)
         self._init_resilience(
             mesh=mesh, auto_grow=auto_grow, caps=dict(caps),
             supervisor=supervisor, host_fallback=host_fallback,
             auto_checkpoint=auto_checkpoint, history_complete=True,
+            anchor=anchor, durable=durable,
         )
 
     def _init_resilience(self, mesh, auto_grow, caps, supervisor,
                          host_fallback, auto_checkpoint,
-                         history_complete) -> None:
+                         history_complete, anchor=None, durable=None,
+                         replay_base=None, ckpt_epoch=0) -> None:
         self._mesh = mesh
         self._auto_grow = auto_grow
         self._caps = caps
         self._supervisor = supervisor
         self._host_fallback = host_fallback
-        # journal of (updates, cid, use_payloads) rounds since birth;
-        # complete only for servers born via __init__ (a restore()d
-        # server misses pre-checkpoint rounds, so it cannot seed a host
-        # mirror — degradation surfaces typed instead)
+        # journal of (epoch, frozen_updates, cid) rounds; the tail
+        # since the last checkpoint once one exists (checkpoint() folds
+        # older rounds into the mirror anchor and drops them).  With no
+        # anchor the journal must be complete since birth to seed a
+        # host mirror — a restore()d pre-v3 server has neither, so its
+        # degradation surfaces typed instead.
         self._history: List[tuple] = []
         self._history_complete = history_complete
+        # shallow-snapshot mirror anchor (persist.MirrorAnchor): the
+        # host-mirror base at the last checkpoint epoch
+        self._anchor = anchor
+        # durable journal (persist.DurableLog) when durable_dir= given
+        self._durable = durable
+        self._durable_closed = False
+        # bounded recover(): batch bytes to re-seed from (the last
+        # checkpoint blob) + the visible epoch it covers
+        self._replay_base: Optional[bytes] = replay_base
+        self._ckpt_epoch = ckpt_epoch
+        self.last_recovery = None
         self._degraded = False
         self._host = None
         self._epoch_base = 0
@@ -171,6 +222,13 @@ class ResidentServer:
         entries host-side instead of mis-routing the change lists
         through the payload path (where a TypeError escaped the
         per-doc fallback)."""
+        if getattr(self, "_durable_closed", False):
+            from ..errors import PersistError
+
+            raise PersistError(
+                "durable server is closed — a round applied now could "
+                "never be journaled; reopen via persist.recover_server()"
+            )
         batch = self.batch
         per_doc_updates = [
             faultinject.mangle("poison_doc", u, doc=di) if u is not None else None
@@ -300,13 +358,15 @@ class ResidentServer:
         return out
 
     def _record_round(self, updates, cid) -> None:
-        """Journal one APPLIED round.  Change-list entries are FROZEN
-        as encoded bytes: the live Change objects are aliased with the
-        producing doc's oplog, which extends them in place on later
-        commits (change RLE) — journaling the objects themselves would
-        double-apply those ops on replay.  Bytes entries are immutable
-        already and stored as-is."""
-        if not self._host_fallback:
+        """Journal one APPLIED round (stamped with the round's visible
+        epoch).  Change-list entries are FROZEN as encoded bytes: the
+        live Change objects are aliased with the producing doc's oplog,
+        which extends them in place on later commits (change RLE) —
+        journaling the objects themselves would double-apply those ops
+        on replay.  Bytes entries are immutable already and stored
+        as-is.  With ``durable_dir`` the round also lands in the WAL
+        (fsync'd) before this method returns."""
+        if not (self._host_fallback or self._durable is not None):
             return
         from ..codec.binary import encode_changes
 
@@ -315,7 +375,40 @@ class ResidentServer:
             else bytes(encode_changes(list(u)))
             for u in updates
         ]
-        self._history.append((frozen, cid))
+        epoch = self.epoch
+        # in-memory journal FIRST: the round is already on the device,
+        # and the mirror/recover() paths must see it even if the
+        # durable append below fails
+        if self._host_fallback:
+            self._history.append((epoch, frozen, cid))
+        if self._durable is not None:
+            # fail-stop durability: a failed append means served state
+            # has diverged from the WAL — continuing to journal would
+            # make every later recovery silently wrong.  Detach the log
+            # and surface typed; the in-memory paths stay consistent,
+            # the operator recovers durability from the last checkpoint.
+            try:
+                self._durable.append_round(epoch, cid, frozen)
+            except BaseException as e:
+                from ..errors import PersistError
+
+                log, self._durable = self._durable, None
+                self._durable_closed = True  # later ingests raise typed
+                try:
+                    log.close()
+                except Exception:
+                    pass
+                obs.counter("server.errors_total").inc(family=self.family)
+                raise PersistError(
+                    f"durable journal append failed at epoch {epoch} — "
+                    "the WAL no longer matches served state; journaling "
+                    "is DETACHED (fail-stop), recover durability from "
+                    f"{log.dir!r}: {type(e).__name__}: {e}"
+                ) from e
+            obs.gauge(
+                "persist.checkpoint_age_rounds",
+                "journaled rounds since the last checkpoint",
+            ).set(epoch - self._ckpt_epoch, family=self.family)
 
     def _replay_round(self, batch, updates, cid) -> None:
         """Re-apply a journaled round to `batch` with the same routing
@@ -414,22 +507,23 @@ class ResidentServer:
     # -- graceful degradation -----------------------------------------
     def _degrade_round(self, updates, cid, cause: DeviceFailure) -> int:
         """Supervisor declared the device dead mid-epoch: re-run the
-        epoch on the host engine (journal replay + this round) and stay
-        degraded until ``recover()``."""
-        if not (self._host_fallback and self._history_complete):
+        epoch on the host engine (anchor seed / journal replay + this
+        round) and stay degraded until ``recover()``."""
+        anchored = self._anchor is not None
+        if not (self._host_fallback and (self._history_complete or anchored)):
             obs.counter("server.errors_total").inc(family=self.family)
             raise cause
-        from ..resilience.hostpath import HostEngine
-
         self._sup().note_degradation(f"server.{self.family}")
         obs.counter("server.degraded_rounds_total").inc(family=self.family)
         obs.gauge("server.degraded").set(1, family=self.family)
         # base = the VISIBLE epoch (batch.epoch may already include the
         # failed round if it committed before the drain raised)
         self._epoch_base = self.epoch
-        host = HostEngine(self.family, self.n_docs)
-        for ups, c in self._history:
-            host.apply(ups, c)
+        host = self._seed_mirror()
+        floor = self._anchor.epoch if anchored else 0
+        for _e, ups, c in self._history:
+            if _e > floor:
+                host.apply(ups, c)
         if self._cid is not None and cid is None:
             host._cid = self._cid
         # the failed round's bytes never committed anywhere, so they
@@ -442,29 +536,103 @@ class ResidentServer:
         self._record_round(updates, cid)
         return self.epoch
 
+    def _seed_mirror(self):
+        """Host mirror base: anchor-seeded docs when a mirror anchor
+        exists (state at the last checkpoint, history trimmed below
+        it), else fresh docs (the journal is then complete since
+        birth)."""
+        if self._anchor is not None:
+            return self._anchor.seed_engine()
+        from ..resilience.hostpath import HostEngine
+
+        return HostEngine(self.family, self.n_docs)
+
+    def attach_durable(self, log) -> None:
+        """Adopt a ``persist.DurableLog`` (recover_server re-attaches
+        the reopened directory so future rounds keep journaling)."""
+        self._durable = log
+        self._durable_closed = False
+
+    def close(self) -> None:
+        """Release the durable log (flush + close the active WAL
+        segment) so ``persist.recover_server``/``open_server`` can
+        reopen the directory.  No-op without ``durable_dir``.  The
+        server stays READABLE, but further ``ingest()`` raises a typed
+        PersistError — applying a round the closed WAL can't journal
+        would silently diverge served state from recovery."""
+        if self._durable is not None:
+            self._durable.close()
+            self._durable = None
+            self._durable_closed = True
+
+    def _replay_journal_tail(self, rounds) -> None:
+        """Apply recovered WAL rounds (``(epoch, cid, frozen)``) to the
+        batch and re-seed the in-memory journal tail — recovery-only
+        (persist.recover_server); appends route through the supervisor
+        but are NOT re-journaled (the WAL already holds them)."""
+        sup = self._sup()
+        last_epoch = self._ckpt_epoch
+        for epoch, cid, ups in rounds:
+            sup.launch(
+                lambda ups=ups, cid=cid: self._replay_round(self.batch, list(ups), cid),
+                label=f"server.recover.{self.family}",
+                retry=False,
+                drain=self._drain_fetch,
+            )
+            if cid is not None:
+                self._cid = cid
+            if self._host_fallback:
+                self._history.append((epoch, list(ups), cid))
+            last_epoch = epoch
+        # visible epochs must continue exactly where the WAL left off
+        self._epoch_offset = max(
+            0, last_epoch - getattr(self.batch, "epoch", 0)
+        )
+
     def recover(self, mesh=None) -> bool:
-        """Rebuild a fresh device batch and replay the round journal
-        through it.  Replay launches pass ``retry=False`` on purpose: a
-        transiently-failed append may have half-mutated the new batch's
-        order engines / donated buffers, so the only safe unit of retry
-        is this whole method (the failed batch is discarded — call
-        ``recover()`` again).  Returns True and switches reads back to
-        the device on success; stays degraded and returns False if the
-        device is still failing."""
+        """Rebuild the device batch — from the last checkpoint's batch
+        state plus the journal tail when a checkpoint exists (bounded
+        replay), else a fresh batch plus the full journal — and switch
+        reads back to the device.  Replay launches pass ``retry=False``
+        on purpose: a transiently-failed append may have half-mutated
+        the new batch's order engines / donated buffers, so the only
+        safe unit of retry is this whole method (the failed batch is
+        discarded — call ``recover()`` again).  Returns True on
+        success; stays degraded and returns False if the device is
+        still failing."""
         if not self._degraded:
             return True
-        if self._caps is None:
+        if self._caps is None and self._replay_base is None:
             raise ResilienceError(
-                "cannot recover a restore()d server (no construction caps); "
-                "build a fresh server and restore() the checkpoint into it"
+                "cannot recover a restore()d pre-v3 server (no construction "
+                "caps in the checkpoint); build a fresh server and "
+                "restore() a v3 checkpoint into it"
             )
         sup = self._sup()
-        batch = _FAMILIES[self.family][1](
-            self.n_docs, mesh if mesh is not None else self._mesh,
-            self._auto_grow, self._caps,
-        )
         try:
-            for ups, c in self._history:
+            if self._replay_base is not None:
+                # bounded replay: re-seed the batch from the last
+                # checkpoint's device state, then replay only the
+                # journal tail (rounds after the checkpoint epoch)
+                from ..storage import MemKvStore
+
+                kv = MemKvStore()
+                kv.import_all(self._replay_base)
+                batch = sup.guard(
+                    lambda: _FAMILIES[self.family][0].import_state(
+                        kv.get(b"batch"),
+                        mesh=mesh if mesh is not None else self._mesh,
+                    ),
+                    label=f"server.recover.{self.family}",
+                )
+                tail = [r for r in self._history if r[0] > self._ckpt_epoch]
+            else:
+                batch = _FAMILIES[self.family][1](
+                    self.n_docs, mesh if mesh is not None else self._mesh,
+                    self._auto_grow, self._caps,
+                )
+                tail = self._history
+            for _e, ups, c in tail:
                 sup.launch(
                     lambda ups=ups, c=c: self._replay_round(batch, ups, c),
                     label=f"server.recover.{self.family}",
@@ -587,7 +755,12 @@ class ResidentServer:
 
     # -- checkpoint/resume --------------------------------------------
     def checkpoint(self) -> bytes:
-        """Batch state + ack floors as one LTKV store.  Unavailable
+        """Batch state + ack floors (+ v3: construction caps and the
+        mirror anchor) as one LTKV store.  Also the journal bound:
+        the anchor folds every journaled round in, the in-memory
+        journal drops to rounds AFTER this epoch, and with
+        ``durable_dir`` the blob lands on the checkpoint ladder while
+        the WAL rotates and prunes covered segments.  Unavailable
         while degraded (the device state is gone — ``recover()``
         first, or restore the pre-failure ``last_checkpoint``)."""
         if self._degraded:
@@ -598,9 +771,13 @@ class ResidentServer:
         from ..codec.binary import Writer
         from ..storage import MemKvStore
 
+        if self._anchor is not None:
+            # fold the journal tail into the shallow-snapshot anchor
+            # BEFORE trimming: the mirror oracle re-anchors here
+            self._anchor.advance(self._history, self._cid)
         kv = MemKvStore()
         meta = Writer()
-        meta.u8(2)  # server-state version (v2: + epoch offset)
+        meta.u8(3)  # server-state version (v3: + caps/flags/anchor)
         meta.str_(self.family)
         meta.varint(self.n_docs)
         meta.varint(len(self._compacted_at))
@@ -609,6 +786,17 @@ class ResidentServer:
         # acks are visible-scale; the batch state is internal-scale —
         # the offset must survive restore or floors skew (see epoch)
         meta.varint(self._epoch_offset)
+        # v3: construction caps + lifecycle flags, so a restore()d
+        # server can degrade (anchor) and recover() (caps)
+        flags = (
+            (1 if self._auto_grow else 0)
+            | (2 if self._host_fallback else 0)
+            | (4 if self._anchor is not None else 0)
+        )
+        meta.u8(flags)
+        from ..persist.wal import write_caps
+
+        write_caps(meta, self._caps or {})
         kv.set(b"server", bytes(meta.buf))
         w = Writer()
         w.varint(len(self.acks))
@@ -619,7 +807,28 @@ class ResidentServer:
                 w.varint(e)
         kv.set(b"acks", bytes(w.buf))
         kv.set(b"batch", self.batch.export_state())
-        return kv.export_all()
+        if self._anchor is not None:
+            kv.set(b"anchor", self._anchor.encode())
+        blob = kv.export_all()
+        # re-anchor recovery + bound the journal (satellite: journal
+        # length stays O(rounds since checkpoint)).  last_checkpoint
+        # stays the auto-checkpoint blob (the documented pre-first-
+        # launch restore point); _replay_base is the recovery anchor.
+        self._replay_base = blob
+        self._ckpt_epoch = self.epoch
+        if self._anchor is not None:
+            # trim ONLY when the anchor holds the folded history: a
+            # mirror_anchor=False server's host mirror still needs the
+            # journal from birth (recover() is bounded either way — it
+            # filters the tail against _ckpt_epoch)
+            self._history = [r for r in self._history if r[0] > self._ckpt_epoch]
+        if self._durable is not None:
+            self._durable.record_checkpoint(self._ckpt_epoch, blob)
+            obs.gauge(
+                "persist.checkpoint_age_rounds",
+                "journaled rounds since the last checkpoint",
+            ).set(0, family=self.family)
+        return blob
 
     @classmethod
     def restore(cls, data: bytes, mesh=None) -> "ResidentServer":
@@ -635,13 +844,25 @@ class ResidentServer:
         try:
             r = Reader(meta_b)
             version = r.u8()
-            if version > 2:
+            if version > 3:
                 raise DecodeError(f"ResidentServer state v{version} too new")
             family = r.str_()
             n_docs = r.varint()
             n_comp = r.varint()
             compacted_at = [r.varint() for _ in range(n_comp)]
             epoch_offset = r.varint() if version >= 2 else 0
+            # v3: construction caps + lifecycle flags (v1/v2 blobs keep
+            # the old semantics: no caps -> no in-place recover, no
+            # anchor -> typed failure instead of degradation)
+            auto_grow, host_fallback, has_anchor, caps = True, False, False, None
+            if version >= 3:
+                from ..persist.wal import read_caps
+
+                flags = r.u8()
+                auto_grow = bool(flags & 1)
+                host_fallback = bool(flags & 2)
+                has_anchor = bool(flags & 4)
+                caps = read_caps(r)
             if family not in _FAMILIES or n_comp != n_docs:
                 raise DecodeError("ResidentServer state: malformed meta")
             r = Reader(acks_b)
@@ -657,6 +878,16 @@ class ResidentServer:
                 acks.append(a)
         except (IndexError, ValueError, UnicodeDecodeError) as e:
             raise DecodeError(f"ResidentServer state: malformed ({e})") from None
+        anchor = None
+        if has_anchor:
+            from ..persist import MirrorAnchor
+
+            anchor_b = kv.get(b"anchor")
+            if anchor_b is None:
+                raise DecodeError("ResidentServer state: anchor flag without section")
+            anchor = MirrorAnchor.decode(anchor_b)
+            if anchor.family != family or anchor.n_docs != n_docs:
+                raise DecodeError("ResidentServer state: anchor shape mismatch")
         srv = cls.__new__(cls)
         srv.family = family
         srv.n_docs = n_docs
@@ -667,15 +898,21 @@ class ResidentServer:
             raise DecodeError(
                 "ResidentServer state: batch narrower than the ack table"
             )
-        # a restored server misses its pre-checkpoint rounds: no
-        # journal could ever seed a mirror or a recovery replay, so
-        # host_fallback is OFF (journaling would be an unbounded leak
-        # with zero consumers) and a later device failure surfaces as
-        # a typed DeviceFailure — build fresh + restore() instead
+        # a v3 restore carries everything the resilience machinery
+        # needs: caps (in-place recover()), the mirror anchor (host
+        # degradation without birth history — the journal resumes from
+        # the restore point) and the blob itself as the bounded-replay
+        # base.  Pre-v3 blobs restore with host_fallback OFF and a
+        # later device failure surfaces as a typed DeviceFailure.
         srv._init_resilience(
-            mesh=mesh, auto_grow=True, caps=None, supervisor=None,
-            host_fallback=False, auto_checkpoint=False,
-            history_complete=False,
+            mesh=mesh, auto_grow=auto_grow, caps=caps, supervisor=None,
+            host_fallback=host_fallback and anchor is not None,
+            auto_checkpoint=False, history_complete=False,
+            anchor=anchor, replay_base=data,
         )
         srv._epoch_offset = epoch_offset
+        srv.last_checkpoint = data
+        srv._ckpt_epoch = srv.epoch
+        if anchor is not None and anchor.cid is not None:
+            srv._cid = anchor.cid
         return srv

@@ -1,0 +1,595 @@
+"""Segmented append-only write-ahead log of resident ingest rounds.
+
+The ResidentServer round journal is the CRDT oplog of the fleet path,
+compactly encoded — but it lived only in RAM, so a process crash (the
+normal case per the TPU-pool lottery in docs/RESILIENCE.md) lost every
+round since birth.  This WAL is the durable form: one record per
+APPLIED round, crc32-framed in the codec/binary.py Writer/Reader
+envelope family, segment files rotated at every checkpoint so segments
+at/under the checkpoint epoch can be deleted wholesale.
+
+Reference shape: loro's L1 ChangeStore journals block-encoded changes
+over a KV store (SURVEY §L1); the write-optimized-delta + periodic-
+merge split follows the differential-store literature (arxiv
+1109.6885) — the WAL is the delta store, checkpoints are the merged
+read-optimized store.
+
+Directory layout (under ``<durable_dir>/wal/``)::
+
+    seg-00000001.log
+    seg-00000002.log      <- rotated at a checkpoint
+    ...
+
+Segment file = 5-byte header ``"LTWL" u8:version`` then frames::
+
+    u32le payload_len | u32le crc32(payload) | payload
+
+Frame payload = ``u8 rtype`` + body (codec/binary Writer primitives):
+
+- ``R_META``  — ``u8 meta_ver, str family, varint n_docs, u8 flags
+  (bit0 auto_grow, bit1 host_fallback), varint n_caps, (str, varint)*``
+  Construction caps: cold recovery (no valid checkpoint) rebuilds the
+  server from this record.  Written as the FIRST record of EVERY
+  segment so pruning old segments never loses it.
+- ``R_ROUND`` — ``varint epoch, cid_opt, varint n_docs,
+  (u8 present [, bytes_ update])*``.  Updates are the journal's frozen
+  wire bytes (encode_changes output or the client payload as-is).
+- ``R_CKPT``  — ``varint epoch, str filename``: marker that a
+  checkpoint blob landed (inspect shows the ladder inline).
+
+``cid_opt``: ``u8 0`` = None; ``u8 1, u8 ctype, str name`` = root;
+``u8 2, u8 ctype, u64le peer, zigzag counter`` = normal.
+
+Torn-tail policy (the crash contract): a bad frame — short header,
+length past EOF, crc mismatch, malformed payload — in the NEWEST
+segment is a torn tail: scanning stops there, and opening for append
+truncates the file back to the last good frame (counted in
+``persist.wal_torn_tail_truncations_total``).  The same damage in an
+OLDER segment cannot be a torn write (later segments exist, so the
+file was complete once) and raises a typed ``CodecDecodeError``.
+
+Fault sites (resilience/faultinject.py): ``wal_write`` fires
+``check()`` before each append (raise/delay); ``wal_torn_tail`` runs
+the frame bytes through ``mangle()`` on their way to disk, so a
+truncate fault writes a genuinely torn frame for reopen tests.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..codec.binary import Reader, Writer
+from ..core.ids import ContainerID, ContainerType
+from ..errors import CodecDecodeError, PersistError
+from ..obs import metrics as obs
+from ..resilience import faultinject
+
+SEG_MAGIC = b"LTWL"
+SEG_VERSION = 1
+META_VERSION = 1
+
+R_META = 0
+R_ROUND = 1
+R_CKPT = 2
+R_PRUNE = 3  # round-bearing segments were deleted below this epoch
+
+_FRAME_HDR = 8  # u32le len + u32le crc
+_MAX_FRAME = 1 << 31  # sanity bound on a declared payload length
+
+# byte-scale buckets for the append-size histogram (the default obs
+# buckets are seconds-scale)
+_BYTE_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144,
+                 1 << 20, 4 << 20, 16 << 20)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so file creations/renames/unlinks inside it
+    survive power loss (file-content fsync alone does not commit the
+    directory entry).  Best-effort on platforms without O_DIRECTORY
+    semantics."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# standalone ContainerID codec (the binary.py cid codec needs the
+# payload-level peer dictionary; WAL records are self-contained)
+# ---------------------------------------------------------------------------
+
+
+def write_caps(w: Writer, caps: Dict[str, int]) -> None:
+    """Construction-caps table (sorted ``str key, varint value``) —
+    THE one encoder: WAL meta and the v3 server checkpoint both ride
+    it, so the layouts cannot drift."""
+    w.varint(len(caps))
+    for k in sorted(caps):
+        w.str_(k)
+        w.varint(int(caps[k]))
+
+
+def read_caps(r: Reader) -> Dict[str, int]:
+    return {r.str_(): r.varint() for _ in range(r.varint())}
+
+
+def write_cid_opt(w: Writer, cid: Optional[ContainerID]) -> None:
+    if cid is None:
+        w.u8(0)
+    elif cid.is_root:
+        w.u8(1)
+        w.u8(int(cid.ctype))
+        w.str_(cid.name)
+    else:
+        w.u8(2)
+        w.u8(int(cid.ctype))
+        w.u64le(cid.peer)
+        w.zigzag(cid.counter)
+
+
+def read_cid_opt(r: Reader) -> Optional[ContainerID]:
+    tag = r.u8()
+    if tag == 0:
+        return None
+    ctype = ContainerType(r.u8())
+    if tag == 1:
+        return ContainerID.root(r.str_(), ctype)
+    if tag == 2:
+        return ContainerID.normal(r.u64le(), r.zigzag(), ctype)
+    raise ValueError(f"bad cid tag {tag}")
+
+
+# ---------------------------------------------------------------------------
+# records
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class WalMeta:
+    """Construction parameters of the owning server — enough for cold
+    recovery to rebuild it without any checkpoint."""
+
+    family: str
+    n_docs: int
+    caps: Dict[str, int] = field(default_factory=dict)
+    auto_grow: bool = True
+    host_fallback: bool = True
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u8(R_META)
+        w.u8(META_VERSION)
+        w.str_(self.family)
+        w.varint(self.n_docs)
+        w.u8((1 if self.auto_grow else 0) | (2 if self.host_fallback else 0))
+        write_caps(w, self.caps)
+        return bytes(w.buf)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "WalMeta":
+        ver = r.u8()
+        if ver > META_VERSION:
+            raise CodecDecodeError(f"WAL meta v{ver} newer than supported")
+        family = r.str_()
+        n_docs = r.varint()
+        flags = r.u8()
+        caps = read_caps(r)
+        return cls(family, n_docs, caps, bool(flags & 1), bool(flags & 2))
+
+
+@dataclass
+class WalRecord:
+    """One decoded frame (``rtype`` selects which fields are set)."""
+
+    rtype: int
+    epoch: int = 0
+    cid: Optional[ContainerID] = None
+    updates: Optional[List[Optional[bytes]]] = None
+    meta: Optional[WalMeta] = None
+    ckpt_name: str = ""
+
+
+def _encode_round(epoch: int, cid, updates) -> bytes:
+    w = Writer()
+    w.u8(R_ROUND)
+    w.varint(epoch)
+    write_cid_opt(w, cid)
+    w.varint(len(updates))
+    for u in updates:
+        if u is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            w.bytes_(bytes(u))
+    return bytes(w.buf)
+
+
+def _decode_payload(payload: bytes) -> WalRecord:
+    try:
+        r = Reader(payload)
+        rtype = r.u8()
+        if rtype == R_META:
+            return WalRecord(R_META, meta=WalMeta.decode(r))
+        if rtype == R_ROUND:
+            epoch = r.varint()
+            cid = read_cid_opt(r)
+            ups: List[Optional[bytes]] = []
+            for _ in range(r.varint()):
+                ups.append(r.bytes_() if r.u8() else None)
+            return WalRecord(R_ROUND, epoch=epoch, cid=cid, updates=ups)
+        if rtype == R_CKPT:
+            return WalRecord(R_CKPT, epoch=r.varint(), ckpt_name=r.str_())
+        if rtype == R_PRUNE:
+            return WalRecord(R_PRUNE, epoch=r.varint())
+        raise ValueError(f"unknown WAL record type {rtype}")
+    except CodecDecodeError:
+        raise
+    except (IndexError, ValueError, UnicodeDecodeError, struct.error) as e:
+        raise CodecDecodeError(f"malformed WAL record: {e}") from None
+
+
+# ---------------------------------------------------------------------------
+# segment scanning
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SegmentInfo:
+    """Scan result for one segment file (inspect + recovery both use
+    it)."""
+
+    path: str
+    index: int
+    size: int = 0
+    good_bytes: int = 0       # offset just past the last valid frame
+    n_records: int = 0
+    min_epoch: Optional[int] = None
+    max_epoch: Optional[int] = None
+    torn: bool = False        # bad frame found at good_bytes
+    error: str = ""
+
+
+def _seg_index(name: str) -> int:
+    return int(name[len("seg-"):-len(".log")])
+
+
+def _seg_name(index: int) -> str:
+    return f"seg-{index:08d}.log"
+
+
+def _scan_segment(path: str, index: int, collect=None) -> SegmentInfo:
+    """Walk one segment's frames; stop at the first bad frame (torn).
+    ``collect(offset, record)`` is called per valid record when given.
+    A bad segment HEADER is never a torn tail — it raises typed."""
+    info = SegmentInfo(path=path, index=index)
+    with open(path, "rb") as f:
+        data = f.read()
+    info.size = len(data)
+    if len(data) < 5 or data[:4] != SEG_MAGIC:
+        raise CodecDecodeError(f"{os.path.basename(path)}: not a WAL segment")
+    if data[4] > SEG_VERSION:
+        raise CodecDecodeError(
+            f"{os.path.basename(path)}: WAL segment v{data[4]} too new"
+        )
+    off = 5
+    while off < len(data):
+        if off + _FRAME_HDR > len(data):
+            info.torn, info.error = True, "short frame header"
+            break
+        ln, crc = struct.unpack_from("<II", data, off)
+        if ln > _MAX_FRAME or off + _FRAME_HDR + ln > len(data):
+            info.torn, info.error = True, "frame length past EOF"
+            break
+        payload = data[off + _FRAME_HDR: off + _FRAME_HDR + ln]
+        if zlib.crc32(payload) != crc:
+            info.torn, info.error = True, "frame crc mismatch"
+            break
+        try:
+            rec = _decode_payload(payload)
+        except CodecDecodeError as e:
+            info.torn, info.error = True, str(e)
+            break
+        if rec.rtype == R_ROUND:
+            info.min_epoch = rec.epoch if info.min_epoch is None else info.min_epoch
+            info.max_epoch = rec.epoch
+        if collect is not None:
+            collect(off, rec)
+        info.n_records += 1
+        off += _FRAME_HDR + ln
+    info.good_bytes = off  # torn: offset of the bad frame (= truncate point)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# the log
+# ---------------------------------------------------------------------------
+
+
+class WriteAheadLog:
+    """Append-only segmented log under ``<dir>`` (one server per
+    directory).  Opening an existing directory scans every segment:
+    torn tails on the newest segment are truncated away (counted),
+    corruption in older segments raises typed ``CodecDecodeError``.
+    """
+
+    def __init__(self, dir: str, fsync: bool = True):
+        self.dir = dir
+        self.fsync = fsync
+        os.makedirs(dir, exist_ok=True)
+        self._f = None  # active segment file handle
+        self._active: Optional[SegmentInfo] = None
+        self.meta: Optional[WalMeta] = None
+        # newest R_PRUNE floor: rounds at/under it were DELETED from
+        # the log, so a from-birth cold replay is no longer possible
+        self.pruned_below = 0
+        self._segments: List[SegmentInfo] = self._scan_all()
+        self._open_active()
+
+    # -- open / scan ---------------------------------------------------
+    def _scan_all(self) -> List[SegmentInfo]:
+        names = sorted(
+            n for n in os.listdir(self.dir)
+            if n.startswith("seg-") and n.endswith(".log")
+        )
+        # drop headerless TRAILING segments first (crash between
+        # segment creation and the header write): the survivor then
+        # becomes the tail, and a torn frame on IT is a legitimate
+        # torn tail, not mid-log corruption
+        while names and os.path.getsize(os.path.join(self.dir, names[-1])) < 5:
+            os.unlink(os.path.join(self.dir, names.pop()))
+            obs.counter(
+                "persist.wal_torn_tail_truncations_total",
+                "torn WAL tails truncated on reopen",
+            ).inc()
+        infos: List[SegmentInfo] = []
+        for i, name in enumerate(names):
+            is_last = i == len(names) - 1
+            path = os.path.join(self.dir, name)
+
+            def keep_meta(off, rec):
+                if rec.rtype == R_META and self.meta is None:
+                    self.meta = rec.meta
+                elif rec.rtype == R_PRUNE:
+                    self.pruned_below = max(self.pruned_below, rec.epoch)
+
+            info = _scan_segment(path, _seg_index(name), keep_meta)
+            if info.torn and not is_last:
+                raise CodecDecodeError(
+                    f"{name}: corrupt frame in a non-tail WAL segment "
+                    f"({info.error}) — not a torn tail (later segments exist)"
+                )
+            infos.append(info)
+        return infos
+
+    def _open_active(self) -> None:
+        if not self._segments:
+            self._start_segment(1)
+            return
+        last = self._segments[-1]
+        if last.torn:
+            # torn tail: truncate back to the last good frame so the
+            # next append starts on a clean boundary
+            with open(last.path, "r+b") as f:
+                f.truncate(last.good_bytes)
+            last.size = last.good_bytes
+            last.torn = False
+            obs.counter(
+                "persist.wal_torn_tail_truncations_total",
+                "torn WAL tails truncated on reopen",
+            ).inc()
+        self._f = open(last.path, "ab")
+        self._active = last
+
+    def _start_segment(self, index: int) -> None:
+        path = os.path.join(self.dir, _seg_name(index))
+        self._f = open(path, "wb")
+        self._f.write(SEG_MAGIC + bytes([SEG_VERSION]))
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+            fsync_dir(self.dir)  # commit the new directory entry too
+        info = SegmentInfo(path=path, index=index, size=5, good_bytes=5)
+        self._segments.append(info)
+        self._active = info
+        obs.counter("persist.wal_segments_total").inc()
+        # every segment is self-describing: re-write the meta record
+        # (and the prune floor, when history was ever dropped) so
+        # pruning any prefix of segments never loses what cold
+        # recovery needs to rebuild — or to refuse honestly
+        if self.meta is not None:
+            self._append(self.meta.encode(), rtype="meta")
+        if self.pruned_below:
+            w = Writer()
+            w.u8(R_PRUNE)
+            w.varint(self.pruned_below)
+            self._append(bytes(w.buf), rtype="prune")
+
+    # -- appends -------------------------------------------------------
+    def _append(self, payload: bytes, rtype: str) -> None:
+        if self._f is None:
+            raise PersistError("WAL is closed")
+        faultinject.check("wal_write", rtype=rtype)
+        frame = (
+            struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
+        )
+        # a truncate/bitflip fault here writes a genuinely damaged
+        # frame — the reopen path must cope with it (torn-tail tests)
+        frame = faultinject.mangle("wal_torn_tail", frame)
+        self._f.write(frame)
+        self._f.flush()
+        if self.fsync:
+            with obs.histogram(
+                "persist.wal_fsync_seconds", "WAL fsync wall time"
+            ).time():
+                os.fsync(self._f.fileno())
+        obs.histogram(
+            "persist.wal_append_bytes", "WAL frame payload sizes",
+            buckets=_BYTE_BUCKETS,
+        ).observe(len(payload))
+        obs.counter("persist.wal_records_total").inc(rtype=rtype)
+        a = self._active
+        a.size = a.good_bytes = a.good_bytes + _FRAME_HDR + len(payload)
+        a.n_records += 1
+
+    def write_meta(self, meta: WalMeta) -> None:
+        """Record construction caps (once per log; re-emitted at every
+        rotation).  A log that already carries a DIFFERENT meta belongs
+        to another server — cold recovery would rebuild the wrong shape
+        from it, so the mismatch is refused, never silently inherited."""
+        if self.meta is not None:
+            if self.meta != meta:
+                raise PersistError(
+                    f"{self.dir}: WAL meta mismatch — log was created for "
+                    f"{self.meta.family}/{self.meta.n_docs} docs, this "
+                    f"server is {meta.family}/{meta.n_docs}; use a fresh "
+                    "directory (or recover_server for the original)"
+                )
+            return
+        self.meta = meta
+        self._append(meta.encode(), rtype="meta")
+
+    def append_round(self, epoch: int, cid, updates) -> None:
+        """Journal one applied round (``updates``: per-doc frozen wire
+        bytes, None = no update for that doc)."""
+        self._append(_encode_round(epoch, cid, updates), rtype="round")
+        a = self._active
+        a.min_epoch = epoch if a.min_epoch is None else a.min_epoch
+        a.max_epoch = epoch
+
+    def append_ckpt_marker(self, epoch: int, name: str) -> None:
+        w = Writer()
+        w.u8(R_CKPT)
+        w.varint(epoch)
+        w.str_(name)
+        self._append(bytes(w.buf), rtype="ckpt")
+
+    # -- rotation / pruning -------------------------------------------
+    def rotate(self) -> None:
+        """Close the active segment and start the next one (called at
+        every checkpoint, so older segments become prunable units)."""
+        if self._f is not None:
+            self._f.close()
+        self._start_segment(self._active.index + 1 if self._active else 1)
+
+    def prune_below(self, epoch: int) -> int:
+        """Delete non-active segments whose every round is at/under
+        ``epoch`` (covered by a checkpoint).  Returns segments
+        removed.  When a ROUND-bearing segment goes, an ``R_PRUNE``
+        marker lands in the active segment first: cold recovery must
+        be able to tell "no rounds ever" from "rounds were deleted"
+        (silently replaying a truncated history would fabricate
+        state)."""
+        doomed = [
+            info for info in self._segments
+            if info is not self._active
+            and (info.max_epoch is None or info.max_epoch <= epoch)
+        ]
+        if any(info.max_epoch is not None for info in doomed):
+            floor = max(info.max_epoch for info in doomed
+                        if info.max_epoch is not None)
+            w = Writer()
+            w.u8(R_PRUNE)
+            w.varint(floor)
+            self._append(bytes(w.buf), rtype="prune")
+            self.pruned_below = max(self.pruned_below, floor)
+        removed = 0
+        keep: List[SegmentInfo] = []
+        for info in self._segments:
+            if info in doomed:
+                os.unlink(info.path)
+                removed += 1
+            else:
+                keep.append(info)
+        self._segments = keep
+        if removed:
+            obs.counter("persist.wal_segments_pruned_total").inc(removed)
+        return removed
+
+    # -- reads ---------------------------------------------------------
+    def records(self) -> Iterator[WalRecord]:
+        """Replay every record across segments in order.  The active
+        handle is flushed first so a same-process reader sees its own
+        appends."""
+        if self._f is not None:
+            self._f.flush()
+        for info in list(self._segments):
+            recs: List[WalRecord] = []
+            _scan_segment(info.path, info.index, lambda off, r: recs.append(r))
+            for rec in recs:
+                yield rec
+
+    def rounds_after(self, epoch: int) -> List[Tuple[int, Optional[ContainerID], List[Optional[bytes]]]]:
+        return [
+            (r.epoch, r.cid, r.updates)
+            for r in self.records()
+            if r.rtype == R_ROUND and r.epoch > epoch
+        ]
+
+    def segments(self) -> List[SegmentInfo]:
+        return list(self._segments)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class DurableLog:
+    """The per-server durable directory: ``wal/`` (this module) +
+    ``ckpt/`` (checkpoints.CheckpointManager), coordinated so a
+    checkpoint atomically (a) lands the blob on the ladder, (b) marks
+    the WAL, (c) rotates the segment and (d) prunes segments fully
+    covered by the checkpoint."""
+
+    def __init__(self, dir: str, fsync: bool = True, keep_recent: int = 3):
+        from .checkpoints import CheckpointManager
+
+        self.dir = dir
+        os.makedirs(dir, exist_ok=True)
+        self.wal = WriteAheadLog(os.path.join(dir, "wal"), fsync=fsync)
+        self.checkpoints = CheckpointManager(
+            os.path.join(dir, "ckpt"), keep_recent=keep_recent
+        )
+
+    @property
+    def meta(self) -> Optional[WalMeta]:
+        return self.wal.meta
+
+    def ensure_meta(self, meta: WalMeta) -> None:
+        self.wal.write_meta(meta)
+
+    def in_use(self) -> bool:
+        """True when the directory already holds durable state — round
+        records OR checkpoint rungs.  Both matter: a checkpoint prunes
+        every round-bearing segment, so a rounds-only check would let
+        a fresh server silently reuse (and strand) a live directory."""
+        return any(
+            s.max_epoch is not None for s in self.wal.segments()
+        ) or bool(self.checkpoints.list())
+
+    def append_round(self, epoch: int, cid, updates) -> None:
+        self.wal.append_round(epoch, cid, updates)
+
+    def record_checkpoint(self, epoch: int, blob: bytes) -> str:
+        name = self.checkpoints.save(epoch, blob)
+        self.wal.append_ckpt_marker(epoch, name)
+        self.wal.rotate()
+        # prune only below the OLDEST retained rung: a corrupt newest
+        # rung falls DOWN the ladder, and the fallback must still find
+        # the rounds between that older rung and now in the WAL
+        rungs = self.checkpoints.list()
+        if rungs:
+            self.wal.prune_below(min(c.epoch for c in rungs))
+        return name
+
+    def close(self) -> None:
+        self.wal.close()

@@ -13,6 +13,13 @@ instrumented choke points of the device pipeline:
                      in a round (per-doc isolation test)
 - ``backend_init`` — resilience.probe subprocesses: hang or raise
                      during backend init (the TPU-pool lottery)
+- ``wal_write``    — persist.wal append: raise/delay before the frame
+                     reaches disk (durability-path failures)
+- ``wal_torn_tail``— persist.wal append: mangle the frame bytes on
+                     their way to disk (truncate = a genuinely torn
+                     write for the reopen-tolerance tests)
+- ``ckpt_corrupt`` — persist.checkpoints save: mangle the framed blob
+                     (recovery must fall back down the ladder)
 
 Arm programmatically::
 

@@ -1,0 +1,167 @@
+"""Shared driver for the persist crash-recovery test (NOT collected —
+no test_ prefix).
+
+As a script (the subprocess the test SIGKILLs)::
+
+    python tests/_persist_crash_child.py <base_dir> <rounds> <ckpt_at>
+
+drives all five resident families through ``rounds`` deterministic
+ingest rounds against durable servers under ``<base_dir>/<family>``,
+checkpoints at round ``ckpt_at``, writes ``<base_dir>/READY`` and then
+sleeps — the parent kills it there, BETWEEN launches (per
+docs/RESILIENCE.md rule 1 this is a CPU-mesh process, so SIGKILL
+cannot wedge the axon tunnel; the test never signals a TPU process).
+
+As a module (imported by the parent test): ``make_doc``/``apply_edit``
+regenerate the byte-identical edit stream for the host oracle, and
+``read_server``/``read_oracle`` produce comparable views.
+"""
+import os
+import os.path as _p
+import sys
+
+sys.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))  # repo root
+
+FAMILIES = ["text", "map", "tree", "movable", "counter"]
+
+CAPS = {
+    "text": dict(capacity=1 << 12),
+    "map": dict(slot_capacity=128),
+    "tree": dict(move_capacity=1 << 10, node_capacity=256),
+    "movable": dict(capacity=1 << 10, elem_capacity=256),
+    "counter": dict(slot_capacity=32),
+}
+
+_PEER = {f: 9000 + i for i, f in enumerate(FAMILIES)}
+
+
+def make_doc(family):
+    from loro_tpu import LoroDoc
+
+    d = LoroDoc(peer=_PEER[family])
+    if family == "text":
+        d.get_text("t").insert(0, "crash base text")
+    elif family == "map":
+        d.get_map("m").set("k0", 0)
+    elif family == "tree":
+        d.get_tree("tr").create()
+    elif family == "movable":
+        d.get_movable_list("ml").push("a", "b", "c")
+    elif family == "counter":
+        d.get_counter("c").increment(1)
+    d.commit()
+    return d
+
+
+def apply_edit(d, family, r):
+    """Deterministic round-``r`` edit (same bytes in child and
+    oracle)."""
+    if family == "text":
+        t = d.get_text("t")
+        t.insert(min(r, len(t)), f"r{r} ")
+        if r % 2 == 0:
+            t.mark(0, 3, "bold", True if r % 4 == 0 else None)
+        if r % 3 == 0 and len(t) > 6:
+            t.delete(1, 2)
+    elif family == "map":
+        m = d.get_map("m")
+        m.set(f"k{r % 3}", r * 10)
+        if r % 4 == 0:
+            m.delete("k1")
+    elif family == "tree":
+        tr = d.get_tree("tr")
+        nodes = tr.nodes()
+        n = tr.create(nodes[r % len(nodes)] if r % 2 == 0 and nodes else None)
+        nodes = tr.nodes()
+        if r % 3 == 0 and len(nodes) >= 2:
+            tr.move(nodes[-1], nodes[0])
+    elif family == "movable":
+        ml = d.get_movable_list("ml")
+        L = len(ml.get_value())
+        ml.insert(r % (L + 1), f"v{r}")
+        L += 1
+        if r % 2 == 0 and L >= 2:
+            ml.move(r % L, (r * 2) % L)
+        if r % 3 == 0:
+            ml.set(r % L, f"w{r}")
+    elif family == "counter":
+        d.get_counter("c").increment(r * 3 - 5)
+    d.commit()
+
+
+def container_id(family, d):
+    if family == "text":
+        return d.get_text("t").id
+    if family == "tree":
+        return d.get_tree("tr").id
+    if family == "movable":
+        return d.get_movable_list("ml").id
+    return None
+
+
+def read_server(srv, family):
+    if family == "text":
+        return (srv.texts()[0], srv.richtexts()[0])
+    if family == "map":
+        return srv.root_value_maps("m")[0]
+    if family == "tree":
+        return (srv.parent_maps()[0], srv.children_maps()[0])
+    if family == "movable":
+        return srv.value_lists()[0]
+    return srv.value_maps()[0]
+
+
+def read_oracle(d, family):
+    if family == "text":
+        t = d.get_text("t")
+        return (t.to_string(), t.get_richtext_value())
+    if family == "map":
+        return d.get_map("m").get_value()
+    if family == "tree":
+        tr = d.get_tree("tr")
+        kids = {}
+        for x in [None] + tr.nodes():
+            ch = tr.children(x)
+            if ch:
+                kids[x] = ch
+        return ({x: tr.parent(x) for x in tr.nodes()}, kids)
+    if family == "movable":
+        return d.get_movable_list("ml").get_value()
+    c = d.get_counter("c")
+    return {c.id: float(c.get_value())}
+
+
+def main(base_dir, rounds, ckpt_at):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from loro_tpu.parallel.server import ResidentServer
+
+    servers, docs, marks = {}, {}, {}
+    for fam in FAMILIES:
+        docs[fam] = make_doc(fam)
+        servers[fam] = ResidentServer(
+            fam, 1, durable_dir=os.path.join(base_dir, fam), **CAPS[fam]
+        )
+        marks[fam] = None
+    for r in range(1, rounds + 1):
+        for fam in FAMILIES:
+            d, srv = docs[fam], servers[fam]
+            if marks[fam] is None:
+                chs = d.oplog.changes_in_causal_order()
+            else:
+                apply_edit(d, fam, r)
+                chs = d.oplog.changes_between(marks[fam], d.oplog_vv())
+            marks[fam] = d.oplog_vv()
+            srv.ingest([chs], container_id(fam, d))
+            if r == ckpt_at:
+                srv.checkpoint()
+    with open(os.path.join(base_dir, "READY"), "w") as f:
+        f.write("ready")
+    import time
+
+    time.sleep(300.0)  # the parent SIGKILLs us here, between launches
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

@@ -26,6 +26,11 @@ def pytest_configure(config):
         "faultinject: test arms loro_tpu.resilience.faultinject faults "
         "(the conftest guard asserts they are cleared afterwards)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); the full "
+        "suite and explicit invocations still execute these",
+    )
     import pathlib
 
     root = pathlib.Path(__file__).resolve().parent.parent

@@ -6,6 +6,12 @@ Drives ALL five resident device batches (text+richtext, map, tree,
 counter, movable list) through many epochs of concurrent multi-replica
 edits on the 8-device CPU mesh, gating every epoch against the host
 oracles.  Env: SOAK_RES_DOCS (6), SOAK_RES_EPOCHS (10), SOAK_RES_SEED.
+
+SOAK_RES_DURABLE=1 rides ResidentServers with a durable_dir instead of
+bare batches: every round journals to the persist WAL, every third
+epoch checkpoints (rotating + pruning segments), and after the final
+epoch each family is recovered from disk (persist.recover_server) and
+re-gated against the host oracles — bounded replay included.
 """
 import os
 import os.path as _p
@@ -34,6 +40,7 @@ from loro_tpu.parallel.mesh import make_mesh  # noqa: E402
 N = int(os.environ.get("SOAK_RES_DOCS", "6"))
 EPOCHS = int(os.environ.get("SOAK_RES_EPOCHS", "10"))
 SEED = int(os.environ.get("SOAK_RES_SEED", "0"))
+DURABLE = os.environ.get("SOAK_RES_DURABLE", "0") == "1"
 
 t0 = time.time()
 rng = random.Random(SEED)
@@ -50,18 +57,54 @@ mesh = make_mesh()
 cid_t = pairs[0][0].get_text("t").id
 cid_ml = pairs[0][0].get_movable_list("ml").id
 cid_tr = pairs[0][0].get_tree("tr").id
-docs_b = DeviceDocBatch(N, capacity=1 << 13, mesh=mesh)
-maps_b = DeviceMapBatch(N, slot_capacity=128, mesh=mesh)
-tree_b = DeviceTreeBatch(N, move_capacity=1 << 12, node_capacity=512, mesh=mesh)
-ctr_b = DeviceCounterBatch(N, slot_capacity=32, mesh=mesh)
-ml_b = DeviceMovableBatch(N, capacity=1 << 12, elem_capacity=512, mesh=mesh)
+if DURABLE:
+    import shutil
+    import tempfile
+
+    from loro_tpu.parallel.server import ResidentServer
+
+    _soak_dir = tempfile.mkdtemp(prefix="soak_res_durable_")
+
+    def _srv(fam, **caps):
+        return ResidentServer(
+            fam, N, mesh=mesh, durable_dir=os.path.join(_soak_dir, fam), **caps
+        )
+
+    docs_b = _srv("text", capacity=1 << 13)
+    maps_b = _srv("map", slot_capacity=128)
+    tree_b = _srv("tree", move_capacity=1 << 12, node_capacity=512)
+    ctr_b = _srv("counter", slot_capacity=32)
+    ml_b = _srv("movable", capacity=1 << 12, elem_capacity=512)
+    print(f"durable mode: journaling to {_soak_dir}")
+else:
+    docs_b = DeviceDocBatch(N, capacity=1 << 13, mesh=mesh)
+    maps_b = DeviceMapBatch(N, slot_capacity=128, mesh=mesh)
+    tree_b = DeviceTreeBatch(N, move_capacity=1 << 12, node_capacity=512, mesh=mesh)
+    ctr_b = DeviceCounterBatch(N, slot_capacity=32, mesh=mesh)
+    ml_b = DeviceMovableBatch(N, capacity=1 << 12, elem_capacity=512, mesh=mesh)
+
+
+def _ingest(b, ups, cid=None):
+    if DURABLE:
+        b.ingest(ups, cid)
+    elif cid is not None:
+        b.append_changes(ups, cid)
+    else:
+        b.append_changes(ups)
+
+
+def _batch(b):
+    """The device batch under either driver (compaction floors)."""
+    return b.batch if DURABLE else b
+
+
 marks = [a.oplog_vv() for a, _ in pairs]
 init = [a.oplog.changes_in_causal_order() for a, _ in pairs]
-docs_b.append_changes(init, cid_t)
-maps_b.append_changes(init)
-tree_b.append_changes(init, cid_tr)
-ctr_b.append_changes(init)
-ml_b.append_changes(init, cid_ml)
+_ingest(docs_b, init, cid_t)
+_ingest(maps_b, init)
+_ingest(tree_b, init, cid_tr)
+_ingest(ctr_b, init)
+_ingest(ml_b, init, cid_ml)
 
 KEYS = ["k1", "k2", "k3"]
 for epoch in range(EPOCHS):
@@ -122,23 +165,28 @@ for epoch in range(EPOCHS):
     for i, (a, _) in enumerate(pairs):
         ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
         marks[i] = a.oplog_vv()
-    docs_b.append_changes(ups, cid_t)
-    maps_b.append_changes(ups)
-    tree_b.append_changes(ups, cid_tr)
-    ctr_b.append_changes(ups)
-    ml_b.append_changes(ups, cid_ml)
+    _ingest(docs_b, ups, cid_t)
+    _ingest(maps_b, ups)
+    _ingest(tree_b, ups, cid_tr)
+    _ingest(ctr_b, ups)
+    _ingest(ml_b, ups, cid_ml)
 
     if epoch % 2 == 1:
         # compaction epochs: every pair is fully synced above, so all
         # ingested epochs are stable — the oracle gates below re-check
         # every family after reclamation (text/richtext through anchors,
         # tree child order, movable slot remaps)
-        gc = (
-            docs_b.compact([docs_b.epoch] * docs_b.d)
-            + tree_b.compact([tree_b.epoch] * tree_b.d)
-            + ml_b.compact([ml_b.epoch] * ml_b.d)
-        )
+        gc = 0
+        for b in (docs_b, tree_b, ml_b):
+            db = _batch(b)
+            gc += db.compact([db.epoch] * db.d)
         print(f"  epoch {epoch}: compaction reclaimed {gc} rows")
+
+    if DURABLE and epoch % 3 == 2:
+        # checkpoint ladder + WAL rotation/prune + journal trim
+        for b in (docs_b, maps_b, tree_b, ctr_b, ml_b):
+            b.checkpoint()
+        print(f"  epoch {epoch}: checkpointed all five families")
 
     texts = docs_b.texts()
     segs = docs_b.richtexts()
@@ -164,5 +212,49 @@ for epoch in range(EPOCHS):
         assert cvals[i].get(c.id, 0.0) == c.get_value(), f"counter epoch {epoch} doc {i}"
         assert mls[i] == a.get_movable_list("ml").get_value(), f"mlist epoch {epoch} doc {i}"
     print(f"epoch {epoch}: all 5 resident families match host oracles ({time.time()-t0:.0f}s)")
+
+if DURABLE:
+    # crash-recovery gate: reopen every family from its durable dir
+    # (newest checkpoint + bounded WAL replay) and re-verify all five
+    # families byte-for-byte against the host oracles
+    from loro_tpu.persist import recover_server
+
+    for b in (docs_b, maps_b, tree_b, ctr_b, ml_b):
+        b.close()
+    rec = {
+        fam: recover_server(os.path.join(_soak_dir, fam), mesh=mesh)
+        for fam in ("text", "map", "tree", "counter", "movable")
+    }
+    for fam, srv in rec.items():
+        r = srv.last_recovery
+        print(f"  recovered {fam}: ckpt epoch {r.checkpoint_epoch}, "
+              f"{r.rounds_replayed} rounds replayed")
+    texts = rec["text"].texts()
+    segs = rec["text"].richtexts()
+    mvals = rec["map"].root_value_maps("m")
+    parents = rec["tree"].parent_maps()
+    kids = rec["tree"].children_maps()
+    cvals = rec["counter"].value_maps()
+    mls = rec["movable"].value_lists()
+    for i, (a, _) in enumerate(pairs):
+        t = a.get_text("t")
+        assert texts[i] == t.to_string(), f"recovered text doc {i}"
+        assert segs[i] == t.get_richtext_value(), f"recovered richtext doc {i}"
+        assert mvals[i] == a.get_map("m").get_value(), f"recovered map doc {i}"
+        tr = a.get_tree("tr")
+        assert parents[i] == {x: tr.parent(x) for x in tr.nodes()}, f"recovered tree doc {i}"
+        host_kids = {}
+        for x in [None] + tr.nodes():
+            ch = tr.children(x)
+            if ch:
+                host_kids[x] = ch
+        assert kids[i] == host_kids, f"recovered children doc {i}"
+        c = a.get_counter("c")
+        assert cvals[i].get(c.id, 0.0) == c.get_value(), f"recovered counter doc {i}"
+        assert mls[i] == a.get_movable_list("ml").get_value(), f"recovered mlist doc {i}"
+    for srv in rec.values():
+        srv.close()
+    shutil.rmtree(_soak_dir, ignore_errors=True)
+    print("durable recovery: all 5 families match host oracles after reopen")
 
 print(f"RESIDENT SOAK CLEAN: {N} docs x {EPOCHS} epochs in {time.time()-t0:.0f}s")

@@ -1,0 +1,390 @@
+"""loro_tpu.persist unit tests: WAL framing + torn-tail tolerance,
+checkpoint ladder retention + corruption fallback, mirror-anchor
+round-trips, fault sites, and the inspect CLI.
+
+Corruption sweeps follow the test_codec_harden.py contract: every
+truncation/bit-flip ends in a clean (possibly shortened) replay or a
+typed CodecDecodeError/DecodeError — never untyped garbage, never a
+hang."""
+import io
+import os
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.errors import CodecDecodeError, DecodeError, PersistError
+from loro_tpu.persist import (
+    CheckpointManager,
+    DurableLog,
+    MirrorAnchor,
+    WalMeta,
+    WriteAheadLog,
+)
+from loro_tpu.persist.wal import R_ROUND
+from loro_tpu.resilience import faultinject
+
+
+def _mk_wal(tmp_path, name="w", **kw):
+    return WriteAheadLog(str(tmp_path / name), **kw)
+
+
+def _rounds(wal):
+    return [(r.epoch, r.cid, r.updates) for r in wal.records()
+            if r.rtype == R_ROUND]
+
+
+def _payload(i, n=40):
+    return bytes((i + j) % 251 for j in range(n))
+
+
+class TestWalRoundTrip:
+    def test_append_replay(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        wal.write_meta(WalMeta("text", 2, {"capacity": 4096}))
+        wal.append_round(1, None, [_payload(1), None])
+        wal.append_round(2, None, [None, _payload(2)])
+        wal.close()
+        back = _mk_wal(tmp_path)
+        assert back.meta.family == "text"
+        assert back.meta.n_docs == 2
+        assert back.meta.caps == {"capacity": 4096}
+        got = _rounds(back)
+        assert got == [
+            (1, None, [_payload(1), None]),
+            (2, None, [None, _payload(2)]),
+        ]
+
+    def test_cid_round_trip(self, tmp_path):
+        d = LoroDoc(peer=9)
+        d.get_text("t").insert(0, "x")
+        d.commit()
+        root = d.get_text("t").id
+        sub = d.get_map("m").id  # root too; make a normal cid via tree
+        tr = d.get_tree("tr")
+        node = tr.create()
+        d.commit()
+        wal = _mk_wal(tmp_path)
+        wal.append_round(1, root, [_payload(0)])
+        wal.append_round(2, sub, [_payload(1)])
+        wal.append_round(3, tr.id, [_payload(2)])
+        wal.close()
+        got = _rounds(_mk_wal(tmp_path))
+        assert [g[1] for g in got] == [root, sub, tr.id]
+
+    def test_rotation_and_prune(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        wal.write_meta(WalMeta("text", 1))
+        wal.append_round(1, None, [_payload(1)])
+        wal.append_round(2, None, [_payload(2)])
+        wal.rotate()
+        wal.append_round(3, None, [_payload(3)])
+        assert len(wal.segments()) == 2
+        # prune segments fully covered by epoch 2: segment 1 goes, the
+        # active segment stays
+        assert wal.prune_below(2) == 1
+        assert [e for e, _, _ in wal.rounds_after(0)] == [3]
+        wal.close()
+        # the surviving segment re-carries the meta record (pruning a
+        # prefix never loses construction caps)
+        back = _mk_wal(tmp_path)
+        assert back.meta is not None and back.meta.family == "text"
+
+    def test_fresh_dir_has_one_segment(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        assert len(wal.segments()) == 1
+        assert _rounds(wal) == []
+        wal.close()
+
+
+class TestWalTornTail:
+    def _write_three(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        wal.write_meta(WalMeta("text", 1))
+        for e in (1, 2, 3):
+            wal.append_round(e, None, [_payload(e)])
+        wal.close()
+        (seg,) = [s for s in wal.segments()]
+        return seg.path
+
+    @pytest.mark.parametrize("cut", [1, 3, 7, 11, 25])
+    def test_truncated_tail_recovers_prefix(self, tmp_path, cut):
+        path = self._write_three(tmp_path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - cut)
+        back = _mk_wal(tmp_path)
+        got = [e for e, _, _ in _rounds(back)]
+        # the torn record (3) is gone; earlier rounds survive intact
+        assert got in ([1, 2], [1, 2, 3][: len(got)])
+        assert got[: len(got)] == [1, 2, 3][: len(got)]
+        back.close()
+        # reopen truncated the tail: appending continues cleanly
+        back2 = _mk_wal(tmp_path)
+        back2.append_round(9, None, [_payload(9)])
+        assert [e for e, _, _ in _rounds(back2)][-1] == 9
+        back2.close()
+
+    def test_bitflip_in_tail_segment_truncates(self, tmp_path):
+        path = self._write_three(tmp_path)
+        size = os.path.getsize(path)
+        at = size - 20  # inside the last record
+        with open(path, "r+b") as f:
+            f.seek(at)
+            b = f.read(1)
+            f.seek(at)
+            f.write(bytes([b[0] ^ 0x5A]))
+        back = _mk_wal(tmp_path)
+        got = [e for e, _, _ in _rounds(back)]
+        assert got == [1, 2]  # flipped record dropped as a torn tail
+        back.close()
+
+    def test_bitflip_in_old_segment_is_typed(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        wal.write_meta(WalMeta("text", 1))
+        wal.append_round(1, None, [_payload(1)])
+        wal.rotate()
+        wal.append_round(2, None, [_payload(2)])
+        wal.close()
+        seg1 = wal.segments()[0].path
+        sz = os.path.getsize(seg1)
+        with open(seg1, "r+b") as f:
+            f.seek(sz - 10)
+            b = f.read(1)
+            f.seek(sz - 10)
+            f.write(bytes([b[0] ^ 0xFF]))
+        # corruption in a NON-tail segment is not a torn write: typed
+        with pytest.raises(CodecDecodeError):
+            _mk_wal(tmp_path)
+
+    def test_headerless_last_segment_dropped(self, tmp_path):
+        """Crash between segment creation and the header write: a
+        <5-byte LAST segment held nothing durable and is dropped on
+        reopen; earlier segments keep replaying."""
+        wal = _mk_wal(tmp_path)
+        wal.write_meta(WalMeta("text", 1))
+        wal.append_round(1, None, [_payload(1)])
+        wal.rotate()
+        wal.close()
+        last = wal.segments()[-1].path
+        with open(last, "r+b") as f:
+            f.truncate(3)  # torn mid-header
+        back = _mk_wal(tmp_path)
+        assert [e for e, _, _ in _rounds(back)] == [1]
+        back.append_round(2, None, [_payload(2)])
+        assert [e for e, _, _ in _rounds(back)] == [1, 2]
+        back.close()
+
+    def test_garbage_header_is_typed(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        wal.close()
+        (seg,) = wal.segments()
+        with open(seg.path, "wb") as f:
+            f.write(b"not a segment at all")
+        with pytest.raises(CodecDecodeError):
+            _mk_wal(tmp_path)
+
+
+@pytest.mark.faultinject
+class TestWalFaultSites:
+    def test_wal_write_raise_is_typed(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        faultinject.inject("wal_write", exc=PersistError("disk gone"), times=1)
+        try:
+            with pytest.raises(PersistError):
+                wal.append_round(1, None, [_payload(1)])
+        finally:
+            faultinject.clear()
+        # fault exhausted: the next append lands
+        wal.append_round(1, None, [_payload(1)])
+        assert [e for e, _, _ in _rounds(wal)] == [1]
+        wal.close()
+
+    def test_wal_torn_tail_mangle_truncates_on_reopen(self, tmp_path):
+        wal = _mk_wal(tmp_path)
+        wal.append_round(1, None, [_payload(1)])
+        faultinject.inject("wal_torn_tail", action="truncate", keep_bytes=6,
+                           times=1)
+        try:
+            wal.append_round(2, None, [_payload(2)])  # torn on disk
+        finally:
+            faultinject.clear()
+        wal.close()
+        back = _mk_wal(tmp_path)
+        assert [e for e, _, _ in _rounds(back)] == [1]
+        back.close()
+
+
+class TestCheckpointLadder:
+    def test_save_load_round_trip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        name = mgr.save(5, b"blob five")
+        (info,) = mgr.list()
+        assert info.name == name and info.epoch == 5
+        assert mgr.load(info) == b"blob five"
+
+    def test_corrupt_newest_falls_back_down_ladder(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, b"one")
+        mgr.save(2, b"two")
+        mgr.save(3, b"three")
+        newest = mgr.list()[0]
+        with open(newest.path, "r+b") as f:
+            f.seek(os.path.getsize(newest.path) - 2)
+            f.write(b"\xff\xff")
+        with pytest.raises(DecodeError):
+            mgr.load(newest)
+        info, blob = mgr.load_newest()
+        assert info.epoch == 2 and blob == b"two"
+
+    def test_all_rungs_corrupt(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, b"one")
+        for info in mgr.list():
+            with open(info.path, "wb") as f:
+                f.write(b"garbage")
+        assert mgr.load_newest() is None
+
+    def test_truncated_rung_is_typed(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(4, b"x" * 100)
+        (info,) = mgr.list()
+        for keep in (0, 3, 8, 40):
+            with open(info.path, "rb") as f:
+                data = f.read()
+            with open(info.path, "wb") as f:
+                f.write(data[:keep])
+            with pytest.raises(DecodeError):
+                mgr.load(mgr.list()[0])
+            with open(info.path, "wb") as f:
+                f.write(data)  # restore for the next cut
+
+    def test_retention_keeps_recent_and_thins_old(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_recent=3, keep_total=6)
+        for e in range(1, 21):
+            mgr.save(e, b"blob %d" % e)
+        rungs = mgr.list()
+        assert len(rungs) <= 6
+        # the newest three are always present
+        assert [c.epoch for c in rungs[:3]] == [20, 19, 18]
+        # older rungs are geometrically spaced (strictly growing gaps)
+        older = [c.epoch for c in rungs[3:]]
+        assert older == sorted(older, reverse=True)
+
+    @pytest.mark.faultinject
+    def test_ckpt_corrupt_fault_forces_fallback(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, b"good")
+        faultinject.inject("ckpt_corrupt", action="bitflip", flip_at=30,
+                           times=1)
+        try:
+            mgr.save(2, b"bad blob")
+        finally:
+            faultinject.clear()
+        info, blob = mgr.load_newest()
+        assert info.epoch == 1 and blob == b"good"
+
+
+class TestMirrorAnchor:
+    def _round(self, d, mark=None):
+        from loro_tpu.codec.binary import encode_changes
+
+        chs = (d.oplog.changes_in_causal_order() if mark is None
+               else d.oplog.changes_between(mark, d.oplog_vv()))
+        return [bytes(encode_changes(list(chs)))]
+
+    def test_advance_seed_and_wire_round_trip(self, tmp_path):
+        d = LoroDoc(peer=3)
+        d.get_text("t").insert(0, "anchor base")
+        d.commit()
+        cid = d.get_text("t").id
+        a = MirrorAnchor("text", 1)
+        a.advance([(1, self._round(d), cid)], cid)
+        assert a.epoch == 1
+        mark = d.oplog_vv()
+        d.get_text("t").insert(6, "XYZ ")
+        d.commit()
+        a2 = MirrorAnchor.decode(a.encode())
+        assert a2.epoch == 1 and a2.cid == cid
+        eng = a2.seed_engine()
+        eng.apply(self._round(d, mark), cid)
+        assert eng.texts()[0] == d.get_text("t").to_string()
+
+    def test_anchor_is_shallow(self):
+        """The anchor doc blobs carry state, not history: re-exported
+        blobs stay state-sized as rounds accumulate."""
+        d = LoroDoc(peer=4)
+        d.get_text("t").insert(0, "x" * 64)
+        d.commit()
+        cid = d.get_text("t").id
+        from loro_tpu import VersionVector
+
+        a = MirrorAnchor("text", 1)
+        mark = VersionVector()
+        sizes = []
+        for e in range(1, 9):
+            chs = d.oplog.changes_between(mark, d.oplog_vv())
+            mark = d.oplog_vv()
+            from loro_tpu.codec.binary import encode_changes
+
+            a.advance([(e, [bytes(encode_changes(list(chs)))], cid)], cid)
+            sizes.append(len(a.doc_blobs[0]))
+            # churn: delete + reinsert the same span (state size stays
+            # flat, history would grow)
+            d.get_text("t").delete(0, 8)
+            d.get_text("t").insert(0, "y" * 8)
+            d.commit()
+        assert sizes[-1] < sizes[0] * 3
+
+    def test_malformed_anchor_typed(self):
+        with pytest.raises(DecodeError):
+            MirrorAnchor.decode(b"\x01garbage")
+        with pytest.raises(DecodeError):
+            MirrorAnchor.decode(b"\xff")
+
+
+class TestDurableLog:
+    def test_checkpoint_rotates_and_prunes(self, tmp_path):
+        log = DurableLog(str(tmp_path / "d"))
+        log.ensure_meta(WalMeta("text", 1, {"capacity": 64}))
+        log.append_round(1, None, [_payload(1)])
+        log.append_round(2, None, [_payload(2)])
+        log.record_checkpoint(2, b"ckpt at two")
+        log.append_round(3, None, [_payload(3)])
+        # pre-checkpoint segments are pruned; the tail survives
+        assert [e for e, _, _ in log.wal.rounds_after(2)] == [3]
+        assert [e for e, _, _ in log.wal.rounds_after(0)] == [3]
+        (info,) = log.checkpoints.list()
+        assert info.epoch == 2
+        assert log.checkpoints.load(info) == b"ckpt at two"
+        log.close()
+
+
+class TestInspectCli:
+    def test_one_screen_dump(self, tmp_path):
+        from loro_tpu.persist.inspect import inspect_dir, main
+
+        log = DurableLog(str(tmp_path / "d"))
+        log.ensure_meta(WalMeta("text", 2, {"capacity": 128}))
+        log.append_round(1, None, [_payload(1), None])
+        log.record_checkpoint(1, b"blob one")
+        log.append_round(2, None, [None, _payload(2)])
+        log.close()
+        buf = io.StringIO()
+        rc = inspect_dir(str(tmp_path / "d"), out=buf)
+        text = buf.getvalue()
+        assert rc == 0
+        assert "family=text" in text
+        assert "rounds journaled: 1" in text  # post-checkpoint tail
+        assert "epoch 1" in text and "crc ok" in text
+        assert "replay 1 round(s)" in text
+        # corrupt the rung: rc flips, fallback is reported
+        (info,) = log.checkpoints.list()
+        with open(info.path, "r+b") as f:
+            f.seek(os.path.getsize(info.path) - 1)
+            f.write(b"\x00")
+        buf = io.StringIO()
+        assert inspect_dir(str(tmp_path / "d"), out=buf) == 1
+        assert "CORRUPT" in buf.getvalue()
+        # CLI arg handling
+        assert main([]) == 2
+        assert main([str(tmp_path / "nope")]) == 2

@@ -1,0 +1,374 @@
+"""Integration: durable ResidentServer + bounded-replay recovery.
+
+Acceptance (ISSUE 4): with ``durable_dir`` + auto-checkpoint, recovery
+replays only rounds-since-last-checkpoint (not rounds-since-birth);
+``restore()`` -> ``recover()`` succeeds for all five resident
+families; a SIGKILLed process (between launches, CPU mesh — never a
+TPU process, per docs/RESILIENCE.md) reopens from ``durable_dir``
+byte-for-byte against the host oracle."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import _persist_crash_child as crash
+from loro_tpu.errors import PersistError
+from loro_tpu.obs import metrics as obs
+from loro_tpu.parallel.server import ResidentServer
+from loro_tpu.persist import recover_server
+from loro_tpu.resilience import faultinject
+
+FAMILIES = crash.FAMILIES
+CAPS = crash.CAPS
+
+
+def _drive(srv, d, fam, rounds, start=1, mark=None, ckpt_at=None):
+    """Deterministic ingest rounds via the shared crash-child script."""
+    for r in range(start, start + rounds):
+        if mark is None:
+            chs = d.oplog.changes_in_causal_order()
+        else:
+            crash.apply_edit(d, fam, r)
+            chs = d.oplog.changes_between(mark, d.oplog_vv())
+        mark = d.oplog_vv()
+        srv.ingest([chs], crash.container_id(fam, d))
+        if ckpt_at is not None and r == ckpt_at:
+            srv.checkpoint()
+    return mark
+
+
+class TestBoundedReplay:
+    def test_recovery_replays_only_since_checkpoint(self, tmp_path):
+        """THE acceptance gate: 6 rounds, checkpoint at 4 -> recovery
+        restores the checkpoint and replays exactly 2 rounds."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+        _drive(srv, d, fam, rounds=6, ckpt_at=4)
+        want = crash.read_oracle(d, fam)
+        epoch = srv.epoch
+        srv.close()
+        n0 = obs.counter("persist.recovery_rounds_replayed_total").get()
+        back = recover_server(str(tmp_path))
+        rep = back.last_recovery
+        assert rep.checkpoint_epoch > 0 and not rep.cold
+        assert rep.rounds_replayed == 2  # NOT 6: bounded by the checkpoint
+        assert obs.counter(
+            "persist.recovery_rounds_replayed_total").get() == n0 + 2
+        assert back.epoch == epoch  # visible epochs continue seamlessly
+        assert crash.read_server(back, fam) == want
+        back.close()
+
+    def test_recovered_server_keeps_ingesting_and_checkpointing(self, tmp_path):
+        fam = "movable"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+        mark = _drive(srv, d, fam, rounds=3, ckpt_at=2)
+        srv.close()
+        back = recover_server(str(tmp_path))
+        mark = _drive(back, d, fam, rounds=3, start=4, mark=mark, ckpt_at=5)
+        assert crash.read_server(back, fam) == crash.read_oracle(d, fam)
+        back.close()
+        # and a second recovery after the second checkpoint is bounded
+        again = recover_server(str(tmp_path))
+        assert again.last_recovery.rounds_replayed <= 2
+        assert crash.read_server(again, fam) == crash.read_oracle(d, fam)
+        again.close()
+
+    def test_corrupt_newest_checkpoint_falls_down_ladder(self, tmp_path):
+        fam = "map"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+        # two explicit checkpoints -> two rungs above the auto one
+        _drive(srv, d, fam, rounds=3, ckpt_at=2)
+        srv.checkpoint()
+        want = crash.read_oracle(d, fam)
+        newest = srv._durable.checkpoints.list()[0]
+        srv.close()
+        with open(newest.path, "r+b") as f:
+            f.seek(os.path.getsize(newest.path) - 1)
+            f.write(b"\xee")
+        back = recover_server(str(tmp_path))
+        rep = back.last_recovery
+        assert rep.checkpoints_skipped == 1  # fell past the corrupt rung
+        assert rep.checkpoint_epoch > 0 and not rep.cold
+        assert crash.read_server(back, fam) == want
+        back.close()
+
+    def test_every_rung_corrupt_cold_replays_from_meta(self, tmp_path):
+        fam = "counter"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+        _drive(srv, d, fam, rounds=3)  # no checkpoint: WAL has all rounds
+        want = crash.read_oracle(d, fam)
+        for info in srv._durable.checkpoints.list():
+            with open(info.path, "wb") as f:
+                f.write(b"all gone")
+        srv.close()
+        back = recover_server(str(tmp_path))
+        assert back.last_recovery.cold
+        assert back.last_recovery.rounds_replayed == 3
+        assert crash.read_server(back, fam) == want
+        back.close()
+
+    def test_pruned_history_cold_path_refuses(self, tmp_path):
+        """Review regression: once a checkpoint has pruned round
+        segments, a cold recovery (every rung corrupt) can no longer
+        reach back to birth — it must raise a typed DecodeError, not
+        silently fabricate a truncated history."""
+        from loro_tpu.errors import DecodeError
+
+        fam = "map"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path),
+                             auto_checkpoint=False, **CAPS[fam])
+        _drive(srv, d, fam, rounds=3)
+        srv.checkpoint()  # prunes the round-bearing segments
+        for info in srv._durable.checkpoints.list():
+            with open(info.path, "wb") as f:
+                f.write(b"bitrot everywhere")
+        srv.close()
+        with pytest.raises(DecodeError, match="pruned"):
+            recover_server(str(tmp_path))
+
+    def test_fresh_server_over_existing_log_refuses(self, tmp_path):
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+        _drive(srv, d, fam, rounds=1)
+        srv.close()
+        with pytest.raises(PersistError, match="recover_server"):
+            ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+
+    @pytest.mark.faultinject
+    def test_wal_append_failure_fail_stops(self, tmp_path):
+        """Review regression: a failed durable append means served
+        state diverged from the WAL — the server must detach the log
+        with a typed PersistError (fail-stop), keep its in-memory
+        journal consistent with the device, and never journal on top
+        of the gap."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+        mark = _drive(srv, d, fam, rounds=1)
+        crash.apply_edit(d, fam, 2)
+        chs = d.oplog.changes_between(mark, d.oplog_vv())
+        faultinject.inject("wal_write", exc=OSError("disk gone"), times=1)
+        try:
+            with pytest.raises(PersistError, match="DETACHED"):
+                srv.ingest([chs], crash.container_id(fam, d))
+        finally:
+            faultinject.clear()
+        # the round IS on the device and in the in-memory journal
+        assert crash.read_server(srv, fam) == crash.read_oracle(d, fam)
+        assert len(srv._history) == 2
+        assert srv._durable is None  # journaling detached, not resumed
+        # the WAL on disk stops BEFORE the failed round: recovery
+        # honestly reflects what was journaled
+        back = recover_server(str(tmp_path))
+        assert back.epoch == 1
+        back.close()
+
+    def test_meta_mismatch_refused(self, tmp_path):
+        """Review regression: a server closed before any ingest leaves
+        a rounds-free, meta-bearing WAL; a DIFFERENT server shape over
+        the same dir must be refused, not silently inherit the stale
+        meta (cold recovery would rebuild the wrong server from it)."""
+        srv = ResidentServer("text", 4, durable_dir=str(tmp_path),
+                             capacity=1 << 10)
+        srv.close()
+        with pytest.raises(PersistError, match="meta mismatch"):
+            ResidentServer("map", 8, durable_dir=str(tmp_path),
+                           slot_capacity=64)
+        # the SAME shape reopens cleanly (idempotent create)
+        again = ResidentServer("text", 4, durable_dir=str(tmp_path),
+                               capacity=1 << 10)
+        again.close()
+
+    def test_open_server_ladder_only_dir_recovers(self, tmp_path):
+        """Review regression: a dir whose wal/ was lost but whose
+        checkpoint rungs survive must route open_server to recovery
+        (previously it dead-ended in a circular PersistError)."""
+        import shutil
+
+        from loro_tpu.persist import open_server
+
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+        _drive(srv, d, fam, rounds=2, ckpt_at=2)
+        want = crash.read_oracle(d, fam)
+        srv.close()
+        shutil.rmtree(os.path.join(str(tmp_path), "wal"))
+        back = open_server(str(tmp_path))
+        assert back.last_recovery.rounds_replayed == 0  # ladder only
+        assert crash.read_server(back, fam) == want
+        # the fresh WAL re-seeded its meta from the v3 caps: a later
+        # cold recovery of this directory stays possible
+        assert back._durable.meta is not None
+        assert back._durable.meta.family == fam
+        back.close()
+
+    def test_fresh_server_over_checkpointed_log_refuses(self, tmp_path):
+        """Review regression: a checkpoint prunes every round-bearing
+        segment, so a rounds-only in-use check let a fresh server
+        silently reuse the directory — and recovery then restored the
+        STALE checkpoint, dropping the new server's rounds."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path),
+                             auto_checkpoint=False, **CAPS[fam])
+        _drive(srv, d, fam, rounds=3)
+        srv.checkpoint()  # prunes all round segments; rungs remain
+        srv.close()
+        with pytest.raises(PersistError, match="checkpoints"):
+            ResidentServer(fam, 1, durable_dir=str(tmp_path), **CAPS[fam])
+
+
+@pytest.mark.faultinject
+class TestJournalBound:
+    def test_journal_stays_o_rounds_since_checkpoint(self):
+        """Satellite: _record_round grew forever; checkpoint() now
+        drops journal rounds at/under its epoch, with or without
+        durable_dir."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, **CAPS[fam])
+        mark = _drive(srv, d, fam, rounds=4)
+        assert len(srv._history) == 4
+        srv.checkpoint()
+        assert len(srv._history) == 0  # folded into the mirror anchor
+        mark = _drive(srv, d, fam, rounds=3, start=5, mark=mark)
+        assert len(srv._history) == 3  # O(rounds since checkpoint)
+        srv.checkpoint()
+        assert len(srv._history) == 0
+        # ...and the degradation oracle still has full coverage via the
+        # anchor (exercised in test_restore_recover_all_families below)
+
+    def test_no_anchor_checkpoint_keeps_journal_for_mirror(self):
+        """Review regression: with mirror_anchor=False the host mirror
+        still needs the journal from birth — checkpoint() must NOT
+        trim it, and a post-checkpoint degrade must serve the full
+        oracle (not a silently empty mirror)."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, mirror_anchor=False, **CAPS[fam])
+        mark = _drive(srv, d, fam, rounds=3)
+        srv.checkpoint()
+        assert len(srv._history) == 3  # NOT trimmed: no anchor holds it
+        crash.apply_edit(d, fam, 4)
+        chs = d.oplog.changes_between(mark, d.oplog_vv())
+        faultinject.inject(
+            "launch", exc=RuntimeError("INTERNAL: injected death"), times=1
+        )
+        try:
+            srv.ingest([chs], crash.container_id(fam, d))
+        finally:
+            faultinject.clear()
+        assert srv.degraded
+        assert crash.read_server(srv, fam) == crash.read_oracle(d, fam)
+        # bounded recover() still works (checkpoint batch + tail)
+        assert srv.recover()
+        assert crash.read_server(srv, fam) == crash.read_oracle(d, fam)
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_degrade_after_trim_matches_oracle(self, family):
+        """The trimmed journal + shallow anchor must serve a degraded
+        epoch byte-for-byte (the anchor IS the missing history)."""
+        d = crash.make_doc(family)
+        srv = ResidentServer(family, 1, **CAPS[family])
+        mark = _drive(srv, d, family, rounds=3, ckpt_at=3)
+        assert len(srv._history) == 0
+        crash.apply_edit(d, family, 4)
+        chs = d.oplog.changes_between(mark, d.oplog_vv())
+        faultinject.inject(
+            "launch", exc=RuntimeError("INTERNAL: injected death"), times=1
+        )
+        try:
+            srv.ingest([chs], crash.container_id(family, d))
+        finally:
+            faultinject.clear()
+        assert srv.degraded
+        assert crash.read_server(srv, family) == crash.read_oracle(d, family)
+        assert srv.recover()
+        assert crash.read_server(srv, family) == crash.read_oracle(d, family)
+
+
+@pytest.mark.faultinject
+class TestRestoreRecover:
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_restore_recover_all_families(self, family):
+        """Acceptance: restore() -> degrade -> recover() succeeds for
+        every family (v3 checkpoints carry caps + the mirror anchor)."""
+        d = crash.make_doc(family)
+        srv = ResidentServer(family, 1, **CAPS[family])
+        mark = _drive(srv, d, family, rounds=2)
+        blob = srv.checkpoint()
+        back = ResidentServer.restore(blob)
+        assert crash.read_server(back, family) == crash.read_oracle(d, family)
+        # a restored, never-degraded server recovers trivially
+        assert back.recover()
+        # degrade it with a post-restore round, then recover in place
+        crash.apply_edit(d, family, 3)
+        chs = d.oplog.changes_between(mark, d.oplog_vv())
+        faultinject.inject(
+            "launch", exc=RuntimeError("INTERNAL: injected death"), times=1
+        )
+        try:
+            back.ingest([chs], crash.container_id(family, d))
+        finally:
+            faultinject.clear()
+        assert back.degraded
+        assert crash.read_server(back, family) == crash.read_oracle(d, family)
+        assert back.recover()
+        assert not back.degraded
+        assert crash.read_server(back, family) == crash.read_oracle(d, family)
+
+
+@pytest.mark.slow
+class TestCrashRecovery:
+    def test_sigkill_mid_stream_recovers_all_families(self, tmp_path):
+        """Satellite: SIGKILL the driver subprocess (between launches,
+        CPU mesh) after ROUNDS rounds + a checkpoint at CKPT_AT, reopen
+        every family from its durable_dir and verify byte-for-byte
+        against a regenerated host oracle."""
+        ROUNDS, CKPT_AT = 4, 2
+        child = os.path.join(os.path.dirname(__file__), "_persist_crash_child.py")
+        proc = subprocess.Popen(
+            [sys.executable, child, str(tmp_path), str(ROUNDS), str(CKPT_AT)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        ready = os.path.join(str(tmp_path), "READY")
+        deadline = time.time() + 180
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"crash child exited early: {proc.stderr.read().decode()[-2000:]}"
+                )
+            if time.time() > deadline:
+                proc.kill()
+                raise AssertionError("crash child never became READY")
+            time.sleep(0.2)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        for fam in FAMILIES:
+            back = recover_server(os.path.join(str(tmp_path), fam))
+            rep = back.last_recovery
+            assert rep.checkpoint_epoch > 0, fam  # bounded, not cold
+            # regenerate the oracle: same deterministic edit stream
+            d = crash.make_doc(fam)
+            for r in range(2, ROUNDS + 1):
+                crash.apply_edit(d, fam, r)
+            assert crash.read_server(back, fam) == crash.read_oracle(d, fam), fam
+            # the recovered server is live: one more round lands
+            mark = d.oplog_vv()
+            crash.apply_edit(d, fam, ROUNDS + 1)
+            back.ingest(
+                [d.oplog.changes_between(mark, d.oplog_vv())],
+                crash.container_id(fam, d),
+            )
+            assert crash.read_server(back, fam) == crash.read_oracle(d, fam), fam
+            back.close()

@@ -391,13 +391,40 @@ class TestResidentDegradationAndRecovery:
         restored = ResidentServer.restore(srv.last_checkpoint)
         assert restored.texts() == [""]  # pre-first-epoch state
 
-    def test_restored_server_failure_is_typed(self, fake_sleep_supervisor):
-        """A restore()d server has no complete journal: a device
-        failure surfaces as a typed DeviceFailure (documented), never
-        a wrong host mirror."""
+    def test_restored_server_degrades_via_anchor(self, fake_sleep_supervisor):
+        """A v3 checkpoint embeds the shallow-snapshot mirror anchor
+        (persist.MirrorAnchor), so a restore()d server degrades to a
+        CORRECT host mirror — anchor state + post-restore journal —
+        and recover()s in place (the checkpoint also carries the
+        construction caps)."""
         a, _ = _mk_pair("text", i=21)
         cid = a.get_text("t").id
         srv = ResidentServer("text", 1, capacity=1 << 12)
+        srv.ingest([a.oplog.changes_in_causal_order()], cid)
+        mark = a.oplog_vv()
+        srv2 = ResidentServer.restore(srv.checkpoint())
+        _edit("text", a, salt=7)
+        a.commit()
+        _fatal(times=1)
+        try:
+            srv2.ingest([a.oplog.changes_between(mark, a.oplog_vv())], cid)
+        finally:
+            faultinject.clear()
+        assert srv2.degraded
+        assert srv2.texts()[0] == a.get_text("t").to_string()
+        # bounded recover(): checkpoint batch state + journal tail
+        assert srv2.recover()
+        assert not srv2.degraded
+        assert srv2.texts()[0] == a.get_text("t").to_string()
+
+    def test_restored_server_without_anchor_is_typed(self,
+                                                     fake_sleep_supervisor):
+        """host_fallback=False servers embed no anchor: their restored
+        form keeps the old contract — a device failure surfaces as a
+        typed DeviceFailure, never a wrong host mirror."""
+        a, _ = _mk_pair("text", i=22)
+        cid = a.get_text("t").id
+        srv = ResidentServer("text", 1, capacity=1 << 12, host_fallback=False)
         srv.ingest([a.oplog.changes_in_causal_order()], cid)
         srv2 = ResidentServer.restore(srv.checkpoint())
         _edit("text", a, salt=7)

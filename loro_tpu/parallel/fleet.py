@@ -16,6 +16,7 @@ from __future__ import annotations
 import functools
 import os
 import struct
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -896,6 +897,13 @@ class DeviceDocBatch:
         )
         self.key_hi = z(np.uint32, 0xFFFFFFFF)
         self.key_lo = z(np.uint32, 0xFFFFFFFF)
+        # coalesced-ingest accumulator (None = every append launches its
+        # own device scatter; see begin_coalesce)
+        self._defer: Optional[_DeferredSeqDevice] = None
+        # serializes device-array writers: a detached commit (pipeline
+        # commit thread) vs a grow() triggered by the NEXT group's host
+        # staging — the only two that can ever overlap
+        self._dev_lock = threading.RLock()
 
     # column fill values shared by __init__, grow() and compact() —
     # one table so the three cannot drift
@@ -903,6 +911,198 @@ class DeviceDocBatch:
         parent=-1, side=0, peer_hi=0, peer_lo=0, counter=0,
         deleted=True, content=-1, valid=False,
     )
+
+    # -- round coalescing ----------------------------------------------
+    # Contract (docs/RESILIENCE.md "round coalescing"): between
+    # begin_coalesce() and flush_coalesce(), every append commits its
+    # HOST state per round exactly as before — epoch clock, row/tomb
+    # epoch stamps, order engines, id maps, counts — so the final state
+    # is byte-for-byte what the serial path produces; only the DEVICE
+    # block scatters/tombstone launches accumulate, and flush ships
+    # them as ONE scatter (+ one tombstone launch) for the whole group.
+    # Reads between begin and flush would see stale device columns:
+    # the caller (ResidentServer.ingest_coalesced) never reads inside a
+    # group.
+    def begin_coalesce(self) -> None:
+        if self._defer is not None:
+            raise RuntimeError("coalesce group already open")
+        self._defer = _DeferredSeqDevice(self.counts.copy())
+
+    def detach_coalesce(self):
+        """Close the group and hand back its pending device work for a
+        later ``commit_detached`` — the two-phase form the pipeline
+        executor uses to overlap group N's device commit with group
+        N+1's host staging.  Everything the commit needs from host
+        state is SNAPSHOTTED here (renumbered key rows, group-start
+        offsets), so the commit thread never reads order engines / id
+        maps / epoch arrays the next group is already mutating."""
+        d, self._defer = self._defer, None
+        if d is not None and d.renumbered:
+            d.key_snap = {
+                di: np.asarray(self.order[di].all_keys(), np.int64).copy()
+                for di in sorted(d.renumbered)
+            }
+        return d
+
+    def commit_detached(self, d) -> None:
+        """Ship a detached group's blocks as one merged scatter + one
+        tombstone launch.  Per-doc row segments across rounds are
+        contiguous (appends only ever extend the tail), so the merged
+        block is each doc's concatenated segments at its group-start
+        offset.  Never grows: a grow here would race the next group's
+        host staging (epoch arrays repack) — a merged window that
+        outgrew capacity by bucket rounding falls back to per-round
+        scatters, each already validated at stage time."""
+        from ..ops.fugue_batch import pad_bucket
+
+        if d is None:
+            return
+        with self._dev_lock:
+            if d.rounds:
+                total = np.zeros(self.d, np.int64)
+                for _blk, _kh, _kl, n_new in d.rounds:
+                    total += np.asarray(n_new, np.int64)
+                width = pad_bucket(int(total.max()), floor=16)
+                need = max(
+                    (int(d.base0[di]) + width
+                     for di in range(self.d) if total[di]),
+                    default=0,
+                )
+                if need > self.cap:
+                    off = d.base0.astype(np.int64).copy()
+                    for blk, kh, kl, n_new in d.rounds:
+                        self._device_commit_block(
+                            blk, kh, kl, off.astype(np.int32), n_new,
+                            renumbered=(),
+                        )
+                        off += np.asarray(n_new, np.int64)
+                    if d.renumbered:
+                        self._upload_renumbered_keys(
+                            sorted(d.renumbered), d.key_snap
+                        )
+                else:
+                    blk_shape = (self.d, width)
+                    blk = {
+                        f: np.full(blk_shape, fill,
+                                   dtype=d.rounds[0][0][f].dtype)
+                        for f, fill in self._COL_FILLS.items()
+                    }
+                    khc = np.full(blk_shape, 0xFFFFFFFF, np.uint32)
+                    klc = np.full(blk_shape, 0xFFFFFFFF, np.uint32)
+                    pos = np.zeros(self.d, np.int64)
+                    for rblk, rkh, rkl, n_new in d.rounds:
+                        for di, k in enumerate(n_new):
+                            if not k:
+                                continue
+                            p = int(pos[di])
+                            for f in blk:
+                                blk[f][di, p : p + k] = rblk[f][di, :k]
+                            khc[di, p : p + k] = rkh[di, :k]
+                            klc[di, p : p + k] = rkl[di, :k]
+                            pos[di] += k
+                    self._device_commit_block(
+                        blk, khc, klc, d.base0.astype(np.int32), total,
+                        sorted(d.renumbered), d.key_snap,
+                    )
+                obs.counter("pipeline.coalesced_rounds_total").inc(
+                    len(d.rounds), family="text" if self.as_text else "list"
+                )
+            elif d.renumbered:
+                # delete-only / no-op rounds can still renumber docs
+                self._upload_renumbered_keys(sorted(d.renumbered), d.key_snap)
+            if d.del_d:
+                self._device_mark_deleted(
+                    np.concatenate(d.del_d), np.concatenate(d.del_r)
+                )
+
+    def flush_coalesce(self) -> None:
+        """Synchronous close-and-commit of the open group."""
+        self.commit_detached(self.detach_coalesce())
+
+    def _device_commit_block(self, blk, key_blk_hi, key_blk_lo, offsets,
+                             n_new, renumbered, key_snap=None) -> None:
+        """The device tail of an append: one block scatter (+ whole-row
+        key re-uploads for renumbered docs).  Shared by the immediate
+        path and commit_detached."""
+        width = blk["valid"].shape[1]
+        obs.counter("fleet.pad_waste_rows_total").inc(
+            int(self.d * width - int(np.sum(n_new))), family="resident_seq"
+        )
+        obs.counter("fleet.device_launches_total").inc(family="resident_seq")
+        obs.unique("fleet.padded_shapes_distinct").add(
+            ("resident_seq", self.d, width, self.cap)
+        )
+        with self._dev_lock:
+            sh = doc_sharding(self.mesh)
+            blk_dev = {f: jax.device_put(v, sh) for f, v in blk.items()}
+            blk_dev["key_hi"] = jax.device_put(key_blk_hi, sh)
+            blk_dev["key_lo"] = jax.device_put(key_blk_lo, sh)
+            packed = _scatter_rows(
+                (self.cols, self.key_hi, self.key_lo),
+                blk_dev,
+                jax.device_put(
+                    np.asarray(offsets, np.int32), replicated(self.mesh)
+                ),
+            )
+            self.cols, self.key_hi, self.key_lo = packed
+            if renumbered:
+                self._upload_renumbered_keys(list(renumbered), key_snap)
+
+    def _upload_renumbered_keys(self, renumbered, key_snap=None) -> None:
+        """Renumbered docs: re-upload whole key rows in ONE jitted
+        scatter (the per-doc eager .at[di].set dispatch was ~half of
+        warm epoch time — r5 profile).  Fixed [cap]-wide rows + bucket-
+        padded doc count bound retraces; pad entries repeat doc
+        renumbered[0]'s row (idempotent writes).  ``key_snap`` (doc ->
+        key array) is the detach-time snapshot a pipelined commit uses
+        — the live engines belong to the group being staged."""
+        from ..ops.fugue_batch import pad_bucket
+
+        from .order_maintenance import split_keys
+
+        nb = pad_bucket(len(renumbered), floor=4)
+        kh_rows = np.empty((nb, self.cap), np.uint32)
+        kl_rows = np.empty((nb, self.cap), np.uint32)
+        d_idx = np.empty(nb, np.int32)
+        for i in range(nb):
+            di = renumbered[i] if i < len(renumbered) else renumbered[0]
+            d_idx[i] = di
+            if i < len(renumbered):
+                keys = (
+                    key_snap[di] if key_snap is not None
+                    else self.order[di].all_keys()
+                )
+                kh, kl = split_keys(np.asarray(keys, np.int64))
+                kh_rows[i, : len(kh)] = kh
+                kl_rows[i, : len(kl)] = kl
+                kh_rows[i, len(kh):] = 0xFFFFFFFF
+                kl_rows[i, len(kl):] = 0xFFFFFFFF
+            else:
+                kh_rows[i] = kh_rows[0]
+                kl_rows[i] = kl_rows[0]
+        with self._dev_lock:
+            self.key_hi, self.key_lo = _set_key_rows(
+                (self.key_hi, self.key_lo),
+                jnp.asarray(d_idx),
+                jnp.asarray(kh_rows),
+                jnp.asarray(kl_rows),
+            )
+
+    def _device_mark_deleted(self, d_all: np.ndarray, r_all: np.ndarray) -> None:
+        """The device tail of mark_deleted (padded tombstone scatter)."""
+        from ..ops.fugue_batch import pad_bucket
+
+        n = len(d_all)
+        k = pad_bucket(n, floor=16)
+        d_idx = np.empty(k, np.int32)
+        r_idx = np.empty(k, np.int32)
+        d_idx[:n], r_idx[:n] = d_all, r_all
+        d_idx[n:], r_idx[n:] = d_all[0], r_all[0]
+        with self._dev_lock:
+            deleted = _set_deleted(
+                self.cols.deleted, jnp.asarray(d_idx), jnp.asarray(r_idx)
+            )
+            self.cols = self.cols._replace(deleted=deleted)
 
     # ------------------------------------------------------------------
     def grow(self, new_capacity: int) -> None:
@@ -915,26 +1115,29 @@ class DeviceDocBatch:
         container/richtext/tracker.rs)."""
         if new_capacity <= self.cap:
             return
-        sh = doc_sharding(self.mesh)
-        cols = _pad_axis1(
-            {f: getattr(self.cols, f) for f in self.cols._fields},
-            new_capacity, self._COL_FILLS, sh,
-        )
-        from ..ops.fugue_batch import SeqColumnsU
+        # under the device lock: a pipelined commit in flight is
+        # scattering into the SAME buffers this repack replaces
+        with self._dev_lock:
+            sh = doc_sharding(self.mesh)
+            cols = _pad_axis1(
+                {f: getattr(self.cols, f) for f in self.cols._fields},
+                new_capacity, self._COL_FILLS, sh,
+            )
+            from ..ops.fugue_batch import SeqColumnsU
 
-        self.cols = SeqColumnsU(**cols)
-        keys = _pad_axis1(
-            {"key_hi": self.key_hi, "key_lo": self.key_lo},
-            new_capacity,
-            {"key_hi": 0xFFFFFFFF, "key_lo": 0xFFFFFFFF},
-            sh,
-        )
-        self.key_hi, self.key_lo = keys["key_hi"], keys["key_lo"]
-        for name in ("tomb_epoch", "row_epoch"):
-            ne = np.full((self.d, new_capacity), -1, np.int64)
-            ne[:, : self.cap] = getattr(self, name)
-            setattr(self, name, ne)
-        self.cap = new_capacity
+            self.cols = SeqColumnsU(**cols)
+            keys = _pad_axis1(
+                {"key_hi": self.key_hi, "key_lo": self.key_lo},
+                new_capacity,
+                {"key_hi": 0xFFFFFFFF, "key_lo": 0xFFFFFFFF},
+                sh,
+            )
+            self.key_hi, self.key_lo = keys["key_hi"], keys["key_lo"]
+            for name in ("tomb_epoch", "row_epoch"):
+                ne = np.full((self.d, new_capacity), -1, np.int64)
+                ne[:, : self.cap] = getattr(self, name)
+                setattr(self, name, ne)
+            self.cap = new_capacity
 
     def compact(
         self,
@@ -1350,13 +1553,6 @@ class DeviceDocBatch:
             obs.counter("fleet.resident_rows_total").inc(
                 sum(n_new), family="text" if self.as_text else "list"
             )
-            obs.counter("fleet.pad_waste_rows_total").inc(
-                self.d * max_new - sum(n_new), family="resident_seq"
-            )
-            obs.counter("fleet.device_launches_total").inc(family="resident_seq")
-            obs.unique("fleet.padded_shapes_distinct").add(
-                ("resident_seq", self.d, max_new, self.cap)
-            )
             blk_shape = (self.d, max_new)
             blk = {
                 "parent": np.full(blk_shape, -1, np.int32),
@@ -1442,43 +1638,16 @@ class DeviceDocBatch:
                 for di in active:
                     if _ingest_doc(di):
                         renumbered.append(di)
-            sh = doc_sharding(self.mesh)
-            blk_dev = {f: jax.device_put(v, sh) for f, v in blk.items()}
-            blk_dev["key_hi"] = jax.device_put(key_blk_hi, sh)
-            blk_dev["key_lo"] = jax.device_put(key_blk_lo, sh)
-            packed = _scatter_rows(
-                (self.cols, self.key_hi, self.key_lo),
-                blk_dev,
-                jax.device_put(offsets, replicated(self.mesh)),
-            )
-            self.cols, self.key_hi, self.key_lo = packed
-            # renumbered docs: re-upload whole key rows in ONE jitted
-            # scatter (the per-doc eager .at[di].set dispatch was ~half
-            # of warm epoch time — r5 profile).  Fixed [cap]-wide rows
-            # + bucket-padded doc count bound retraces; pad entries
-            # repeat doc renumbered[0]'s row (idempotent writes).
-            if renumbered:
-                nb = pad_bucket(len(renumbered), floor=4)
-                kh_rows = np.empty((nb, self.cap), np.uint32)
-                kl_rows = np.empty((nb, self.cap), np.uint32)
-                d_idx = np.empty(nb, np.int32)
-                for i in range(nb):
-                    di = renumbered[i] if i < len(renumbered) else renumbered[0]
-                    d_idx[i] = di
-                    if i < len(renumbered):
-                        kh, kl = split_keys(self.order[di].all_keys())
-                        kh_rows[i, : len(kh)] = kh
-                        kl_rows[i, : len(kl)] = kl
-                        kh_rows[i, len(kh):] = 0xFFFFFFFF
-                        kl_rows[i, len(kl):] = 0xFFFFFFFF
-                    else:
-                        kh_rows[i] = kh_rows[0]
-                        kl_rows[i] = kl_rows[0]
-                self.key_hi, self.key_lo = _set_key_rows(
-                    (self.key_hi, self.key_lo),
-                    jnp.asarray(d_idx),
-                    jnp.asarray(kh_rows),
-                    jnp.asarray(kl_rows),
+            if self._defer is not None:
+                # coalesced group: stash the block; flush_coalesce ships
+                # every round's segments in one merged scatter
+                self._defer.rounds.append(
+                    (blk, key_blk_hi, key_blk_lo, list(n_new))
+                )
+                self._defer.renumbered.update(renumbered)
+            else:
+                self._device_commit_block(
+                    blk, key_blk_hi, key_blk_lo, offsets, n_new, renumbered
                 )
         self.mark_deleted(del_pairs)
 
@@ -1675,15 +1844,14 @@ class DeviceDocBatch:
         # date the tombstones: compact() may reclaim them once every
         # replica has acked this epoch
         self.tomb_epoch[d_all, r_all] = self.epoch
-        k = pad_bucket(n, floor=16)
-        d_idx = np.empty(k, np.int32)
-        r_idx = np.empty(k, np.int32)
-        d_idx[:n], r_idx[:n] = d_all, r_all
-        d_idx[n:], r_idx[n:] = d_all[0], r_all[0]
-        deleted = _set_deleted(
-            self.cols.deleted, jnp.asarray(d_idx), jnp.asarray(r_idx)
-        )
-        self.cols = self.cols._replace(deleted=deleted)
+        if self._defer is not None:
+            # coalesced group: tombstones launch once at flush (after
+            # the merged row scatter, which only writes NEW rows — it
+            # cannot resurrect a row an earlier round tombstoned)
+            self._defer.del_d.append(d_all)
+            self._defer.del_r.append(r_all)
+            return
+        self._device_mark_deleted(d_all, r_all)
 
     def resolve_row(self, doc: int, peer: int, counter: int) -> Optional[int]:
         return self.id2row[doc].get((peer, counter))
@@ -2127,6 +2295,31 @@ class DeviceMapBatch:
         # server journals rounds against it; folds have no rows to
         # reclaim, so unlike theirs it never gates a compact())
         self.epoch = 0
+        self._defer = None  # coalesced-ingest accumulator
+        self._dev_lock = threading.RLock()
+
+    # -- round coalescing (LWW fold is associative: one merged fold of
+    # the group's rows lands the same winners as one fold per round;
+    # the epoch clock still bumps per round in _fold_rows) -------------
+    def begin_coalesce(self) -> None:
+        if self._defer is not None:
+            raise RuntimeError("coalesce group already open")
+        self._defer = _DeferredFold(self.d)
+
+    def detach_coalesce(self):
+        d, self._defer = self._defer, None
+        return d
+
+    def commit_detached(self, d) -> None:
+        if d is None or not any(d.rows):
+            return
+        self._device_fold(d.rows)
+        obs.counter("pipeline.coalesced_rounds_total").inc(
+            d.n_rounds, family="map"
+        )
+
+    def flush_coalesce(self) -> None:
+        self.commit_detached(self.detach_coalesce())
 
     def grow(self, new_slot_capacity: int) -> None:
         """Repack the LWW winner columns to a larger slot capacity
@@ -2135,13 +2328,14 @@ class DeviceMapBatch:
 
         if new_slot_capacity <= self.s:
             return
-        fills = _lww_fills(-2)
-        res = _pad_axis1(
-            {f: getattr(self.res, f) for f in self.res._fields},
-            new_slot_capacity, fills, doc_sharding(self.mesh),
-        )
-        self.res = LwwResident(**res)
-        self.s = new_slot_capacity
+        with self._dev_lock:  # vs an in-flight pipelined commit
+            fills = _lww_fills(-2)
+            res = _pad_axis1(
+                {f: getattr(self.res, f) for f in self.res._fields},
+                new_slot_capacity, fills, doc_sharding(self.mesh),
+            )
+            self.res = LwwResident(**res)
+            self.s = new_slot_capacity
 
     def _require_slots(self, required: int) -> None:
         """Grow (auto_grow) or raise when a staged append needs more
@@ -2268,13 +2462,20 @@ class DeviceMapBatch:
         self._fold_rows(rows_per_doc)
 
     def _fold_rows(self, rows_per_doc) -> None:
+        self.epoch += 1  # post-validation: dates this append (journal clock)
+        if not any(rows_per_doc):
+            return
+        if self._defer is not None:
+            self._defer.extend(rows_per_doc)
+            return
+        self._device_fold(rows_per_doc)
+
+    def _device_fold(self, rows_per_doc) -> None:
         from ..ops.fugue_batch import pad_bucket
         from ..ops.lww import lww_update_resident
 
-        self.epoch += 1  # post-validation: dates this append (journal clock)
+        obs.counter("fleet.device_launches_total").inc(family="resident_map")
         m = pad_bucket(max((len(r) for r in rows_per_doc), default=0), floor=16)
-        if not any(rows_per_doc):
-            return
         slot = np.zeros((self.d, m), np.int32)
         lam = np.zeros((self.d, m), np.int32)
         hi = np.zeros((self.d, m), np.uint32)
@@ -2289,11 +2490,13 @@ class DeviceMapBatch:
                 lo[di, j] = p_ & 0xFFFFFFFF
                 val[di, j] = v_
                 valid[di, j] = True
-        sh = doc_sharding(self.mesh)
-        put = lambda a: jax.device_put(a, sh)
-        self.res = lww_update_resident(
-            self.res, put(slot), put(lam), put(hi), put(lo), put(valid), self.s, value=put(val)
-        )
+        with self._dev_lock:
+            sh = doc_sharding(self.mesh)
+            put = lambda a: jax.device_put(a, sh)
+            self.res = lww_update_resident(
+                self.res, put(slot), put(lam), put(hi), put(lo), put(valid),
+                self.s, value=put(val),
+            )
 
     def value_maps(self) -> List[Dict[Tuple[ContainerID, str], object]]:
         """Materialize {(container, key): value} per doc.  Keys carry
@@ -2478,6 +2681,84 @@ class DeviceTreeBatch:
             parent=z(np.int32, ROOT),
             valid=z(bool, False),
         )
+        self._defer = None  # coalesced-ingest accumulator
+        self._dev_lock = threading.RLock()
+
+    # -- round coalescing (same contract as DeviceDocBatch) ------------
+    def begin_coalesce(self) -> None:
+        if self._defer is not None:
+            raise RuntimeError("coalesce group already open")
+        self._defer = _DeferredSeqDevice(self.counts.copy())
+
+    def detach_coalesce(self):
+        d, self._defer = self._defer, None
+        return d
+
+    def commit_detached(self, d) -> None:
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.tree_batch import ROOT
+
+        if d is None or not d.rounds:
+            return
+        with self._dev_lock:
+            fills = dict(
+                lamport=0, peer_hi=0, peer_lo=0, counter=0, target=0,
+                parent=ROOT, valid=False,
+            )
+            total = np.zeros(self.d, np.int64)
+            for _blk, n_new in d.rounds:
+                total += np.asarray(n_new, np.int64)
+            width = pad_bucket(int(total.max()), floor=16)
+            need = max(
+                (int(d.base0[di]) + width
+                 for di in range(self.d) if total[di]),
+                default=0,
+            )
+            if need > self.cap:
+                # bucket rounding outgrew capacity: per-round fallback
+                # (no grow here — it would race the next group's stage)
+                off = d.base0.astype(np.int64).copy()
+                for blk, n_new in d.rounds:
+                    self._device_commit_moves(blk, off.astype(np.int32), n_new)
+                    off += np.asarray(n_new, np.int64)
+            else:
+                blk = {
+                    f: np.full((self.d, width), fill,
+                               dtype=d.rounds[0][0][f].dtype)
+                    for f, fill in fills.items()
+                }
+                pos = np.zeros(self.d, np.int64)
+                for rblk, n_new in d.rounds:
+                    for di, k in enumerate(n_new):
+                        if not k:
+                            continue
+                        p = int(pos[di])
+                        for f in blk:
+                            blk[f][di, p : p + k] = rblk[f][di, :k]
+                        pos[di] += k
+                self._device_commit_moves(blk, d.base0.astype(np.int32), total)
+            obs.counter("pipeline.coalesced_rounds_total").inc(
+                len(d.rounds), family="tree"
+            )
+
+    def flush_coalesce(self) -> None:
+        self.commit_detached(self.detach_coalesce())
+
+    def _device_commit_moves(self, blk, offsets, n_new) -> None:
+        obs.counter("fleet.device_launches_total").inc(family="resident_tree")
+        obs.counter("fleet.pad_waste_rows_total").inc(
+            int(self.d * blk["valid"].shape[1] - int(np.sum(n_new))),
+            family="resident_tree",
+        )
+        with self._dev_lock:
+            sh = doc_sharding(self.mesh)
+            self.cols = _scatter_tree_rows(
+                self.cols,
+                {f: jax.device_put(v, sh) for f, v in blk.items()},
+                jax.device_put(
+                    np.asarray(offsets, np.int32), replicated(self.mesh)
+                ),
+            )
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
         """Incremental ingest: each doc's new causally-ordered changes
@@ -2636,19 +2917,20 @@ class DeviceTreeBatch:
         from ..ops.tree_batch import ROOT, TreeLogCols
 
         if move_capacity is not None and move_capacity > self.cap:
-            fills = dict(
-                lamport=0, peer_hi=0, peer_lo=0, counter=0, target=0,
-                parent=ROOT, valid=False,
-            )
-            cols = _pad_axis1(
-                {f: getattr(self.cols, f) for f in self.cols._fields},
-                move_capacity, fills, doc_sharding(self.mesh),
-            )
-            self.cols = TreeLogCols(**cols)
-            me = np.full((self.d, move_capacity), -1, np.int64)
-            me[:, : self.cap] = self.move_epoch
-            self.move_epoch = me
-            self.cap = move_capacity
+            with self._dev_lock:  # vs an in-flight pipelined commit
+                fills = dict(
+                    lamport=0, peer_hi=0, peer_lo=0, counter=0, target=0,
+                    parent=ROOT, valid=False,
+                )
+                cols = _pad_axis1(
+                    {f: getattr(self.cols, f) for f in self.cols._fields},
+                    move_capacity, fills, doc_sharding(self.mesh),
+                )
+                self.cols = TreeLogCols(**cols)
+                me = np.full((self.d, move_capacity), -1, np.int64)
+                me[:, : self.cap] = self.move_epoch
+                self.move_epoch = me
+                self.cap = move_capacity
         if node_capacity is not None and node_capacity > self.node_cap:
             self.node_cap = node_capacity
 
@@ -2728,12 +3010,11 @@ class DeviceTreeBatch:
             self.move_meta[di].extend(
                 (r[0], r[1], r[2], r[3], r[5], r[6]) for r in rows
             )
-        sh = doc_sharding(self.mesh)
-        self.cols = _scatter_tree_rows(
-            self.cols,
-            {f: jax.device_put(v, sh) for f, v in blk.items()},
-            jax.device_put(offsets, replicated(self.mesh)),
-        )
+        n_new = [len(r) for r in rows_per_doc]
+        if self._defer is not None:
+            self._defer.rounds.append((blk, n_new))
+        else:
+            self._device_commit_moves(blk, offsets, n_new)
 
     def _replay(self):
         from ..ops.tree_batch import tree_replay_log_batch
@@ -3044,6 +3325,44 @@ class DeviceTreeBatch:
         return out
 
 
+class _DeferredSeqDevice:
+    """Accumulated device work of one coalesced ingest group over a
+    DeviceDocBatch/DeviceTreeBatch: per-round host blocks (already
+    host-committed — epochs, order engines, id maps, counts) waiting
+    for the single merged scatter at flush_coalesce()."""
+
+    __slots__ = ("base0", "rounds", "renumbered", "del_d", "del_r", "key_snap")
+
+    def __init__(self, base0: np.ndarray):
+        self.base0 = base0          # per-doc counts at group start
+        self.rounds: List[tuple] = []
+        self.renumbered: set = set()
+        self.del_d: List[np.ndarray] = []
+        self.del_r: List[np.ndarray] = []
+        self.key_snap = None        # detach-time key rows (renumbered docs)
+
+
+class _DeferredFold:
+    """Accumulated fold rows of one coalesced group over an LWW/counter
+    resident (per-doc row lists concatenated across rounds; the folds
+    are associative — max by (lamport, peer) / float add — so one
+    merged fold lands the same winners as one fold per round)."""
+
+    __slots__ = ("rows", "n_rounds")
+
+    def __init__(self, n_docs: int):
+        self.rows: List[list] = [[] for _ in range(n_docs)]
+        self.n_rounds = 0  # non-empty rounds folded (metric unit parity
+        #                    with the seq/tree per-round block counts)
+
+    def extend(self, rows_per_doc) -> None:
+        if any(rows_per_doc):
+            self.n_rounds += 1
+        for di, rows in enumerate(rows_per_doc):
+            if rows:
+                self.rows[di].extend(rows)
+
+
 def _windowed_scatter_field(col, nbl, vbl, off):
     """One doc-row of the block scatter: padding rows of a block restore
     the window's previous values so short updates don't clobber
@@ -3120,6 +3439,40 @@ class DeviceMovableBatch:
         )
         self.moves = mk(0)  # value = winning slot ROW in the seq buffer
         self.vals = mk(-2)  # value = winning value ordinal
+        self._defer_moves = None  # coalesced-ingest accumulators
+        self._defer_vals = None
+        self._dev_lock = threading.RLock()
+
+    # -- round coalescing (slots ride the inner seq batch's deferral;
+    # the two element folds accumulate here — both associative) --------
+    def begin_coalesce(self) -> None:
+        if self._defer_moves is not None:
+            raise RuntimeError("coalesce group already open")
+        self.seq.begin_coalesce()
+        self._defer_moves = _DeferredFold(self.d)
+        self._defer_vals = _DeferredFold(self.d)
+
+    def detach_coalesce(self):
+        dm, self._defer_moves = self._defer_moves, None
+        dv, self._defer_vals = self._defer_vals, None
+        return (self.seq.detach_coalesce(), dm, dv)
+
+    def commit_detached(self, pending) -> None:
+        if pending is None:
+            return
+        seq_d, dm, dv = pending
+        self.seq.commit_detached(seq_d)
+        if dm is not None and any(dm.rows):
+            self._device_fold_elem(dm.rows, "moves")
+        if dv is not None and any(dv.rows):
+            self._device_fold_elem(dv.rows, "vals")
+        if dm is not None and dm.n_rounds:
+            obs.counter("pipeline.coalesced_rounds_total").inc(
+                dm.n_rounds, family="movable"
+            )
+
+    def flush_coalesce(self) -> None:
+        self.commit_detached(self.detach_coalesce())
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
         """Incremental ingest: slots append into the internal seq batch
@@ -3461,19 +3814,20 @@ class DeviceMovableBatch:
         if capacity is not None:
             self.seq.grow(capacity)
         if elem_capacity is not None and elem_capacity > self.e_cap:
-            sh = doc_sharding(self.mesh)
-            for name, vfill in (("moves", 0), ("vals", -2)):
-                res = getattr(self, name)
-                fills = _lww_fills(vfill)
-                setattr(
-                    self,
-                    name,
-                    LwwResident(**_pad_axis1(
-                        {f: getattr(res, f) for f in res._fields},
-                        elem_capacity, fills, sh,
-                    )),
-                )
-            self.e_cap = elem_capacity
+            with self._dev_lock:  # vs an in-flight pipelined commit
+                sh = doc_sharding(self.mesh)
+                for name, vfill in (("moves", 0), ("vals", -2)):
+                    res = getattr(self, name)
+                    fills = _lww_fills(vfill)
+                    setattr(
+                        self,
+                        name,
+                        LwwResident(**_pad_axis1(
+                            {f: getattr(res, f) for f in res._fields},
+                            elem_capacity, fills, sh,
+                        )),
+                    )
+                self.e_cap = elem_capacity
 
     def _commit_movable(
         self, rows_per_doc, overlays, move_rows, set_rows,
@@ -3504,11 +3858,27 @@ class DeviceMovableBatch:
                 self.elem_ids[di][eid] = len(self.elem_ids[di])
             self.values[di].extend(staged_vals[di])
         # fold element winners (moves then values)
-        sh = doc_sharding(self.mesh)
-        put = lambda a: jax.device_put(a, sh)
+        if self._defer_moves is not None:
+            set_only = not any(move_rows) and any(set_rows)
+            self._defer_moves.extend(move_rows)
+            self._defer_vals.extend(set_rows)
+            if set_only:
+                # a set-only round still shipped device work: count it
+                # on the moves accumulator (the group's round tally)
+                self._defer_moves.n_rounds += 1
+            return
         for rows_set, res_name in ((move_rows, "moves"), (set_rows, "vals")):
-            if not any(rows_set):
-                continue
+            if any(rows_set):
+                self._device_fold_elem(rows_set, res_name)
+
+    def _device_fold_elem(self, rows_set, res_name: str) -> None:
+        from ..ops.fugue_batch import pad_bucket
+        from ..ops.lww import lww_update_resident
+
+        obs.counter("fleet.device_launches_total").inc(family="resident_movable")
+        with self._dev_lock:
+            sh = doc_sharding(self.mesh)
+            put = lambda a: jax.device_put(a, sh)
             m = pad_bucket(max(len(r) for r in rows_set), floor=16)
             shp = (self.d, m)
             elem = np.full(shp, self.e_cap, np.int32)
@@ -3611,6 +3981,8 @@ class DeviceMovableBatch:
         batch.d = seq.d
         batch.e_cap = e_cap
         batch.auto_grow = auto_grow  # review r5: __new__ skips __init__
+        batch._defer_moves = batch._defer_vals = None
+        batch._dev_lock = threading.RLock()
         batch.elem_ids = [dict() for _ in range(batch.d)]
         batch.values = [[] for _ in range(batch.d)]
         sh = doc_sharding(batch.mesh)
@@ -3826,17 +4198,42 @@ class DeviceCounterBatch:
         # ingest-epoch clock (parity with the seq/tree batches — the
         # server journals rounds against it; folds never compact)
         self.epoch = 0
+        self._defer = None  # coalesced-ingest accumulator
+        self._dev_lock = threading.RLock()
+
+    # -- round coalescing (float add is associative for the documented
+    # integer-delta precision contract; epoch still bumps per round) ---
+    def begin_coalesce(self) -> None:
+        if self._defer is not None:
+            raise RuntimeError("coalesce group already open")
+        self._defer = _DeferredFold(self.d)
+
+    def detach_coalesce(self):
+        d, self._defer = self._defer, None
+        return d
+
+    def commit_detached(self, d) -> None:
+        if d is None or not any(d.rows):
+            return
+        self._device_fold(d.rows)
+        obs.counter("pipeline.coalesced_rounds_total").inc(
+            d.n_rounds, family="counter"
+        )
+
+    def flush_coalesce(self) -> None:
+        self.commit_detached(self.detach_coalesce())
 
     def grow(self, new_slot_capacity: int) -> None:
         """Repack counter sums to a larger slot capacity (resident
         lifecycle, r4 verdict #6)."""
         if new_slot_capacity <= self.s:
             return
-        self.sums = _pad_axis1(
-            {"sums": self.sums}, new_slot_capacity, {"sums": 0.0},
-            doc_sharding(self.mesh),
-        )["sums"]
-        self.s = new_slot_capacity
+        with self._dev_lock:  # vs an in-flight pipelined commit
+            self.sums = _pad_axis1(
+                {"sums": self.sums}, new_slot_capacity, {"sums": 0.0},
+                doc_sharding(self.mesh),
+            )["sums"]
+            self.s = new_slot_capacity
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]]) -> None:
         from ..core.change import CounterIncr
@@ -3887,17 +4284,27 @@ class DeviceCounterBatch:
         for di, order in enumerate(staged_slots):
             for cid in order:
                 self.slot_of[di][cid] = len(self.slot_of[di])
-        m = pad_bucket(max(len(r) for r in rows_per_doc), floor=16)
-        slot = np.full((self.d, m), self.s, np.int32)  # dump slot
-        delta = np.zeros((self.d, m), np.float32)
-        for di, rows in enumerate(rows_per_doc):
-            for i, (s_, dl) in enumerate(rows):
-                slot[di, i] = s_
-                delta[di, i] = dl
-        sh = doc_sharding(self.mesh)
-        self.sums = _fold_counter_rows(
-            self.sums, jax.device_put(slot, sh), jax.device_put(delta, sh)
-        )
+        if self._defer is not None:
+            self._defer.extend(rows_per_doc)
+            return
+        self._device_fold(rows_per_doc)
+
+    def _device_fold(self, rows_per_doc) -> None:
+        from ..ops.fugue_batch import pad_bucket
+
+        obs.counter("fleet.device_launches_total").inc(family="resident_counter")
+        with self._dev_lock:
+            m = pad_bucket(max(len(r) for r in rows_per_doc), floor=16)
+            slot = np.full((self.d, m), self.s, np.int32)  # dump slot
+            delta = np.zeros((self.d, m), np.float32)
+            for di, rows in enumerate(rows_per_doc):
+                for i, (s_, dl) in enumerate(rows):
+                    slot[di, i] = s_
+                    delta[di, i] = dl
+            sh = doc_sharding(self.mesh)
+            self.sums = _fold_counter_rows(
+                self.sums, jax.device_put(slot, sh), jax.device_put(delta, sh)
+            )
 
     def value_maps(self) -> List[Dict[ContainerID, float]]:
         sums = np.asarray(self.sums)

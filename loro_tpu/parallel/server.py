@@ -80,6 +80,26 @@ import struct as _struct  # noqa: E402  (stdlib, for _struct.error)
 _DATA_ERRORS = (ValueError, TypeError, KeyError, IndexError, _struct.error)
 
 
+class _StagedGroup:
+    """Handle between ``ingest_stage`` and ``ingest_commit``: the
+    normalized rounds, their stage-time epochs, and the detached device
+    work.  ``mode``: "group" (normal), "serial" (server was degraded at
+    stage time), "done" (stage already produced the final epochs, e.g.
+    the auto-checkpoint launch degraded)."""
+
+    __slots__ = ("mode", "rounds", "staged", "cid", "epochs", "pending",
+                 "error_index")
+
+    def __init__(self, rounds, cid):
+        self.mode = "group"
+        self.rounds = rounds
+        self.staged: List[tuple] = []
+        self.cid = cid
+        self.epochs: List[int] = []
+        self.pending = None
+        self.error_index: Optional[int] = None
+
+
 class ResidentServer:
     """One resident device batch + per-doc replica-ack bookkeeping.
 
@@ -116,7 +136,8 @@ class ResidentServer:
                  auto_grow: bool = True, supervisor=None,
                  host_fallback: bool = True, auto_checkpoint: bool = True,
                  durable_dir: Optional[str] = None,
-                 durable_fsync: bool = True,
+                 durable_fsync=True,
+                 fsync_window: int = 8,
                  mirror_anchor: bool = True,
                  **caps):
         if family not in _FAMILIES:
@@ -144,6 +165,7 @@ class ResidentServer:
                 durable.ensure_meta(WalMeta(
                     family=family, n_docs=n_docs, caps=dict(caps),
                     auto_grow=auto_grow, host_fallback=host_fallback,
+                    fsync_mode=durable.fsync_mode,
                 ))
             except BaseException:
                 durable.close()  # never leak the active segment handle
@@ -157,13 +179,14 @@ class ResidentServer:
             mesh=mesh, auto_grow=auto_grow, caps=dict(caps),
             supervisor=supervisor, host_fallback=host_fallback,
             auto_checkpoint=auto_checkpoint, history_complete=True,
-            anchor=anchor, durable=durable,
+            anchor=anchor, durable=durable, fsync_window=fsync_window,
         )
 
     def _init_resilience(self, mesh, auto_grow, caps, supervisor,
                          host_fallback, auto_checkpoint,
                          history_complete, anchor=None, durable=None,
-                         replay_base=None, ckpt_epoch=0) -> None:
+                         replay_base=None, ckpt_epoch=0,
+                         fsync_window: int = 8) -> None:
         self._mesh = mesh
         self._auto_grow = auto_grow
         self._caps = caps
@@ -183,6 +206,20 @@ class ResidentServer:
         # durable journal (persist.DurableLog) when durable_dir= given
         self._durable = durable
         self._durable_closed = False
+        # group commit (docs/PERSISTENCE.md): in "group" fsync mode the
+        # WAL defers fsyncs; the server syncs every `fsync_window`
+        # journaled rounds and tracks the acked-epoch watermark — the
+        # newest epoch a crash is guaranteed not to lose.  The
+        # watermark advances to the newest JOURNALED epoch (not
+        # self.epoch, which a concurrently-staging pipeline group may
+        # already have pushed past what is on disk).
+        self._fsync_window = max(1, int(fsync_window))
+        self._unsynced_rounds = 0
+        self._journaled_epoch = 0
+        self._durable_epoch = 0
+        # attached PipelinedIngest executor (parallel/pipeline.py):
+        # close()/checkpoint() drain it so no staged round is stranded
+        self._pipeline = None
         # bounded recover(): batch bytes to re-seed from (the last
         # checkpoint blob) + the visible epoch it covers
         self._replay_base: Optional[bytes] = replay_base
@@ -230,45 +267,17 @@ class ResidentServer:
                 "never be journaled; reopen via persist.recover_server()"
             )
         batch = self.batch
-        per_doc_updates = [
-            faultinject.mangle("poison_doc", u, doc=di) if u is not None else None
-            for di, u in enumerate(per_doc_updates)
-        ]
-        n_updated = sum(1 for u in per_doc_updates if u is not None)
-        obs.gauge("server.queue_depth").set(n_updated, family=self.family)
         self.last_poison_docs = []
-        has_bytes = any(isinstance(u, (bytes, bytearray))
-                        for u in per_doc_updates if u is not None)
-        has_changes = any(u is not None and not isinstance(u, (bytes, bytearray))
-                          for u in per_doc_updates)
-        if has_bytes and (has_changes or not hasattr(batch, "append_payloads")):
-            # mixed round, or a family without a native payload path
-            # (counter): decode bytes entries host-side per doc.  A
-            # bytes entry that won't decode is poison for THAT doc only
-            # — skipped with a typed record, never an uncaught error
-            # for the round.
-            reason = "mixed_round" if has_changes else "no_payload_path"
-            n_decoded = sum(
-                1 for u in per_doc_updates if isinstance(u, (bytes, bytearray))
-            )
-            obs.counter("server.ingest_fallback_total").inc(
-                n_decoded, family=self.family, reason=reason
-            )
-            per_doc_updates = self._decode_bytes_entries(per_doc_updates)
-            use_payloads = False
-        else:
-            use_payloads = has_bytes
+        per_doc_updates, use_payloads, n_updated = self._normalize_round(
+            per_doc_updates, batch
+        )
         if self.family not in ("map", "counter") and cid is None:
             # API misuse, not a poison round: surface it before the
             # isolation machinery can misread it as per-doc poison
             raise ValueError(f"{self.family} ingest needs the container id")
         if cid is not None:
             self._cid = cid
-        route = "payloads" if use_payloads else "changes"
-        obs.counter("server.ingest_rounds_total").inc(
-            family=self.family, route=route
-        )
-        obs.counter("server.ingest_docs_total").inc(n_updated, family=self.family)
+        self._tick_round_counters(use_payloads, n_updated)
         if self._degraded:
             # decode EVERYTHING first (per-doc poison -> skip, typed),
             # then apply: a poison doc never half-applies a mirror round
@@ -324,6 +333,46 @@ class ResidentServer:
         self._record_round(per_doc_updates, cid)
         return self.epoch
 
+    def _normalize_round(self, per_doc_updates, batch):
+        """Fault-mangle + route one round (shared by ingest and
+        ingest_coalesced): returns ``(updates, use_payloads,
+        n_updated)``.  Bytes entries decode host-side when the round is
+        mixed or the family lacks a native payload path; an entry that
+        won't decode is poison for THAT doc only — skipped with a
+        typed record (``last_poison_docs``), never an uncaught error."""
+        per_doc_updates = [
+            faultinject.mangle("poison_doc", u, doc=di) if u is not None else None
+            for di, u in enumerate(per_doc_updates)
+        ]
+        n_updated = sum(1 for u in per_doc_updates if u is not None)
+        obs.gauge("server.queue_depth").set(n_updated, family=self.family)
+        has_bytes = any(isinstance(u, (bytes, bytearray))
+                        for u in per_doc_updates if u is not None)
+        has_changes = any(u is not None and not isinstance(u, (bytes, bytearray))
+                          for u in per_doc_updates)
+        if has_bytes and (has_changes or not hasattr(batch, "append_payloads")):
+            # mixed round, or a family without a native payload path
+            # (counter): decode bytes entries host-side per doc
+            reason = "mixed_round" if has_changes else "no_payload_path"
+            n_decoded = sum(
+                1 for u in per_doc_updates if isinstance(u, (bytes, bytearray))
+            )
+            obs.counter("server.ingest_fallback_total").inc(
+                n_decoded, family=self.family, reason=reason
+            )
+            per_doc_updates = self._decode_bytes_entries(per_doc_updates)
+            use_payloads = False
+        else:
+            use_payloads = has_bytes
+        return per_doc_updates, use_payloads, n_updated
+
+    def _tick_round_counters(self, use_payloads: bool, n_updated: int) -> None:
+        route = "payloads" if use_payloads else "changes"
+        obs.counter("server.ingest_rounds_total").inc(
+            family=self.family, route=route
+        )
+        obs.counter("server.ingest_docs_total").inc(n_updated, family=self.family)
+
     def _append(self, batch, updates, cid, use_payloads: bool) -> None:
         if self.family in ("map", "counter"):
             if use_payloads:
@@ -357,15 +406,19 @@ class ResidentServer:
                     obs.counter("server.poison_docs_total").inc(family=self.family)
         return out
 
-    def _record_round(self, updates, cid) -> None:
+    def _record_round(self, updates, cid, epoch: Optional[int] = None) -> None:
         """Journal one APPLIED round (stamped with the round's visible
-        epoch).  Change-list entries are FROZEN as encoded bytes: the
+        epoch — coalesced ingest passes each round's epoch explicitly,
+        since the batch clock has already advanced past it by journal
+        time).  Change-list entries are FROZEN as encoded bytes: the
         live Change objects are aliased with the producing doc's oplog,
         which extends them in place on later commits (change RLE) —
         journaling the objects themselves would double-apply those ops
         on replay.  Bytes entries are immutable already and stored
         as-is.  With ``durable_dir`` the round also lands in the WAL
-        (fsync'd) before this method returns."""
+        before this method returns (fsync'd per round, or deferred to
+        the group-commit window in ``durable_fsync="group"`` mode —
+        ``durable_epoch`` is the watermark a crash cannot lose)."""
         if not (self._host_fallback or self._durable is not None):
             return
         from ..codec.binary import encode_changes
@@ -375,7 +428,8 @@ class ResidentServer:
             else bytes(encode_changes(list(u)))
             for u in updates
         ]
-        epoch = self.epoch
+        if epoch is None:
+            epoch = self.epoch
         # in-memory journal FIRST: the round is already on the device,
         # and the mirror/recover() paths must see it even if the
         # durable append below fails
@@ -405,10 +459,59 @@ class ResidentServer:
                     "is DETACHED (fail-stop), recover durability from "
                     f"{log.dir!r}: {type(e).__name__}: {e}"
                 ) from e
+            self._journaled_epoch = max(self._journaled_epoch, epoch)
+            if self._durable.fsync_mode == "group":
+                self._unsynced_rounds += 1
+                if self._unsynced_rounds >= self._fsync_window:
+                    self.flush_durable()
+            else:
+                # per-round fsync: the round is already on disk
+                self._durable_epoch = epoch
             obs.gauge(
                 "persist.checkpoint_age_rounds",
                 "journaled rounds since the last checkpoint",
             ).set(epoch - self._ckpt_epoch, family=self.family)
+
+    def flush_durable(self) -> int:
+        """Group-commit flush point: fsync every journaled-but-unsynced
+        WAL append (the WAL's own pending count includes control
+        records the per-round window never sees) and advance the
+        ``durable_epoch`` watermark to the newest JOURNALED epoch —
+        never ``self.epoch``, which a concurrently-staging pipeline
+        group may already have pushed past what is on disk.  Returns
+        appends covered (0 when nothing was pending or the server is
+        not durable).  Fail-stop like the append path: a failed fsync
+        detaches the journal typed."""
+        if self._durable is None:
+            return 0
+        try:
+            n = self._durable.sync()
+        except BaseException as e:
+            from ..errors import PersistError
+
+            log, self._durable = self._durable, None
+            self._durable_closed = True
+            try:
+                log.close()
+            except Exception:
+                pass
+            obs.counter("server.errors_total").inc(family=self.family)
+            raise PersistError(
+                f"durable group-commit fsync failed — journaling is "
+                f"DETACHED (fail-stop), recover durability from "
+                f"{log.dir!r}: {type(e).__name__}: {e}"
+            ) from e
+        self._unsynced_rounds = 0
+        self._durable_epoch = max(self._durable_epoch, self._journaled_epoch)
+        return n
+
+    @property
+    def durable_epoch(self) -> int:
+        """The acked-epoch watermark: the newest visible epoch whose
+        journal record is known fsync'd.  A crash loses at most rounds
+        after it (group mode); equals the newest journaled epoch in
+        per-round mode.  0 for non-durable servers."""
+        return self._durable_epoch
 
     def _replay_round(self, batch, updates, cid) -> None:
         """Re-apply a journaled round to `batch` with the same routing
@@ -443,6 +546,203 @@ class ResidentServer:
                     leaves.append(leaf)
         if leaves:
             np.asarray(min(leaves, key=lambda a: a.size))
+
+    # -- coalesced sync rounds ----------------------------------------
+    def ingest_coalesced(self, rounds: Sequence[Sequence], cid=None) -> List[int]:
+        """Apply several pending sync rounds as ONE coalesced device
+        group (docs/RESILIENCE.md "round coalescing"): every round's
+        host work — routing, order maintenance, id maps, epoch clock —
+        runs per round exactly as serial ``ingest`` would (the final
+        state is byte-for-byte identical), but the device scatters/
+        folds of the whole group ship as one launch, amortizing the
+        dispatch + tunnel-RTT floor across the group.
+
+        Journal records, poison isolation, host-mirror degradation and
+        ack bookkeeping stay PER ROUND: returns one visible epoch per
+        round, in order, for clients to ack.  With
+        ``durable_fsync="group"`` the group's journal records share one
+        fsync and the epochs are returned only after it — an acked
+        round is never lost to a crash (``durable_epoch``).
+
+        ``ingest_stage``/``ingest_commit`` are the two-phase form the
+        pipeline executor uses to overlap group N's device commit with
+        group N+1's host staging; this method is simply stage+commit
+        back-to-back."""
+        rounds = [list(r) for r in rounds]
+        if not rounds:
+            return []
+        if self._degraded or len(rounds) == 1:
+            # host mirror rounds have no launch to amortize; a solo
+            # round IS the serial path
+            return [self.ingest(r, cid) for r in rounds]
+        return self.ingest_commit(self.ingest_stage(rounds, cid))
+
+    def ingest_stage(self, rounds: Sequence[Sequence], cid=None):
+        """Phase 1 of a coalesced group: normalize + HOST-stage every
+        round (order maintenance, id maps, per-round epoch stamps) with
+        the device work deferred, and return an opaque handle for
+        ``ingest_commit``.  Touches no device arrays (modulo a rare
+        capacity grow, which the batch's device lock serializes against
+        an in-flight commit), so it may run while the PREVIOUS group's
+        commit is still on the device — the host/device overlap of
+        docs/RESILIENCE.md."""
+        rounds = [list(r) for r in rounds]
+        if getattr(self, "_durable_closed", False):
+            from ..errors import PersistError
+
+            raise PersistError(
+                "durable server is closed — a round applied now could "
+                "never be journaled; reopen via persist.recover_server()"
+            )
+        if self.family not in ("map", "counter") and cid is None:
+            raise ValueError(f"{self.family} ingest needs the container id")
+        h = _StagedGroup(rounds, cid)
+        if not rounds:
+            h.mode = "done"
+            return h
+        if self._degraded:
+            h.mode = "serial"  # commit routes through degraded ingest
+            return h
+        batch = self.batch
+        self.last_poison_docs = []
+        for r in rounds:
+            ups, use_pl, n_upd = self._normalize_round(r, batch)
+            h.staged.append((ups, use_pl))
+            self._tick_round_counters(use_pl, n_upd)
+        if cid is not None:
+            self._cid = cid
+        sup = self._sup()
+        if self._auto_ckpt_pending:
+            # same contract as serial ingest: snapshot before the first
+            # risky (first-compile) launch of the server's life.  Only
+            # ever runs before the FIRST group, so no commit can be in
+            # flight behind it.
+            self._auto_ckpt_pending = False
+            try:
+                self.last_checkpoint = sup.guard(
+                    self.checkpoint, label=f"server.checkpoint.{self.family}"
+                )
+            except DeviceFailure as e:
+                h.mode = "done"
+                h.epochs = self._degrade_rounds(
+                    [s[0] for s in h.staged], cid, e
+                )
+                return h
+            obs.counter("server.auto_checkpoints_total").inc(family=self.family)
+        batch.begin_coalesce()
+        try:
+            for i, (ups, use_pl) in enumerate(h.staged):
+                try:
+                    self._append(batch, ups, cid, use_pl)
+                except _DATA_ERRORS:
+                    # poison round: staging stops here; commit isolates
+                    # it per doc and runs the tail serially
+                    h.error_index = i
+                    break
+                h.epochs.append(self.epoch)
+        except BaseException:
+            # host config/logic error (capacity with auto_grow=False,
+            # API misuse): ship the staged prefix so host and device
+            # agree, journal it, then surface loudly — same contract as
+            # serial ingest
+            batch.flush_coalesce()
+            for j, ep in enumerate(h.epochs):
+                self._record_round(h.staged[j][0], cid, epoch=ep)
+            self.flush_durable()
+            obs.counter("server.errors_total").inc(family=self.family)
+            raise
+        h.pending = batch.detach_coalesce()
+        return h
+
+    def ingest_commit(self, h) -> List[int]:
+        """Phase 2 of a coalesced group: ship the staged device work as
+        one supervised launch, journal each round with its stage-time
+        epoch, and fsync the group-commit window.  Returns the
+        per-round ack epochs.  A DeviceFailure here degrades with the
+        WHOLE group (none of it is journaled before this method), so
+        staged work replays in order on the host mirror — never lost,
+        never double-applied."""
+        if h.mode == "done":
+            return h.epochs
+        if h.mode == "serial":
+            # server was degraded at stage time: plain serial ingest
+            # (host mirror application, journaled per round).  The
+            # group-end fsync still applies: a pipeline epoch future
+            # must never resolve before its journal record is durable.
+            out = [self.ingest(r, h.cid) for r in h.rounds]
+            self.flush_durable()
+            return out
+        cid = h.cid
+        sup = self._sup()
+        batch = self.batch
+        if self._degraded:
+            # a previous group's commit degraded the server AFTER this
+            # group host-staged into the now-discarded device batch:
+            # re-apply the normalized rounds on the mirror (the mirror
+            # seeded from the journal, which holds none of them)
+            out: List[int] = []
+            for ups, _pl in h.staged:
+                obs.counter("server.degraded_rounds_total").inc(family=self.family)
+                ups = self._decode_bytes_entries(ups)
+                self._host.apply(ups, cid)
+                self._host_rounds += 1
+                self._record_round(ups, cid)
+                out.append(self.epoch)
+            self.flush_durable()
+            return out
+        obs.counter("pipeline.groups_total").inc(family=self.family)
+        obs.histogram(
+            "pipeline.coalesce_group_rounds", "rounds per coalesced group",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        ).observe(len(h.staged))
+        try:
+            with obs.histogram(
+                "server.epoch_seconds", "ingest wall time per sync round"
+            ).time(family=self.family):
+                sup.launch(
+                    lambda: batch.commit_detached(h.pending),
+                    label=f"server.ingest.{self.family}",
+                    retry=False,  # scatters donate buffers: never re-run
+                    drain=self._drain_fetch,
+                )
+        except DeviceFailure as e:
+            return self._degrade_rounds([s[0] for s in h.staged], cid, e)
+        epochs = list(h.epochs)
+        # journal per round (each with ITS stage-time epoch)
+        for (ups, _pl), ep in zip(h.staged, epochs):
+            self._record_round(ups, cid, epoch=ep)
+        if h.error_index is not None:
+            # the poison round + unstaged tail: isolate per doc, then
+            # run the remainder serially (another device failure there
+            # degrades with the remaining rounds)
+            i = h.error_index
+            self._ingest_isolated(h.staged[i][0], cid, sup)
+            epochs.append(self.epoch)
+            i += 1
+            while i < len(h.staged):
+                ups, use_pl = h.staged[i]
+                try:
+                    sup.launch(
+                        lambda ups=ups, up=use_pl: self._append(
+                            batch, ups, cid, up
+                        ),
+                        label=f"server.ingest.{self.family}",
+                        retry=False,
+                        drain=self._drain_fetch,
+                    )
+                except DeviceFailure as e:
+                    return epochs + self._degrade_rounds(
+                        [s[0] for s in h.staged[i:]], cid, e
+                    )
+                except _DATA_ERRORS:
+                    self._ingest_isolated(ups, cid, sup)
+                else:
+                    self._record_round(ups, cid)
+                epochs.append(self.epoch)
+                i += 1
+        # one group-commit sync point: every returned epoch is durable
+        self.flush_durable()
+        return epochs
 
     # -- per-doc error isolation --------------------------------------
     def _ingest_isolated(self, updates, cid, sup) -> None:
@@ -509,15 +809,24 @@ class ResidentServer:
         """Supervisor declared the device dead mid-epoch: re-run the
         epoch on the host engine (anchor seed / journal replay + this
         round) and stay degraded until ``recover()``."""
+        return self._degrade_rounds([updates], cid, cause)[-1]
+
+    def _degrade_rounds(self, rounds_updates, cid,
+                        cause: DeviceFailure) -> List[int]:
+        """Group form of ``_degrade_round`` (coalesced ingest): seed
+        the host mirror once — anchor / journal replay, which holds
+        NOTHING of the failed group — then apply and journal every
+        group round in order, so staged work replays exactly once.
+        Returns one visible epoch per round."""
         anchored = self._anchor is not None
         if not (self._host_fallback and (self._history_complete or anchored)):
             obs.counter("server.errors_total").inc(family=self.family)
             raise cause
         self._sup().note_degradation(f"server.{self.family}")
-        obs.counter("server.degraded_rounds_total").inc(family=self.family)
         obs.gauge("server.degraded").set(1, family=self.family)
-        # base = the VISIBLE epoch (batch.epoch may already include the
-        # failed round if it committed before the drain raised)
+        # base = the VISIBLE epoch (batch.epoch may already include
+        # rounds of the failed group that committed before the drain
+        # raised — the offset keeps visible epochs monotone)
         self._epoch_base = self.epoch
         host = self._seed_mirror()
         floor = self._anchor.epoch if anchored else 0
@@ -526,15 +835,21 @@ class ResidentServer:
                 host.apply(ups, c)
         if self._cid is not None and cid is None:
             host._cid = self._cid
-        # the failed round's bytes never committed anywhere, so they
-        # are NOT known-decodable: poison-skip per doc before applying
         self._host = host
         self._degraded = True
-        updates = self._decode_bytes_entries(updates)
-        host.apply(updates, cid)
-        self._host_rounds = 1
-        self._record_round(updates, cid)
-        return self.epoch
+        self._host_rounds = 0
+        out: List[int] = []
+        for updates in rounds_updates:
+            obs.counter("server.degraded_rounds_total").inc(family=self.family)
+            # the failed rounds' bytes never committed anywhere, so
+            # they are NOT known-decodable: poison-skip per doc
+            updates = self._decode_bytes_entries(updates)
+            host.apply(updates, cid)
+            self._host_rounds += 1
+            self._record_round(updates, cid)
+            out.append(self.epoch)
+        self.flush_durable()
+        return out
 
     def _seed_mirror(self):
         """Host mirror base: anchor-seeded docs when a mirror anchor
@@ -549,21 +864,58 @@ class ResidentServer:
 
     def attach_durable(self, log) -> None:
         """Adopt a ``persist.DurableLog`` (recover_server re-attaches
-        the reopened directory so future rounds keep journaling)."""
+        the reopened directory so future rounds keep journaling).
+        Every replayed round came FROM disk, so the durable watermark
+        starts at the recovered epoch."""
         self._durable = log
         self._durable_closed = False
+        self._unsynced_rounds = 0
+        self._journaled_epoch = self.epoch
+        self._durable_epoch = self.epoch
+
+    def pipeline(self, cid=None, coalesce: int = 4, depth: int = 2):
+        """Attach a ``PipelinedIngest`` executor (parallel/pipeline.py):
+        submitted rounds stage on the host while the device group in
+        flight drains, and consecutive staged rounds coalesce into one
+        launch.  ``close()``/``checkpoint()`` drain it automatically."""
+        from .pipeline import PipelinedIngest
+
+        if self._pipeline is not None and not self._pipeline.closed:
+            raise RuntimeError(
+                "server already has a live pipeline — close() it first"
+            )
+        self._pipeline = PipelinedIngest(
+            self, cid=cid, coalesce=coalesce, depth=depth
+        )
+        return self._pipeline
+
+    def _drain_pipeline(self) -> None:
+        """Flush the attached pipeline (no-op from the pipeline's own
+        worker thread — e.g. the auto-checkpoint a worker ingest
+        triggers — and when no pipeline is attached)."""
+        if self._pipeline is not None and not self._pipeline.closed:
+            self._pipeline.flush()
 
     def close(self) -> None:
-        """Release the durable log (flush + close the active WAL
-        segment) so ``persist.recover_server``/``open_server`` can
-        reopen the directory.  No-op without ``durable_dir``.  The
-        server stays READABLE, but further ``ingest()`` raises a typed
-        PersistError — applying a round the closed WAL can't journal
-        would silently diverge served state from recovery."""
-        if self._durable is not None:
-            self._durable.close()
-            self._durable = None
-            self._durable_closed = True
+        """Drain the attached pipeline, fsync any pending group-commit
+        window, and release the durable log (flush + close the active
+        WAL segment) so ``persist.recover_server``/``open_server`` can
+        reopen the directory.  The server stays READABLE, but further
+        ``ingest()`` raises a typed PersistError — applying a round the
+        closed WAL can't journal would silently diverge served state
+        from recovery."""
+        try:
+            if self._pipeline is not None and not self._pipeline.closed:
+                self._pipeline.close()
+        finally:
+            # the durable teardown must run even when the pipeline
+            # drain re-raises a worker error: a WAL handle left open
+            # would make the directory refuse a later recover_server
+            if self._durable is not None:
+                self.flush_durable()
+                self._durable.close()
+                self._durable = None
+                self._durable_closed = True
 
     def _replay_journal_tail(self, rounds) -> None:
         """Apply recovered WAL rounds (``(epoch, cid, frozen)``) to the
@@ -730,6 +1082,7 @@ class ResidentServer:
         their resident state is already a fold — and while degraded:
         the host mirror holds no device rows to reclaim).  Returns rows
         reclaimed."""
+        self._drain_pipeline()  # never compact under a staged group
         if self.family not in _COMPACTABLE or self._degraded:
             return 0
         floors: List[Optional[int]] = []
@@ -762,7 +1115,10 @@ class ResidentServer:
         ``durable_dir`` the blob lands on the checkpoint ladder while
         the WAL rotates and prunes covered segments.  Unavailable
         while degraded (the device state is gone — ``recover()``
-        first, or restore the pre-failure ``last_checkpoint``)."""
+        first, or restore the pre-failure ``last_checkpoint``).  An
+        attached pipeline is DRAINED first: a checkpoint must cover
+        every submitted round, never split a staged group."""
+        self._drain_pipeline()
         if self._degraded:
             raise ResilienceError(
                 "cannot checkpoint a degraded server (device state lost); "
@@ -824,6 +1180,15 @@ class ResidentServer:
             self._history = [r for r in self._history if r[0] > self._ckpt_epoch]
         if self._durable is not None:
             self._durable.record_checkpoint(self._ckpt_epoch, blob)
+            # the rotation inside record_checkpoint fsyncs any pending
+            # group-commit tail: everything JOURNALED is now durable
+            # (self.epoch may already include concurrently-staged
+            # rounds that are not — the pipeline was drained above,
+            # but stay on the journaled clock for consistency)
+            self._unsynced_rounds = 0
+            self._durable_epoch = max(
+                self._durable_epoch, self._journaled_epoch
+            )
             obs.gauge(
                 "persist.checkpoint_age_rounds",
                 "journaled rounds since the last checkpoint",

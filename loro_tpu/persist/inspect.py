@@ -65,7 +65,8 @@ def inspect_dir(durable_dir: str, out=None) -> int:
     if meta is not None:
         caps = " ".join(f"{k}={v}" for k, v in sorted(meta.caps.items()))
         p(f"meta: family={meta.family} n_docs={meta.n_docs} "
-          f"auto_grow={meta.auto_grow} host_fallback={meta.host_fallback}"
+          f"auto_grow={meta.auto_grow} host_fallback={meta.host_fallback} "
+          f"fsync={meta.fsync_mode}"
           + (f" {caps}" if caps else ""))
     else:
         p("meta: (none)")

@@ -27,7 +27,8 @@ Segment file = 5-byte header ``"LTWL" u8:version`` then frames::
 Frame payload = ``u8 rtype`` + body (codec/binary Writer primitives):
 
 - ``R_META``  — ``u8 meta_ver, str family, varint n_docs, u8 flags
-  (bit0 auto_grow, bit1 host_fallback), varint n_caps, (str, varint)*``
+  (bit0 auto_grow, bit1 host_fallback, bit2 group-commit fsync mode),
+  varint n_caps, (str, varint)*``
   Construction caps: cold recovery (no valid checkpoint) rebuilds the
   server from this record.  Written as the FIRST record of EVERY
   segment so pruning old segments never loses it.
@@ -156,13 +157,28 @@ def read_cid_opt(r: Reader) -> Optional[ContainerID]:
 @dataclass
 class WalMeta:
     """Construction parameters of the owning server — enough for cold
-    recovery to rebuild it without any checkpoint."""
+    recovery to rebuild it without any checkpoint.  ``fsync_mode``
+    records the durability mode the log was CREATED with ("per_round"
+    or "group" — docs/PERSISTENCE.md "group commit"); it is
+    informational (inspect shows it) and excluded from the reopen
+    mismatch check, so a directory can be reopened under either mode."""
 
     family: str
     n_docs: int
     caps: Dict[str, int] = field(default_factory=dict)
     auto_grow: bool = True
     host_fallback: bool = True
+    fsync_mode: str = "per_round"
+
+    def compatible(self, other: "WalMeta") -> bool:
+        """Same server shape (the refusal check ignores fsync_mode)."""
+        return (
+            self.family == other.family
+            and self.n_docs == other.n_docs
+            and self.caps == other.caps
+            and self.auto_grow == other.auto_grow
+            and self.host_fallback == other.host_fallback
+        )
 
     def encode(self) -> bytes:
         w = Writer()
@@ -170,7 +186,11 @@ class WalMeta:
         w.u8(META_VERSION)
         w.str_(self.family)
         w.varint(self.n_docs)
-        w.u8((1 if self.auto_grow else 0) | (2 if self.host_fallback else 0))
+        w.u8(
+            (1 if self.auto_grow else 0)
+            | (2 if self.host_fallback else 0)
+            | (4 if self.fsync_mode == "group" else 0)
+        )
         write_caps(w, self.caps)
         return bytes(w.buf)
 
@@ -183,7 +203,10 @@ class WalMeta:
         n_docs = r.varint()
         flags = r.u8()
         caps = read_caps(r)
-        return cls(family, n_docs, caps, bool(flags & 1), bool(flags & 2))
+        return cls(
+            family, n_docs, caps, bool(flags & 1), bool(flags & 2),
+            "group" if flags & 4 else "per_round",
+        )
 
 
 @dataclass
@@ -319,11 +342,31 @@ class WriteAheadLog:
     directory).  Opening an existing directory scans every segment:
     torn tails on the newest segment are truncated away (counted),
     corruption in older segments raises typed ``CodecDecodeError``.
+
+    ``fsync`` selects the durability mode: ``True`` fsyncs every frame
+    before the append returns (per-round commit), ``"group"`` defers
+    the fsync to an explicit ``sync()`` — the group-commit flush point
+    (docs/PERSISTENCE.md): appends stay buffered-to-OS until the owner
+    syncs a whole window, amortizing the fsync across rounds; a crash
+    loses at most the unsynced tail (the torn-tail reopen contract
+    already covers partially-flushed frames).  ``False`` never fsyncs
+    (tests only).
     """
 
-    def __init__(self, dir: str, fsync: bool = True):
+    def __init__(self, dir: str, fsync=True):
         self.dir = dir
-        self.fsync = fsync
+        if fsync is True:
+            self.fsync_mode = "per_round"
+        elif fsync is False:
+            self.fsync_mode = "off"
+        elif fsync in ("per_round", "group", "off"):
+            self.fsync_mode = fsync
+        else:
+            raise PersistError(f"unknown WAL fsync mode {fsync!r}")
+        # segment-creation/rotation fsyncs stay on in group mode (rare,
+        # and a lost directory entry would orphan the whole segment)
+        self.fsync = self.fsync_mode != "off"
+        self._unsynced = 0  # appends since the last fsync (group mode)
         os.makedirs(dir, exist_ok=True)
         self._f = None  # active segment file handle
         self._active: Optional[SegmentInfo] = None
@@ -412,6 +455,10 @@ class WriteAheadLog:
             w.u8(R_PRUNE)
             w.varint(self.pruned_below)
             self._append(bytes(w.buf), rtype="prune")
+        # control records never ride the group-commit window: the old
+        # segment (holding the previous meta copy) may be pruned right
+        # after this rotation, so the fresh copy must hit disk first
+        self.sync()
 
     # -- appends -------------------------------------------------------
     def _append(self, payload: bytes, rtype: str) -> None:
@@ -426,11 +473,10 @@ class WriteAheadLog:
         frame = faultinject.mangle("wal_torn_tail", frame)
         self._f.write(frame)
         self._f.flush()
-        if self.fsync:
-            with obs.histogram(
-                "persist.wal_fsync_seconds", "WAL fsync wall time"
-            ).time():
-                os.fsync(self._f.fileno())
+        if self.fsync_mode == "per_round":
+            self._fsync_active()
+        elif self.fsync_mode == "group":
+            self._unsynced += 1
         obs.histogram(
             "persist.wal_append_bytes", "WAL frame payload sizes",
             buckets=_BYTE_BUCKETS,
@@ -440,13 +486,44 @@ class WriteAheadLog:
         a.size = a.good_bytes = a.good_bytes + _FRAME_HDR + len(payload)
         a.n_records += 1
 
+    def _fsync_active(self) -> None:
+        """fsync the active segment handle (timed + counted: the
+        bench A/B and the count-based perf guard compare fsyncs/round
+        across commit modes)."""
+        with obs.histogram(
+            "persist.wal_fsync_seconds", "WAL fsync wall time"
+        ).time():
+            os.fsync(self._f.fileno())
+        obs.counter(
+            "persist.wal_fsyncs_total", "WAL data fsyncs issued"
+        ).inc(mode=self.fsync_mode)
+
+    def sync(self) -> int:
+        """Group-commit flush point: fsync the active segment if any
+        appends are pending; returns how many appends the fsync covered
+        (0 = nothing pending).  No-op in per-round mode (every append
+        already synced) and off mode."""
+        if self.fsync_mode != "group" or not self._unsynced:
+            return 0
+        if self._f is None:
+            raise PersistError("WAL is closed")
+        n, self._unsynced = self._unsynced, 0
+        self._fsync_active()
+        obs.histogram(
+            "persist.wal_group_commit_rounds", "appends per group fsync",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+        ).observe(n)
+        return n
+
     def write_meta(self, meta: WalMeta) -> None:
         """Record construction caps (once per log; re-emitted at every
         rotation).  A log that already carries a DIFFERENT meta belongs
         to another server — cold recovery would rebuild the wrong shape
-        from it, so the mismatch is refused, never silently inherited."""
+        from it, so the mismatch is refused, never silently inherited.
+        (``fsync_mode`` is excluded: reopening under a different
+        durability mode is legitimate — see WalMeta.compatible.)"""
         if self.meta is not None:
-            if self.meta != meta:
+            if not self.meta.compatible(meta):
                 raise PersistError(
                     f"{self.dir}: WAL meta mismatch — log was created for "
                     f"{self.meta.family}/{self.meta.n_docs} docs, this "
@@ -456,6 +533,11 @@ class WriteAheadLog:
             return
         self.meta = meta
         self._append(meta.encode(), rtype="meta")
+        # control records never ride the group-commit window: a meta
+        # lost from the OS buffer would make the directory scan as
+        # empty and let open_server silently build a fresh server over
+        # it (the rotation/prune paths sync their copies the same way)
+        self.sync()
 
     def append_round(self, epoch: int, cid, updates) -> None:
         """Journal one applied round (``updates``: per-doc frozen wire
@@ -475,7 +557,11 @@ class WriteAheadLog:
     # -- rotation / pruning -------------------------------------------
     def rotate(self) -> None:
         """Close the active segment and start the next one (called at
-        every checkpoint, so older segments become prunable units)."""
+        every checkpoint, so older segments become prunable units).
+        Pending group-commit appends are fsynced first — a rotated-away
+        segment can never be synced again, and silently dropping its
+        tail would lose journaled rounds the owner believes durable."""
+        self.sync()
         if self._f is not None:
             self._f.close()
         self._start_segment(self._active.index + 1 if self._active else 1)
@@ -500,6 +586,11 @@ class WriteAheadLog:
             w.u8(R_PRUNE)
             w.varint(floor)
             self._append(bytes(w.buf), rtype="prune")
+            # the marker must be durable BEFORE the segments vanish: a
+            # crash in between must read "rounds were deleted", never
+            # silently replay a truncated history (group mode defers
+            # data fsyncs — control records don't get to)
+            self.sync()
             self.pruned_below = max(self.pruned_below, floor)
         removed = 0
         keep: List[SegmentInfo] = []
@@ -539,6 +630,7 @@ class WriteAheadLog:
 
     def close(self) -> None:
         if self._f is not None:
+            self.sync()  # group mode: never strand a buffered tail
             self._f.close()
             self._f = None
 
@@ -550,7 +642,7 @@ class DurableLog:
     the WAL, (c) rotates the segment and (d) prunes segments fully
     covered by the checkpoint."""
 
-    def __init__(self, dir: str, fsync: bool = True, keep_recent: int = 3):
+    def __init__(self, dir: str, fsync=True, keep_recent: int = 3):
         from .checkpoints import CheckpointManager
 
         self.dir = dir
@@ -563,6 +655,14 @@ class DurableLog:
     @property
     def meta(self) -> Optional[WalMeta]:
         return self.wal.meta
+
+    @property
+    def fsync_mode(self) -> str:
+        return self.wal.fsync_mode
+
+    def sync(self) -> int:
+        """Group-commit flush point (see WriteAheadLog.sync)."""
+        return self.wal.sync()
 
     def ensure_meta(self, meta: WalMeta) -> None:
         self.wal.write_meta(meta)

@@ -3,7 +3,8 @@ no test_ prefix).
 
 As a script (the subprocess the test SIGKILLs)::
 
-    python tests/_persist_crash_child.py <base_dir> <rounds> <ckpt_at>
+    python tests/_persist_crash_child.py <base_dir> <rounds> <ckpt_at> \
+        [fsync_mode] [fsync_window]
 
 drives all five resident families through ``rounds`` deterministic
 ingest rounds against durable servers under ``<base_dir>/<family>``,
@@ -11,6 +12,12 @@ checkpoints at round ``ckpt_at``, writes ``<base_dir>/READY`` and then
 sleeps — the parent kills it there, BETWEEN launches (per
 docs/RESILIENCE.md rule 1 this is a CPU-mesh process, so SIGKILL
 cannot wedge the axon tunnel; the test never signals a TPU process).
+
+``fsync_mode="group"`` runs the servers in WAL group-commit mode with
+the given window, and appends one line per round to
+``<base_dir>/<family>.progress`` (``round epoch durable_epoch``,
+flushed to the OS) — the parent's oracle for the acked-epoch
+watermark the crash must not lose.
 
 As a module (imported by the parent test): ``make_doc``/``apply_edit``
 regenerate the byte-identical edit stream for the host oracle, and
@@ -131,17 +138,23 @@ def read_oracle(d, family):
     return {c.id: float(c.get_value())}
 
 
-def main(base_dir, rounds, ckpt_at):
+def main(base_dir, rounds, ckpt_at, fsync_mode="per_round", fsync_window=0):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     from loro_tpu.parallel.server import ResidentServer
 
+    group = fsync_mode == "group"
+    kw = {}
+    if group:
+        kw = dict(durable_fsync="group",
+                  fsync_window=fsync_window or 4)
     servers, docs, marks = {}, {}, {}
     for fam in FAMILIES:
         docs[fam] = make_doc(fam)
         servers[fam] = ResidentServer(
-            fam, 1, durable_dir=os.path.join(base_dir, fam), **CAPS[fam]
+            fam, 1, durable_dir=os.path.join(base_dir, fam),
+            **CAPS[fam], **kw,
         )
         marks[fam] = None
     for r in range(1, rounds + 1):
@@ -156,6 +169,13 @@ def main(base_dir, rounds, ckpt_at):
             srv.ingest([chs], container_id(fam, d))
             if r == ckpt_at:
                 srv.checkpoint()
+            if group:
+                # one flushed line per round: the parent's watermark
+                # oracle (flush() reaches the OS, which survives the
+                # SIGKILL; only power loss would need an fsync here)
+                with open(os.path.join(base_dir, fam + ".progress"), "a") as f:
+                    f.write(f"{r} {srv.epoch} {srv.durable_epoch}\n")
+                    f.flush()
     with open(os.path.join(base_dir, "READY"), "w") as f:
         f.write("ready")
     import time
@@ -164,4 +184,8 @@ def main(base_dir, rounds, ckpt_at):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    main(
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+        sys.argv[4] if len(sys.argv) > 4 else "per_round",
+        int(sys.argv[5]) if len(sys.argv) > 5 else 0,
+    )

@@ -12,6 +12,14 @@ bare batches: every round journals to the persist WAL, every third
 epoch checkpoints (rotating + pruning segments), and after the final
 epoch each family is recovered from disk (persist.recover_server) and
 re-gated against the host oracles — bounded replay included.
+
+SOAK_RES_PIPELINE=1 routes every family's ingest through a
+PipelinedIngest executor (round coalescing + stage/commit overlap,
+ISSUE 5): epochs submit asynchronously, the oracle gates run every
+SECOND epoch after a flush (so consecutive epochs actually coalesce
+into one device group), and the coalesced state must still match the
+host oracles byte-for-byte.  Composes with SOAK_RES_DURABLE=1 (the
+pipelined rounds then ride the WAL group-commit window).
 """
 import os
 import os.path as _p
@@ -41,6 +49,7 @@ N = int(os.environ.get("SOAK_RES_DOCS", "6"))
 EPOCHS = int(os.environ.get("SOAK_RES_EPOCHS", "10"))
 SEED = int(os.environ.get("SOAK_RES_SEED", "0"))
 DURABLE = os.environ.get("SOAK_RES_DURABLE", "0") == "1"
+PIPELINE = os.environ.get("SOAK_RES_PIPELINE", "0") == "1"
 
 t0 = time.time()
 rng = random.Random(SEED)
@@ -57,25 +66,36 @@ mesh = make_mesh()
 cid_t = pairs[0][0].get_text("t").id
 cid_ml = pairs[0][0].get_movable_list("ml").id
 cid_tr = pairs[0][0].get_tree("tr").id
-if DURABLE:
+if DURABLE or PIPELINE:
     import shutil
     import tempfile
 
     from loro_tpu.parallel.server import ResidentServer
 
-    _soak_dir = tempfile.mkdtemp(prefix="soak_res_durable_")
+    _soak_dir = tempfile.mkdtemp(prefix="soak_res_durable_") if DURABLE else None
 
     def _srv(fam, **caps):
-        return ResidentServer(
-            fam, N, mesh=mesh, durable_dir=os.path.join(_soak_dir, fam), **caps
-        )
+        kw = {}
+        if DURABLE:
+            kw["durable_dir"] = os.path.join(_soak_dir, fam)
+            if PIPELINE:
+                # pipelined rounds ride the WAL group-commit window
+                kw["durable_fsync"] = "group"
+                kw["fsync_window"] = 4
+        return ResidentServer(fam, N, mesh=mesh, **caps, **kw)
 
     docs_b = _srv("text", capacity=1 << 13)
     maps_b = _srv("map", slot_capacity=128)
     tree_b = _srv("tree", move_capacity=1 << 12, node_capacity=512)
     ctr_b = _srv("counter", slot_capacity=32)
     ml_b = _srv("movable", capacity=1 << 12, elem_capacity=512)
-    print(f"durable mode: journaling to {_soak_dir}")
+    if DURABLE:
+        print(f"durable mode: journaling to {_soak_dir}")
+    if PIPELINE:
+        for _b, _cid in ((docs_b, cid_t), (maps_b, None), (tree_b, cid_tr),
+                         (ctr_b, None), (ml_b, cid_ml)):
+            _b._soak_pipe = _b.pipeline(cid=_cid, coalesce=2, depth=2)
+        print("pipeline mode: coalesced submit, gates every 2nd epoch")
 else:
     docs_b = DeviceDocBatch(N, capacity=1 << 13, mesh=mesh)
     maps_b = DeviceMapBatch(N, slot_capacity=128, mesh=mesh)
@@ -85,7 +105,9 @@ else:
 
 
 def _ingest(b, ups, cid=None):
-    if DURABLE:
+    if PIPELINE:
+        b._soak_pipe.submit(ups)
+    elif DURABLE:
         b.ingest(ups, cid)
     elif cid is not None:
         b.append_changes(ups, cid)
@@ -93,9 +115,15 @@ def _ingest(b, ups, cid=None):
         b.append_changes(ups)
 
 
+def _flush_all():
+    if PIPELINE:
+        for b in (docs_b, maps_b, tree_b, ctr_b, ml_b):
+            b._soak_pipe.flush()
+
+
 def _batch(b):
     """The device batch under either driver (compaction floors)."""
-    return b.batch if DURABLE else b
+    return b.batch if (DURABLE or PIPELINE) else b
 
 
 marks = [a.oplog_vv() for a, _ in pairs]
@@ -170,6 +198,12 @@ for epoch in range(EPOCHS):
     _ingest(tree_b, ups, cid_tr)
     _ingest(ctr_b, ups)
     _ingest(ml_b, ups, cid_ml)
+
+    if PIPELINE and epoch % 2 == 0 and epoch != EPOCHS - 1:
+        # pipeline mode: let consecutive epochs coalesce into one
+        # device group — gates (and compaction) run on flush epochs
+        continue
+    _flush_all()
 
     if epoch % 2 == 1:
         # compaction epochs: every pair is fully synced above, so all

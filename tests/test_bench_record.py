@@ -1,0 +1,156 @@
+"""Flagship-line contract (ISSUE 5 satellite, round-5 verdict): the
+bench's FINAL stdout line must always be compact enough that a
+2,000-char tail window captures every flagship field — verbose notes
+and dict sidecars ride a separate `sidecars_for` line printed before
+it, and the parent's backward scan re-merges the two."""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    """Import bench.py as a module (no jax work happens at import)."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    saved = sys.modules.get("bench_mod")
+    sys.modules["bench_mod"] = mod
+    spec.loader.exec_module(mod)
+    yield mod
+    if saved is not None:
+        sys.modules["bench_mod"] = saved
+    else:
+        sys.modules.pop("bench_mod", None)
+
+
+def _fat_checkpoint():
+    """A checkpoint dict with every field populated and the sidecars
+    deliberately bloated (the round-5 failure mode)."""
+    fat_metrics = {
+        f"fleet.counter_{i}": {"value": i * 1000, "labels": {"family": "text"}}
+        for i in range(60)
+    }
+    return dict(
+        value=5.9e6,
+        metric="ops_merged_per_sec_per_chip (test)",
+        unit="ops/s",
+        device="tpu:v5e",
+        kernel="pallas",
+        place_algo="sort",
+        last_phase="done",
+        elapsed_s=600.0,
+        xla_rank_value=4200000,
+        xla_flight_median=4300000,
+        pallas_flight_median=5900000,
+        merge_latency_ms_p50=80.1,
+        merge_latency_ms_p99=120.9,
+        merge_latency_ms_max=200.0,
+        latency_samples=1024,
+        latency_note="x" * 400,
+        tunnel_rtt_ms=75.0,
+        ring_tokens_per_doc=20000,
+        rank_rounds=15,
+        gather_rows_per_sec=90_000_000,
+        hbm_bytes_per_op_model=12.3,
+        achieved_hbm_gbps_model=400.5,
+        hbm_frac_model=0.49,
+        roofline_note="y" * 500,
+        rank_ms_measured=55.5,
+        place_ms_measured=1.2,
+        gather_rows_per_sec_measured=88_000_000,
+        achieved_hbm_gbps_measured=390.0,
+        hbm_frac=0.48,
+        roofline_measured_note="z" * 500,
+        e2e_value=1_200_000,
+        e2e_unit="ops/s (payload decode -> SoA -> upload -> merge)",
+        e2e_vs_baseline=0.6,
+        e2e_note="w" * 300,
+        resident_rows_per_sec=1_000_000,
+        resident_rows_per_sec_best=1_100_000,
+        resident_note="n" * 400,
+        resident_sync_rows_per_sec=300_000,
+        resident_pipeline_rows_per_sec=500_000,
+        resident_pipeline_speedup=1.67,
+        resident_pipeline_note="p" * 400,
+        pipeline={"rounds": 48, "groups": 6, "overlap_fraction": 0.4,
+                  "stage_s": 1.0, "commit_s": 0.5, "note": "q" * 200},
+        resident_durable_rows_per_sec=90_000,
+        resident_durable_replayed_rounds=2,
+        resident_durable_fsyncs=11,
+        resident_durable_group_fsyncs=4,
+        resident_durable_group_rows_per_sec=120_000,
+        resident_durable_note="d" * 400,
+        richtext_value=2_000_000,
+        richtext_unit="ops/s (concurrent marks+edits merge)",
+        richtext_vs_baseline=1.0,
+        metrics=fat_metrics,
+        resilience={"launches": 100, "retries": 2, "failures": 0,
+                    "note": "r" * 300},
+    )
+
+
+class TestFlagshipLine:
+    def test_final_line_parses_and_fits_budget(self, bench):
+        rec = bench.assemble_record(_fat_checkpoint())
+        flag, side = bench.split_record(rec)
+        line = json.dumps(flag)
+        # the budget a tail window is guaranteed to capture whole
+        assert len(line) <= bench.FLAGSHIP_BUDGET, len(line)
+        back = json.loads(line)  # parses standalone
+        # flagship numerics survive the split
+        for k in ("metric", "value", "unit", "vs_baseline", "device",
+                  "resident_pipeline_speedup", "resident_durable_fsyncs",
+                  "resident_durable_group_fsyncs"):
+            assert k in back, k
+        # verbose prose + dict sidecars moved to the secondary line
+        assert side is not None
+        for k in ("metrics", "resilience", "pipeline", "baseline_note",
+                  "roofline_note", "resident_pipeline_note"):
+            assert k in side, k
+            assert k not in back, k
+        assert side["sidecars_for"] == back["metric"]
+        assert back["sidecars"] == "previous_line"
+
+    def test_emit_order_flagship_last(self, bench, capsys):
+        bench.emit_record(bench.assemble_record(_fat_checkpoint()))
+        out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+        assert len(out) == 2
+        assert "sidecars_for" in json.loads(out[0])
+        last = json.loads(out[-1])
+        assert "metric" in last and "value" in last
+        # the whole point: the LAST 2000 chars contain the full line
+        tail = "\n".join(out)[-2000:]
+        assert json.loads(tail.splitlines()[-1]) == last
+
+    def test_last_json_record_remerges_sidecars(self, bench, tmp_path):
+        rec = bench.assemble_record(_fat_checkpoint())
+        p = tmp_path / "out.jsonl"
+        flag, side = bench.split_record(rec)
+        p.write_text(json.dumps(side) + "\n" + json.dumps(flag) + "\n")
+        merged = bench._last_json_record(str(p))
+        assert merged["metric"] == flag["metric"]
+        assert "metrics" in merged and "resilience" in merged
+        assert "sidecars" not in merged
+
+    def test_small_record_stays_single_line(self, bench, capsys):
+        bench.emit_record({"metric": "m", "value": 1, "unit": "ops/s",
+                           "vs_baseline": 0.5})
+        out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+        assert len(out) == 1
+        assert json.loads(out[0])["metric"] == "m"
+
+    def test_over_budget_numerics_spill_not_core(self, bench):
+        rec = {"metric": "m", "value": 1, "unit": "ops/s",
+               "vs_baseline": 0.5}
+        for i in range(300):
+            rec[f"extra_field_{i:03d}"] = i * 1.5
+        flag, side = bench.split_record(rec)
+        assert len(json.dumps(flag)) <= bench.FLAGSHIP_BUDGET
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            assert k in flag
+        spilled = [k for k in side if k.startswith("extra_field_")]
+        assert spilled  # the overflow went to the sidecar line

@@ -372,3 +372,78 @@ class TestCrashRecovery:
             )
             assert crash.read_server(back, fam) == crash.read_oracle(d, fam), fam
             back.close()
+
+    def test_sigkill_group_commit_recovers_to_watermark(self, tmp_path):
+        """Satellite (ISSUE 5): SIGKILL mid-group-commit-window, then
+        simulate the power-loss the deferred fsync is about to risk by
+        tearing the newest WAL segment's tail — recovery must land AT
+        OR ABOVE the acked-epoch watermark (every fsynced round
+        survives), on an EXACT round boundary (no torn or fabricated
+        rounds), byte-identical to the oracle replayed to that round;
+        and persist.inspect reports the group-commit mode."""
+        import io
+
+        from loro_tpu.persist.inspect import inspect_dir
+
+        ROUNDS, CKPT_AT, WINDOW = 8, 3, 3
+        child = os.path.join(os.path.dirname(__file__), "_persist_crash_child.py")
+        proc = subprocess.Popen(
+            [sys.executable, child, str(tmp_path), str(ROUNDS),
+             str(CKPT_AT), "group", str(WINDOW)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        ready = os.path.join(str(tmp_path), "READY")
+        deadline = time.time() + 180
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"crash child exited early: {proc.stderr.read().decode()[-2000:]}"
+                )
+            if time.time() > deadline:
+                proc.kill()
+                raise AssertionError("crash child never became READY")
+            time.sleep(0.2)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        for fam in FAMILIES:
+            fam_dir = os.path.join(str(tmp_path), fam)
+            # progress oracle: round -> (epoch, durable watermark)
+            prog = []
+            with open(os.path.join(str(tmp_path), fam + ".progress")) as f:
+                for line in f:
+                    r, e, w = line.split()
+                    prog.append((int(r), int(e), int(w)))
+            assert len(prog) == ROUNDS
+            epoch_to_round = {e: r for r, e, _w in prog}
+            watermark = prog[-1][2]
+            # the window is mid-flight at the kill: rounds past the
+            # watermark are journaled but not fsynced
+            assert watermark < prog[-1][1], fam
+            # simulate the power loss: tear the newest segment's tail
+            # (chops into the LAST journaled round's frame)
+            wal_dir = os.path.join(fam_dir, "wal")
+            segs = sorted(
+                n for n in os.listdir(wal_dir) if n.endswith(".log")
+            )
+            newest = os.path.join(wal_dir, segs[-1])
+            with open(newest, "r+b") as f:
+                f.truncate(max(5, os.path.getsize(newest) - 7))
+            back = recover_server(fam_dir)
+            rec_epoch = back.last_recovery.recovered_epoch
+            # 1) at-or-above the acked watermark: fsynced rounds survive
+            assert rec_epoch >= watermark, fam
+            # 2) an exact round boundary: no torn or fabricated rounds
+            assert rec_epoch in epoch_to_round, fam
+            r_star = epoch_to_round[rec_epoch]
+            assert r_star < ROUNDS, fam  # the torn tail really tore
+            # 3) byte-identical to the oracle replayed to that round
+            d = crash.make_doc(fam)
+            for r in range(2, r_star + 1):
+                crash.apply_edit(d, fam, r)
+            assert crash.read_server(back, fam) == crash.read_oracle(d, fam), fam
+            back.close()
+            # 4) inspect reports the group-commit mode (post-recovery:
+            # the torn tail has been truncated away, rc is clean)
+            out = io.StringIO()
+            assert inspect_dir(fam_dir, out=out) == 0
+            assert "fsync=group" in out.getvalue(), fam

@@ -29,6 +29,7 @@ def test_readme_quickstart_executes():
         d.commit()
     ns2 = {
         "payloads": [d.export_updates()[10:] for d in docs],
+        "sync_rounds": [],  # illustrative in the README; empty here
         "container_id": docs[0].get_text("t").id,
         "changes_per_doc": [d.oplog.changes_in_causal_order() for d in docs],
         "cid": docs[0].get_text("t").id,

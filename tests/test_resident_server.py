@@ -158,6 +158,116 @@ class TestResidentServer:
         srv.ack(0, "r", 99)
         assert srv.compact() == 0
 
+    @pytest.mark.parametrize(
+        "family", ["text", "map", "tree", "movable", "counter"]
+    )
+    def test_coalesced_ingest_byte_identical(self, family):
+        """Differential gate (ISSUE 5 satellite): pipelined+coalesced
+        ingest produces BYTE-FOR-BYTE identical batch state and read
+        results vs the serial path, for every resident family.  Rounds
+        are frozen as wire bytes (the journal contract) so change-RLE
+        aliasing cannot blur the comparison."""
+        import random
+
+        from loro_tpu.codec.binary import encode_changes
+
+        rng = random.Random(hash(family) & 0xFFFF)
+        docs = []
+        for i in range(3):
+            d = LoroDoc(peer=100 + 2 * i)
+            d.get_text("t").insert(0, f"diff base {i}")
+            d.get_map("m").set("k", i)
+            d.get_tree("tr").create()
+            d.get_counter("c").increment(i + 1)
+            d.get_movable_list("ml").push("a", "b")
+            d.commit()
+            docs.append(d)
+        cids = {
+            "text": docs[0].get_text("t").id,
+            "tree": docs[0].get_tree("tr").id,
+            "movable": docs[0].get_movable_list("ml").id,
+            "map": None,
+            "counter": None,
+        }
+        marks = [d.oplog_vv() for d in docs]
+        rounds = [[
+            bytes(encode_changes(list(d.oplog.changes_in_causal_order())))
+            for d in docs
+        ]]
+        for r in range(5):
+            ups = []
+            for i, d in enumerate(docs):
+                t = d.get_text("t")
+                L = len(t)
+                if L > 6 and rng.random() < 0.3:
+                    t.delete(rng.randrange(L - 2), 2)
+                else:
+                    t.insert(rng.randint(0, L), rng.choice(["xy", "q "]))
+                if rng.random() < 0.3:
+                    t.mark(0, min(4, len(t)), "bold", True)
+                d.get_map("m").set(rng.choice(["k", "j"]), rng.randrange(50))
+                tr = d.get_tree("tr")
+                nodes = tr.nodes()
+                tr.create(rng.choice(nodes) if nodes and rng.random() < 0.5
+                          else None)
+                d.get_counter("c").increment(rng.randint(-5, 9))
+                ml = d.get_movable_list("ml")
+                L = len(ml)
+                if L >= 2 and rng.random() < 0.4:
+                    ml.move(rng.randrange(L), rng.randrange(L))
+                else:
+                    ml.insert(rng.randint(0, L), f"v{r}")
+                d.commit()
+                ups.append(bytes(encode_changes(
+                    list(d.oplog.changes_between(marks[i], d.oplog_vv()))
+                )))
+                marks[i] = d.oplog_vv()
+            rounds.append(ups)
+        caps = {
+            "text": dict(capacity=1 << 12),
+            "map": dict(slot_capacity=64),
+            "tree": dict(move_capacity=1 << 10, node_capacity=128),
+            "movable": dict(capacity=1 << 10, elem_capacity=128),
+            "counter": dict(slot_capacity=16),
+        }[family]
+        serial = ResidentServer(family, 3, **caps)
+        for ups in rounds:
+            serial.ingest(list(ups), cids[family])
+        co = ResidentServer(family, 3, **caps)
+        eps = co.ingest_coalesced([list(u) for u in rounds], cids[family])
+        assert len(eps) == len(rounds)
+        assert co.batch.export_state() == serial.batch.export_state()
+        # and through the threaded executor as well
+        pl = ResidentServer(family, 3, **caps)
+        ex = pl.pipeline(cid=cids[family], coalesce=4)
+        for ups in rounds:
+            ex.submit(list(ups))
+        ex.flush()
+        assert pl.batch.export_state() == serial.batch.export_state()
+        ex.close()
+        # read results identical (and equal to the host oracle)
+        if family == "text":
+            want = [d.get_text("t").to_string() for d in docs]
+            assert serial.texts() == co.texts() == pl.texts() == want
+            assert serial.richtexts() == co.richtexts() == pl.richtexts()
+        elif family == "map":
+            want = [d.get_map("m").get_value() for d in docs]
+            assert (serial.root_value_maps("m") == co.root_value_maps("m")
+                    == pl.root_value_maps("m") == want)
+        elif family == "tree":
+            want = [
+                {x: d.get_tree("tr").parent(x) for x in d.get_tree("tr").nodes()}
+                for d in docs
+            ]
+            assert (serial.parent_maps() == co.parent_maps()
+                    == pl.parent_maps() == want)
+        elif family == "movable":
+            want = [d.get_movable_list("ml").get_value() for d in docs]
+            assert (serial.value_lists() == co.value_lists()
+                    == pl.value_lists() == want)
+        else:
+            assert serial.value_maps() == co.value_maps() == pl.value_maps()
+
     def test_movable_family_end_to_end(self):
         doc = LoroDoc(peer=3)
         ml = doc.get_movable_list("m")

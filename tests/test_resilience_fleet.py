@@ -417,6 +417,73 @@ class TestResidentDegradationAndRecovery:
         assert not srv2.degraded
         assert srv2.texts()[0] == a.get_text("t").to_string()
 
+    def test_coalesced_group_failure_degrades_with_staged_rounds(
+        self, fake_sleep_supervisor
+    ):
+        """Satellite (ISSUE 5): a device failure on coalesced group N
+        while group N+1 is already staged degrades cleanly — the host
+        mirror answers, and BOTH groups' rounds replay in order (group
+        N via the degradation mirror seed, group N+1 via the
+        degraded-replay commit), byte-identical to the oracle."""
+        a, _ = _mk_pair("text", i=30)
+        cid = a.get_text("t").id
+        srv = ResidentServer("text", 1, capacity=1 << 12)
+        mark = a.oplog_vv()
+        rounds = [[strip_envelope(a.export_updates({}))]]
+        for s in range(5):
+            a.get_text("t").insert(0, f"g{s} ")
+            a.commit()
+            rounds.append([strip_envelope(a.export_updates(mark))])
+            mark = a.oplog_vv()
+        want = a.get_text("t").to_string()
+        n0 = obs.counter("server.degraded_rounds_total").get(family="text")
+        ex = srv.pipeline(cid=cid, coalesce=3, depth=2)
+        _fatal(times=1)  # first supervised launch = group 1's commit
+        try:
+            prs = [ex.submit(list(r)) for r in rounds]
+            ex.flush()
+        finally:
+            faultinject.clear()
+        epochs = [p.epoch() for p in prs]
+        assert epochs == sorted(epochs)  # per-round acks stay monotone
+        assert srv.degraded
+        assert srv.texts()[0] == want  # every staged round replayed
+        assert obs.counter("server.degraded_rounds_total").get(
+            family="text") == n0 + len(rounds)
+        ex.close()
+        # in-place recovery replays the journal back onto a device batch
+        assert srv.recover()
+        assert not srv.degraded
+        assert srv.batch.texts()[0] == want
+
+    def test_coalesced_poison_round_isolates(self, fake_sleep_supervisor):
+        """A poison round INSIDE a coalesced group: earlier rounds
+        commit as one group, the poison round isolates per doc (typed
+        record, no raise), later rounds still apply — and the device
+        never degrades."""
+        a, _ = _mk_pair("text", i=31)
+        cid = a.get_text("t").id
+        srv = ResidentServer("text", 1, capacity=1 << 12)
+        mark = a.oplog_vv()
+        good1 = [strip_envelope(a.export_updates({}))]
+        poison = [b"\x07garbage-not-a-payload"]  # undecodable round
+        a.get_text("t").insert(0, "kept ")
+        a.commit()
+        good2 = [strip_envelope(a.export_updates(mark))]
+        n0 = obs.counter("server.poison_docs_total").get(family="text")
+        epochs = srv.ingest_coalesced([good1, poison, good2], cid)
+        assert len(epochs) == 3
+        assert not srv.degraded
+        assert srv.last_poison_docs == [0]
+        assert obs.counter("server.poison_docs_total").get(
+            family="text") == n0 + 1
+        # the poison round's delta (salt=40) is lost with its bytes;
+        # good1 + good2 applied — mirror that on a fresh oracle server
+        oracle = ResidentServer("text", 1, capacity=1 << 12)
+        oracle.ingest(good1, cid)
+        oracle.ingest(good2, cid)
+        assert srv.texts() == oracle.texts()
+
     def test_restored_server_without_anchor_is_typed(self,
                                                      fake_sleep_supervisor):
         """host_fallback=False servers embed no anchor: their restored

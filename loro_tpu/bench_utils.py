@@ -22,11 +22,45 @@ SYN_CACHE_PATH = os.path.join(
     os.path.dirname(__file__), "..", ".bench_cache_automerge_syn.npz"
 )
 
+# Extract-cache schema version.  Bump whenever the SeqExtract layout or
+# the chain/run extraction semantics feeding it change: a cache written
+# before such a change must be REBUILT, not mis-decoded (loads check the
+# tag and fall through to regeneration on mismatch — including caches
+# from before the tag existed).
+CACHE_SCHEMA = 2
+
 # flips to True when load_automerge_patches had to synthesize a trace
 # (no /root/reference checkout and no committed cache in this image);
 # bench.py tags its record so synthetic-trace numbers never get
 # compared against real-trace rounds
 SYNTHETIC_FALLBACK = False
+
+
+def _load_extract_cache(path: str):
+    """SeqExtract + n_ops from an npz cache, or None when the cache is
+    absent, carries a stale/missing schema tag, or is unreadable (a
+    bench child killed mid-savez leaves a truncated zip — rebuild and
+    overwrite instead of crashing every later run)."""
+    from .ops.columnar import SeqExtract
+
+    if not os.path.exists(path):
+        return None
+    try:
+        z = np.load(path)
+        if "schema" not in z.files or int(z["schema"]) != CACHE_SCHEMA:
+            return None
+        return SeqExtract(
+            parent=z["parent"],
+            side=z["side"],
+            peer=z["peer"],
+            counter=z["counter"],
+            deleted=z["deleted"],
+            content=z["content"],
+            valid=z["valid"],
+            peers=[int(p) for p in z["peers"]],
+        ), int(z["n_ops"])
+    except Exception:
+        return None
 
 
 def _synthetic_patches(limit: Optional[int]) -> List[Tuple[int, int, str]]:
@@ -99,18 +133,10 @@ def automerge_seq_extract(limit: Optional[int] = None, use_cache: bool = True):
         cache = SYN_CACHE_PATH
         global SYNTHETIC_FALLBACK
         SYNTHETIC_FALLBACK = True  # even on a cache hit: tag the record
-    if use_cache and cache and os.path.exists(cache):
-        z = np.load(cache)
-        return SeqExtract(
-            parent=z["parent"],
-            side=z["side"],
-            peer=z["peer"],
-            counter=z["counter"],
-            deleted=z["deleted"],
-            content=z["content"],
-            valid=z["valid"],
-            peers=[int(p) for p in z["peers"]],
-        ), int(z["n_ops"])
+    if use_cache and cache:
+        hit = _load_extract_cache(cache)
+        if hit is not None:
+            return hit
 
     patches, _ = load_automerge_patches(limit=limit)
     doc = LoroDoc(peer=1)
@@ -136,6 +162,7 @@ def automerge_seq_extract(limit: Optional[int] = None, use_cache: bool = True):
             valid=ex.valid,
             peers=np.asarray(ex.peers, np.uint64),
             n_ops=n_ops,
+            schema=np.int64(CACHE_SCHEMA),
         )
     return ex, n_ops
 

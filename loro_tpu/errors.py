@@ -20,6 +20,23 @@ class CodecDecodeError(DecodeError, ValueError):
     """
 
 
+class ConfigError(LoroError, ValueError):
+    """Invalid tuning-knob environment value (RANK_ALGO, PALLAS_RANK_ALGO,
+    PLACE_ALGO, PALLAS_RULING_K, RANK_BLOCK, ...), raised at FIRST USE
+    (trace time) with the accepted values/range spelled out — never a
+    silent fall-back to the default algorithm.
+
+    Subclasses ValueError so pre-existing ``except ValueError`` guards
+    (and tests) keep working.
+    """
+
+    def __init__(self, knob: str, got: object, accepted: str):
+        self.knob = knob
+        self.got = got
+        self.accepted = accepted
+        super().__init__(f"{knob}={got!r} invalid: accepted {accepted}")
+
+
 class PersistError(LoroError):
     """Durability-layer failure (loro_tpu/persist/): a WAL append or
     checkpoint write did not reach disk, or a durable directory is in a

@@ -66,18 +66,42 @@ def rank_bound(n: int) -> int:
     return 2 * (n + 1)
 
 
+RANK_ALGOS = ("wyllie", "ruling", "blocked", "coalesced")
+
+
 def _rank_algo() -> str:
-    """Ranking algorithm: "wyllie" (default) or "ruling" (two-level
-    ruling-set; ~2x fewer gather rows in expectation, adaptive round
-    count — opt-in via RANK_ALGO=ruling until TPU-profiled).  Read at
+    """XLA ranking algorithm (RANK_ALGO): "wyllie" (default), "ruling"
+    (two-level ruling-set; ~2x fewer gather rows in expectation),
+    "blocked" (phase-A block-local doubling + phase-B weighted ruling
+    over the exit graph) or "coalesced" (run-coalesce the ring, rank
+    the contracted super-node ring, expand by cumsum/scatter).  Read at
     TRACE time: set it before the first merge call of the process
     (already-jitted kernels do not retrace on env changes)."""
-    import os
+    from ..errors import ConfigError
 
     algo = os.environ.get("RANK_ALGO", "wyllie")
-    if algo not in ("wyllie", "ruling"):
-        raise ValueError(f"RANK_ALGO must be 'wyllie' or 'ruling', got {algo!r}")
+    if algo not in RANK_ALGOS:
+        raise ConfigError("RANK_ALGO", algo, "|".join(RANK_ALGOS))
     return algo
+
+
+def _rank_block() -> int:
+    """Block size (tokens) for the blocked two-level rank (RANK_BLOCK,
+    default 1024): phase A ranks inside blocks of this many tokens with
+    block-local gathers only.  Power of two, multiple of 128, in
+    [128, 65536] (the 128-lane alignment the pallas twin needs)."""
+    from ..errors import ConfigError
+
+    raw = os.environ.get("RANK_BLOCK", "1024")
+    try:
+        b = int(raw)
+    except ValueError:
+        b = -1
+    if not (128 <= b <= 65536) or (b & (b - 1)) != 0:
+        raise ConfigError(
+            "RANK_BLOCK", raw, "a power of two in [128, 65536]"
+        )
+    return b
 
 
 def _double(T: jax.Array, n_steps: int) -> jax.Array:
@@ -101,9 +125,14 @@ def _wyllie_dist(succ: jax.Array) -> jax.Array:
     return T[:, 0]
 
 
-def make_ring_rank_sharded(mesh, m: int):
+def make_ring_rank_sharded(mesh, m: int, algo: str = "wyllie"):
     """Op-axis-sharded Wyllie ranking (SURVEY.md §2.4 item 2 for the
     sequence kernel): succ [D, m] sharded P(docs, ops) -> dist [D, m].
+    algo="blocked" prepends a SHARD-LOCAL phase A (freeze-at-shard-exit
+    doubling, zero collectives) and makes the all_gather doubling
+    adaptive (early exit when every pointer rests on a terminal — rings
+    with shard locality then pay far fewer all_gather rounds; the
+    round cap keeps arbitrary rings exact).
 
     Each op-shard owns m/S contiguous ring rows; every doubling round
     all_gathers the (dist, succ) row table along the op axis and updates
@@ -124,6 +153,10 @@ def make_ring_rank_sharded(mesh, m: int):
 
     from ..parallel.mesh import DOC_AXIS, OP_AXIS
 
+    if algo not in ("wyllie", "blocked"):
+        from ..errors import ConfigError
+
+        raise ConfigError("sharded rank algo", algo, "wyllie|blocked")
     n_steps = max(1, int(np.ceil(np.log2(max(m, 2)))))
 
     def local(succ_sh: jax.Array) -> jax.Array:  # [d_local, ms] global ids
@@ -133,22 +166,74 @@ def make_ring_rank_sharded(mesh, m: int):
         dist0 = jnp.where(succ_sh == tok, 0, 1).astype(jnp.int32)
         T = jnp.stack([dist0, succ_sh], axis=-1)  # [d, ms, 2]
 
-        def body(_, T):
+        if algo == "blocked":
+            # phase A: collapse in-shard chains without touching ICI —
+            # a pointer composes only while its target is a LOCAL row
+            def body_a(_, T):
+                t = T[:, :, 1]
+                lt = t - tok0
+                in_shard = (lt >= 0) & (lt < ms) & (t != tok)
+                lt = jnp.clip(lt, 0, ms - 1)
+                g = jnp.take_along_axis(T, lt[:, :, None], axis=1)
+                return jnp.stack(
+                    [
+                        jnp.where(in_shard, T[:, :, 0] + g[:, :, 0], T[:, :, 0]),
+                        jnp.where(in_shard, g[:, :, 1], T[:, :, 1]),
+                    ],
+                    axis=-1,
+                )
+
+            T = jax.lax.fori_loop(
+                0, max(1, int(np.ceil(np.log2(max(ms, 2))))), body_a, T
+            )
+
+        def gather_step(T):
             T_full = jax.lax.all_gather(T, OP_AXIS, axis=1, tiled=True)  # [d, m, 2]
             g = jax.vmap(lambda full, t: jnp.take(full, t, axis=0))(
                 T_full, T[:, :, 1]
             )  # [d, ms, 2]: (dist[t], succ[t])
             return jnp.stack([T[:, :, 0] + g[:, :, 0], g[:, :, 1]], axis=-1)
 
-        T = jax.lax.fori_loop(0, n_steps, body, T)
+        if algo == "blocked":
+            # adaptive all_gather doubling: T stabilizes exactly when
+            # every pointer rests on a terminal (terminals are the only
+            # fixpoint rows), so comparing post- vs pre-update targets
+            # detects completion with ZERO extra gathers (one round
+            # later than a lookahead check, but gathers are the cost
+            # being minimized); agreement psum'd across the op shards
+            def body(carry):
+                i, T, _done = carry
+                T_new = gather_step(T)
+                local_done = jnp.all(T_new[:, :, 1] == T[:, :, 1])
+                done = (
+                    jax.lax.psum((~local_done).astype(jnp.int32), OP_AXIS) == 0
+                )
+                return i + 1, T_new, done
+
+            def cond(carry):
+                i, _T, done = carry
+                return (i < n_steps) & ~done
+
+            _, T, _ = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), T, jnp.bool_(False))
+            )
+        else:
+            T = jax.lax.fori_loop(0, n_steps, lambda _, T: gather_step(T), T)
         return T[:, :, 0]
 
+    kw = {}
+    if algo == "blocked":
+        # shard_map has no replication rule for while_loop; the adaptive
+        # loop's outputs are explicitly sharded, so the check is safely
+        # skipped
+        kw["check_rep"] = False
     return jax.jit(
         shard_map(
             local,
             mesh=mesh,
             in_specs=(P(DOC_AXIS, OP_AXIS),),
             out_specs=P(DOC_AXIS, OP_AXIS),
+            **kw,
         )
     )
 
@@ -167,12 +252,26 @@ def _ruling_dist(succ: jax.Array, k: int = 8) -> jax.Array:
     and keep dist 0)."""
     m = succ.shape[0]
     tok = jnp.arange(m, dtype=jnp.int32)
+    d0 = jnp.where(succ == tok, 0, 1).astype(jnp.int32)
+    return _ruling_dist_from(d0, succ, k=k)
+
+
+def _ruling_dist_from(d0: jax.Array, t0: jax.Array, k: int = 8) -> jax.Array:
+    """Ruling-set ranking from a generic WEIGHTED pointer state:
+    dist(i) = d0[i] + dist(t0[i]), terminal nodes are self-loops with
+    d0 == 0.  This is the ruling machinery the blocked and coalesced
+    paths compose with (their phase-A / contraction output is exactly
+    such a weighted state); _ruling_dist is the unit-weight wrapper.
+    The phase-1 round cap stays exact for arbitrary states: after
+    ceil(log2(m)) doublings every pointer rests on a terminal."""
+    m = t0.shape[0]
+    tok = jnp.arange(m, dtype=jnp.int32)
+    succ = t0
     is_term = succ == tok
     is_ruler = (tok % k) == 0
     is_stop = is_ruler | is_term
 
-    d0 = jnp.where(is_term, 0, 1).astype(jnp.int32)
-    T0 = jnp.stack([d0, succ], axis=1)  # (dist-to-target, target)
+    T0 = jnp.stack([d0.astype(jnp.int32), succ], axis=1)  # (dist, target)
     frozen0 = is_term | is_stop[succ]
     max_rounds = max(1, int(np.ceil(np.log2(max(m, 2)))))
 
@@ -209,6 +308,158 @@ def _ruling_dist(succ: jax.Array, k: int = 8) -> jax.Array:
     return d1 + R[:, 0][dense(t1)]
 
 
+def _blocked_dist(succ: jax.Array, block: Optional[int] = None) -> jax.Array:
+    """Blocked two-level ranking (the XLA twin of the pallas blocked
+    kernel; RANK_ALGO=blocked).
+
+    Phase A collapses every in-block pointer chain by doubling that
+    FREEZES at block exits: a pointer composes with its target only
+    while the target sits in the same `block`-token block, so every
+    gather is a within-block take_along_axis on the [n_blocks, block]
+    reshape (contiguous block-local rows — never a random full-ring
+    HBM gather).  After ceil(log2(block)) rounds each token holds
+    (d, t) with t its first out-of-block stop or an in-block terminal.
+
+    Phase B ranks the resulting weighted exit graph with the ruling-set
+    machinery (_ruling_dist_from); its round cap keeps the result exact
+    on rings with no block locality (the exit graph then is nearly the
+    original ring).  O(n log b) block-local + O(adaptive·n + (n/k)
+    log(n/k)) global gather rows vs O(n log n) global for Wyllie."""
+    m = succ.shape[0]
+    b = block if block is not None else _rank_block()
+    # clamp the block to the lane-padded ring: a block bigger than the
+    # ring only inflates the [nb, b] pad that phase B then pays for
+    b = min(b, max(128, -(-m // 128) * 128))
+    mp = -(-m // b) * b
+    if mp != m:
+        pad_ids = jnp.arange(m, mp, dtype=jnp.int32)
+        succ = jnp.concatenate([succ.astype(jnp.int32), pad_ids])
+    nb = mp // b
+    tok2 = jnp.arange(mp, dtype=jnp.int32).reshape(nb, b)
+    base = (jnp.arange(nb, dtype=jnp.int32) * b)[:, None]
+    T = succ.reshape(nb, b)
+    D = jnp.where(T == tok2, 0, 1).astype(jnp.int32)
+    n_a = max(1, int(np.ceil(np.log2(max(b, 2)))))
+
+    def body(_, carry):
+        D, T = carry
+        lt = T - base
+        in_blk = (lt >= 0) & (lt < b)
+        active = in_blk & (T != tok2)
+        lt = jnp.clip(lt, 0, b - 1)
+        gd = jnp.take_along_axis(D, lt, axis=1)
+        gt = jnp.take_along_axis(T, lt, axis=1)
+        return jnp.where(active, D + gd, D), jnp.where(active, gt, T)
+
+    D, T = jax.lax.fori_loop(0, n_a, body, (D, T))
+    return _ruling_dist_from(D.reshape(mp), T.reshape(mp))[:m]
+
+
+def ring_run_heads(succ: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(is_head bool[m], n_runs): maximal index-consecutive successor
+    runs.  Token j is absorbed into its predecessor's run iff
+    succ[j-1] == j, j is j's ONLY predecessor, and j is not a terminal
+    self-loop — which guarantees (a) runs are index intervals and (b) a
+    run tail's successor is always some run's head, so the contracted
+    super-node ring is well formed.  The slot-numbered Euler ring
+    (_order_core) is laid out so that real traces produce long runs
+    here (leaf ENTER->EXIT pairs, sibling groups, chained pads)."""
+    m = succ.shape[0]
+    tok = jnp.arange(m, dtype=jnp.int32)
+    indeg = jnp.zeros(m, jnp.int32).at[succ].add(1)
+    is_term = succ == tok
+    absorbed = (
+        jnp.concatenate([jnp.zeros(1, bool), succ[:-1] == tok[1:]])
+        & (indeg == 1)
+        & ~is_term
+    )
+    is_head = ~absorbed
+    return is_head, is_head.sum().astype(jnp.int32)
+
+
+def _coalesced_dist(
+    succ: jax.Array,
+    r_pad: Optional[int] = None,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Run-coalesced ranking (RANK_ALGO=coalesced): contract maximal
+    successor runs into super-nodes, rank the contracted ring (weighted
+    ruling set, or the weighted pallas kernel when use_pallas), then
+    expand ranks back to tokens with one scatter + one cumsum — no
+    per-token gather.
+
+    `r_pad` is the STATIC contracted-ring budget.  The default (r_pad =
+    m, rounded to lanes) is always safe (n_runs <= m) but saves only
+    round count; callers that know their ring statistics (bench does,
+    via rank_model.ring_stats) pass a tight budget for the full
+    gather-row reduction.  OVERFLOW IS NOT DETECTED HERE: with
+    r_pad < n_runs the result is garbage — callers passing a tight
+    budget own the check (ring_run_heads / host ring_stats), exactly
+    like the c_pad/n_chains contract of chain_contract_materialize_u."""
+    m = succ.shape[0]
+    r = r_pad if r_pad is not None else m
+    r = max(128, -(-r // 128) * 128)
+    tok = jnp.arange(m, dtype=jnp.int32)
+    is_head, n_runs = ring_run_heads(succ)
+    run_id = jnp.cumsum(is_head.astype(jnp.int32)) - 1  # token -> run
+    # compact head/tail token tables [r] (+ sink slot r for the ruling
+    # sub-rank: terminal runs edge to it, matching its dense-ring idiom)
+    rid_clip = jnp.where(is_head, jnp.minimum(run_id, r), r)
+    head_tok = (
+        jnp.full(r + 1, 0, jnp.int32).at[rid_clip].set(tok, mode="drop")[:r]
+    )
+    ridx = jnp.arange(r, dtype=jnp.int32)
+    valid_run = ridx < n_runs
+    nxt_head = jnp.concatenate([head_tok[1:], jnp.array([m], jnp.int32)])
+    tail_tok = jnp.where(ridx + 1 < n_runs, nxt_head, m) - 1
+    tail_tok = jnp.where(valid_run, tail_tok, head_tok)
+    succ_tail = succ[jnp.clip(tail_tok, 0, m - 1)]
+    is_term_run = succ_tail == tail_tok
+    w = jnp.where(
+        valid_run,
+        (tail_tok - head_tok) + jnp.where(is_term_run, 0, 1),
+        0,
+    ).astype(jnp.int32)
+    t = jnp.where(
+        valid_run & ~is_term_run,
+        run_id[jnp.clip(succ_tail, 0, m - 1)],
+        jnp.where(valid_run, r, ridx),  # terminal runs -> sink; pads self
+    ).astype(jnp.int32)
+    w1 = jnp.concatenate([w, jnp.zeros(1, jnp.int32)])
+    t1 = jnp.concatenate([t, jnp.array([r], jnp.int32)])  # sink self-loop
+    if use_pallas:
+        from .pallas_rank import PALLAS_RANK_MAX_M, _LANES, wyllie_rank
+
+        # contracted ring is r+1 tokens (sink slot): lane-pad must stay
+        # within the VMEM cap (the default budget r = round128(m) makes
+        # r+1 overflow it for m at the cap itself) — fall back to the
+        # XLA weighted ruling rather than raise for a ring the
+        # applicability gate approved
+        if -(-(r + 1) // _LANES) * _LANES > PALLAS_RANK_MAX_M:
+            use_pallas = False
+    if use_pallas:
+        # dist_bound = m: contracted distances are pre-contraction step
+        # counts, so a short super-node ring from a long ring must still
+        # take the wide (i32) kernel
+        D = wyllie_rank(t1, weights=w1, dist_bound=m)[:r]
+    else:
+        D = _ruling_dist_from(w1, t1)[:r]
+    # expansion: dist[tok] = D[run] - (tok - head_tok[run]); runs are
+    # index intervals with ascending ids, so one telescoped scatter at
+    # head tokens + a cumsum reconstructs D[run] + head_tok[run] per
+    # token exactly (int32 wraparound-safe, same trick as
+    # _place_by_chain_sort) — no per-token gather.
+    val = jnp.where(valid_run, D + head_tok, 0)
+    prev = jnp.concatenate([jnp.zeros(1, jnp.int32), val[:-1]])
+    delta = jnp.where(valid_run, val - prev, 0)
+    seg = (
+        jnp.zeros(m + 1, jnp.int32)
+        .at[jnp.where(valid_run, head_tok, m)]
+        .add(delta, mode="drop")[:m]
+    )
+    return jnp.cumsum(seg) - tok
+
+
 def fugue_order(cols: SeqColumns) -> jax.Array:
     """Return rank i32[N]: a key whose ascending order is the in-order
     position of each element in the Fugue traversal (keys may have gaps;
@@ -222,19 +473,86 @@ def fugue_order(cols: SeqColumns) -> jax.Array:
     return _order_core(cols.parent, cols.side, cols.valid)
 
 
-def _order_core(
+def _resolve_rank_spec(rank_impl: Optional[str], m: int) -> Tuple[str, str]:
+    """(backend, algo) for a ring of m tokens.  `rank_impl` accepts the
+    legacy "pallas" / "xla" (algo from the PALLAS_RANK_ALGO / RANK_ALGO
+    env) plus explicit "<backend>:<algo>" specs — phased bench runs and
+    differential tests need several algorithms jitted in ONE process,
+    and env knobs bake at trace time.  Precedence with rank_impl=None
+    (auto): pallas when applicable and the XLA algo knob is untouched
+    (an explicit RANK_ALGO keeps algo comparisons honest), but an
+    explicit PALLAS_RANK=1 beats everything."""
+    from ..errors import ConfigError
+    from .pallas_rank import PALLAS_RANK_ALGOS, pallas_rank_applicable
+
+    if rank_impl is not None and ":" in rank_impl:
+        backend, algo = rank_impl.split(":", 1)
+        ok = (backend == "xla" and algo in RANK_ALGOS) or (
+            backend == "pallas" and algo in PALLAS_RANK_ALGOS + ("coalesced",)
+        )
+        if not ok:
+            raise ValueError(
+                f"rank_impl spec must be xla:{{{'|'.join(RANK_ALGOS)}}} or "
+                f"pallas:{{{'|'.join(PALLAS_RANK_ALGOS + ('coalesced',))}}}, "
+                f"got {rank_impl!r}"
+            )
+        return backend, algo
+    if rank_impl == "pallas":
+        from .pallas_rank import _pallas_rank_algo
+
+        return "pallas", _pallas_rank_algo()
+    if rank_impl == "xla":
+        return "xla", _rank_algo()
+    if rank_impl is not None:
+        raise ValueError(
+            f"rank_impl must be pallas|xla|<backend>:<algo>|None, got {rank_impl!r}"
+        )
+    algo = _rank_algo()
+    explicit_pallas = os.environ.get("PALLAS_RANK", "") not in ("", "0")
+    if pallas_rank_applicable(m) and (algo == "wyllie" or explicit_pallas):
+        if algo == "coalesced":
+            # coalesced + PALLAS_RANK=1: pallas sub-rank of the
+            # contracted ring
+            return "pallas", "coalesced"
+        # the pallas kernel's own algo knob picks the kernel variant
+        from .pallas_rank import _pallas_rank_algo
+
+        return "pallas", _pallas_rank_algo()
+    return "xla", algo
+
+
+def _rank_dist(
+    succ: jax.Array,
+    backend: str,
+    algo: str,
+    ring_budget: Optional[int] = None,
+) -> jax.Array:
+    """Distance-to-terminal of a successor ring under a resolved
+    (backend, algo) spec — the single ranking dispatch point."""
+    if algo == "coalesced":
+        return _coalesced_dist(succ, ring_budget, use_pallas=backend == "pallas")
+    if backend == "pallas":
+        from .pallas_rank import wyllie_rank
+
+        return wyllie_rank(succ, algo=algo)
+    if algo == "ruling":
+        return _ruling_dist(succ)
+    if algo == "blocked":
+        return _blocked_dist(succ)
+    return _wyllie_dist(succ)
+
+
+def _ring_and_anchors(
     parent_in: jax.Array,
     side_in: jax.Array,
     valid_in: jax.Array,
     sib_keys: Optional[Tuple[jax.Array, ...]] = None,
-    rank_impl: Optional[str] = None,
-) -> jax.Array:
-    """Euler-tour in-order ranking over generic node arrays (element- or
-    chain-level).  Without `sib_keys`, rows must obey the (peer, counter)
-    order contract (fugue_order); with `sib_keys` (e.g. peer_hi, peer_lo,
-    counter arrays) sibling order comes from an explicit lexsort instead
-    — row order becomes irrelevant, which the incremental/append path
-    needs (appended rows land at the end of the buffer)."""
+) -> Tuple[jax.Array, jax.Array]:
+    """(succ i32[2*(n+1)], anchor i32[n+1]) — the Euler-tour successor
+    ring and each node's in-order anchor token (the virtual root at
+    element index n).  Split from _order_core so tests can diff the
+    in-jit ring against the host mirror (ops.rank_model.build_ring,
+    which must stay in lockstep with this function)."""
     n = parent_in.shape[0]
     n1 = n + 1
     root = n  # virtual root element index
@@ -292,58 +610,79 @@ def _order_core(
     #             (post_L(p) = ENTER(first_r[p]) if has_r[p] else EXIT(p))
     #          -> EXIT(parent[e])     if last sibling and side==R
     # EXIT(root) -> itself (ring terminal)
-    ENTER0, EXIT0 = 0, n1
+    #
+    # TOKEN NUMBERING: tokens are numbered by sibling-sort SLOT, not by
+    # element row — ENTER(e) = slot[e], EXIT(e) = m-1-slot[e].  Real
+    # traces then put consecutive ring steps at consecutive token
+    # indices (a leaf run ENTER(c1)..EXIT(ck) walks slots s, s+1, ...
+    # on the way in and mirrored indices on the way out; invalid
+    # elements all sort into one contiguous slot range and chain below)
+    # — exactly the index-adjacency ring_run_heads contracts.  Any
+    # bijective numbering yields the same ORDER (ranks are compared,
+    # never interpreted), so correctness is layout-free.
     m = 2 * n1
+    slot = jnp.zeros(n1, jnp.int32).at[order].set(jnp.arange(n1, dtype=jnp.int32))
+    ent = slot  # [n1] token id of ENTER(e)
+    ext = (m - 1) - slot  # [n1] token id of EXIT(e)
     e_ids = jnp.arange(n1, dtype=jnp.int32)
-    post_l = jnp.where(has_r, ENTER0 + first_r, EXIT0 + e_ids)  # [n1]
-    succ_enter = jnp.where(has_l, ENTER0 + first_l, post_l)
+    post_l = jnp.where(has_r, ent[jnp.clip(first_r, 0, n)], ext[e_ids])  # [n1]
+    succ_enter = jnp.where(has_l, ent[jnp.clip(first_l, 0, n)], post_l)
     par = jnp.where(parent < big, parent, root).astype(jnp.int32)
     succ_exit = jnp.where(
         has_next_sib,
-        ENTER0 + next_sib,
-        jnp.where(side == 0, post_l[par], EXIT0 + par),
+        ent[jnp.clip(next_sib, 0, n)],
+        jnp.where(side == 0, post_l[par], ext[par]),
     )
-    succ_exit = succ_exit.at[root].set(EXIT0 + root)  # terminal self-loop
-    succ = jnp.concatenate([succ_enter, succ_exit]).astype(jnp.int32)
+    succ_exit = succ_exit.at[root].set(ext[root])  # terminal self-loop
+    # token layout: first half = ENTER tokens in slot order, second
+    # half = EXIT tokens in REVERSE slot order (ext = m-1-slot)
+    succ = jnp.concatenate(
+        [succ_enter[order], jnp.flip(succ_exit[order])]
+    ).astype(jnp.int32)
 
-    # invalid elements: make their tokens tight self-loops so they don't
-    # perturb the ring (they are unreachable from the root anyway)
-    tok_valid = jnp.concatenate([valid, valid])
+    # invalid elements: chain their tokens by index (one coalescable
+    # run per contiguous range instead of per-token self-loops; their
+    # distances are never read — ranks of invalid rows are overwritten
+    # below).  The ring-proper tokens keep their successors.
+    tok_valid = jnp.concatenate([valid[order], jnp.flip(valid[order])])
     tok_ids = jnp.arange(m, dtype=jnp.int32)
-    succ = jnp.where(tok_valid | (tok_ids == EXIT0 + root), succ, tok_ids)
-    # root ENTER is a valid ring member:
-    succ = succ.at[ENTER0 + root].set(succ_enter[root])
-
-    # -- Wyllie list ranking: distance to terminal --------------------
-    from .pallas_rank import pallas_rank_applicable, wyllie_rank
-
-    # precedence: an explicit rank_impl argument (phased bench runs need
-    # both paths jitted in one process — env knobs bake at trace time)
-    # beats env; then an explicit RANK_ALGO=ruling beats the auto-on
-    # pallas default (so algo comparisons stay honest), but an explicit
-    # PALLAS_RANK=1 beats everything
-    explicit_pallas = os.environ.get("PALLAS_RANK", "") not in ("", "0")
-    if rank_impl == "pallas":
-        dist = wyllie_rank(succ)
-    elif rank_impl == "xla":
-        dist = _ruling_dist(succ) if _rank_algo() == "ruling" else _wyllie_dist(succ)
-    elif rank_impl is not None:
-        raise ValueError(f"rank_impl must be pallas|xla|None, got {rank_impl!r}")
-    elif pallas_rank_applicable(int(succ.shape[0])) and (
-        _rank_algo() != "ruling" or explicit_pallas
-    ):
-        # VMEM-resident pointer doubling (default on TPU; falls back to
-        # the XLA formulation for rings too long for the rotate loop)
-        dist = wyllie_rank(succ)
-    elif _rank_algo() == "ruling":
-        dist = _ruling_dist(succ)
-    else:
-        dist = _wyllie_dist(succ)
+    chain_next = jnp.minimum(tok_ids + 1, m - 1)
+    keep = tok_valid | (tok_ids == ext[root]) | (tok_ids == ent[root])
+    succ = jnp.where(keep, succ, chain_next)
+    # root tokens: ENTER is a valid ring member, EXIT the terminal
+    succ = succ.at[ent[root]].set(succ_enter[root])
+    succ = succ.at[ext[root]].set(ext[root])
 
     # in-order anchor: EXIT(last L-child) when L-children exist, else
     # the node's own ENTER; anchors are distinct tokens, so their ring
     # distances order elements exactly (larger distance = earlier)
-    anchor = jnp.where(has_l, EXIT0 + last_l, ENTER0 + e_ids)  # [n1]
+    anchor = jnp.where(has_l, ext[jnp.clip(last_l, 0, n)], ent[e_ids])  # [n1]
+    return succ, anchor
+
+
+def _order_core(
+    parent_in: jax.Array,
+    side_in: jax.Array,
+    valid_in: jax.Array,
+    sib_keys: Optional[Tuple[jax.Array, ...]] = None,
+    rank_impl: Optional[str] = None,
+    ring_budget: Optional[int] = None,
+) -> jax.Array:
+    """Euler-tour in-order ranking over generic node arrays (element- or
+    chain-level).  Without `sib_keys`, rows must obey the (peer, counter)
+    order contract (fugue_order); with `sib_keys` (e.g. peer_hi, peer_lo,
+    counter arrays) sibling order comes from an explicit lexsort instead
+    — row order becomes irrelevant, which the incremental/append path
+    needs (appended rows land at the end of the buffer)."""
+    n = parent_in.shape[0]
+    root = n
+    big = jnp.int32(2**30)
+    succ, anchor = _ring_and_anchors(parent_in, side_in, valid_in, sib_keys)
+
+    # -- list ranking: distance to terminal ---------------------------
+    backend, algo = _resolve_rank_spec(rank_impl, int(succ.shape[0]))
+    dist = _rank_dist(succ, backend, algo, ring_budget)
+
     anchor_dist = dist[anchor]
     rank = anchor_dist[root] - anchor_dist[:n]  # monotone along the traversal
     # pads / unreachable: push to the end
@@ -452,9 +791,11 @@ def _place_algo() -> str:
     histogram + gather + positional-scatter formulation).  Read at
     TRACE time: set it before the first merge call of the process
     (already-jitted kernels do not retrace on env changes)."""
+    from ..errors import ConfigError
+
     algo = os.environ.get("PLACE_ALGO", "sort")
     if algo not in ("sort", "scatter"):
-        raise ValueError(f"PLACE_ALGO must be 'sort' or 'scatter', got {algo!r}")
+        raise ConfigError("PLACE_ALGO", algo, "sort|scatter")
     return algo
 
 
@@ -562,17 +903,26 @@ def _place_by_chain_sort(
 
 
 def chain_materialize(
-    cols: ChainColumns, rank_impl: Optional[str] = None
+    cols: ChainColumns,
+    rank_impl: Optional[str] = None,
+    ring_budget: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Merge via chain contraction: rank C chains (C << N), then place
     all N elements via _place_by_chain (default: rank expansion by
     C-scatter + N-cumsum, then one stable N-row sort; PLACE_ALGO=scatter
     selects the histogram + gather + positional-scatter formulation) —
     the gather-heavy ranking runs on the contracted tree only.
+    `ring_budget` is the static coalesced-ring budget (see
+    _coalesced_dist: callers passing a tight budget own the n_runs
+    check; None is always safe).
     Returns (codes i32[N] padded with -1, visible count)."""
     c = cols.c_parent.shape[0]
     crank = _order_core(
-        cols.c_parent, cols.c_side, cols.c_valid, rank_impl=rank_impl
+        cols.c_parent,
+        cols.c_side,
+        cols.c_valid,
+        rank_impl=rank_impl,
+        ring_budget=ring_budget,
     )  # i32[C]
     visible = cols.valid & ~cols.deleted
     chain_id = jnp.where(cols.valid, cols.chain_id, c)
@@ -584,10 +934,57 @@ def chain_materialize(
 chain_materialize_batch = jax.vmap(chain_materialize)
 
 
+def _tick_rank_obs(
+    n_docs: int,
+    n_nodes: int,
+    rank_impl: Optional[str],
+    ring_budget: Optional[int] = None,
+) -> None:
+    """rank.* obs counters (docs/OBSERVABILITY.md) from the analytic
+    gather model — ticked at host-level jit entry points only (inside a
+    trace the counts would be trace-time noise), with the caller's
+    ring_budget and the live k/block knob values threaded through so
+    budgeted/tuned runs are priced as scheduled.  Never raises: the
+    merge path must not depend on the obs package."""
+    try:
+        m = 2 * (n_nodes + 1)
+        backend, algo = _resolve_rank_spec(rank_impl, m)
+        from ..obs import metrics as obs_m
+
+        from .rank_model import gather_model
+
+        kw = {}
+        if algo == "coalesced":
+            kw["r_pad"] = ring_budget
+        if algo == "blocked":
+            kw["block"] = _rank_block()
+        if backend == "pallas" and algo in ("ruling", "blocked", "coalesced"):
+            # coalesced's pallas sub-rank rides the same kernel knob
+            kw["k"] = int(os.environ.get("PALLAS_RULING_K", "8"))
+        mdl = gather_model(m, algo, **kw)
+        label = f"{backend}:{algo}"
+        obs_m.counter("rank.ring_tokens").inc(n_docs * m, algo=label)
+        obs_m.counter("rank.rounds_total").inc(n_docs * mdl["rounds"], algo=label)
+        obs_m.counter("rank.gather_rows_total").inc(
+            n_docs * mdl["global_rows"], algo=label, kind="global"
+        )
+        if mdl.get("local_rows"):
+            obs_m.counter("rank.gather_rows_total").inc(
+                n_docs * mdl["local_rows"], algo=label, kind="local"
+            )
+    except Exception:
+        pass
+
+
 @jax.jit
+def _chain_merge_docs_jit(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
+    return chain_materialize_batch(cols)
+
+
 def chain_merge_docs(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
     """One launch: chain-contracted merge for a doc batch ([D,C]/[D,N])."""
-    return chain_materialize_batch(cols)
+    _tick_rank_obs(cols.c_parent.shape[0], cols.c_parent.shape[1], None)
+    return _chain_merge_docs_jit(cols)
 
 
 def _weighted_checksum(codes: jax.Array) -> jax.Array:
@@ -600,40 +997,79 @@ def _weighted_checksum(codes: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def chain_merge_docs_checksum(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
+def _chain_merge_docs_checksum_jit(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
     codes, counts = chain_materialize_batch(cols)
     return _weighted_checksum(codes), counts
 
 
-@functools.partial(jax.jit, static_argnames=("rank_impl",))
+def chain_merge_docs_checksum(cols: ChainColumns) -> Tuple[jax.Array, jax.Array]:
+    _tick_rank_obs(cols.c_parent.shape[0], cols.c_parent.shape[1], None)
+    return _chain_merge_docs_checksum_jit(cols)
+
+
+@functools.partial(jax.jit, static_argnames=("rank_impl", "ring_budget"))
+def _chain_merge_docs_v_jit(
+    cols: ChainColumns,
+    rank_impl: Optional[str] = None,
+    ring_budget: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    return jax.vmap(lambda c: chain_materialize(c, rank_impl, ring_budget))(cols)
+
+
 def chain_merge_docs_v(
-    cols: ChainColumns, rank_impl: Optional[str] = None
+    cols: ChainColumns,
+    rank_impl: Optional[str] = None,
+    ring_budget: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """chain_merge_docs with an explicit ranking implementation —
-    phased bench runs measure the XLA path first (banking a safe device
-    number), then the pallas path, inside ONE process (env knobs bake
-    at trace time, so this must be a static argument)."""
-    return jax.vmap(lambda c: chain_materialize(c, rank_impl))(cols)
+    phased bench runs measure several rank paths inside ONE process
+    (env knobs bake at trace time, so this must be a static argument).
+    `rank_impl` accepts "xla" / "pallas" or explicit "<backend>:<algo>"
+    specs (e.g. "xla:coalesced"); `ring_budget` is the static
+    coalesced-ring budget (caller-checked, see _coalesced_dist)."""
+    _tick_rank_obs(cols.c_parent.shape[0], cols.c_parent.shape[1], rank_impl, ring_budget)
+    return _chain_merge_docs_v_jit(cols, rank_impl, ring_budget)
 
 
-@functools.partial(jax.jit, static_argnames=("rank_impl",))
-def chain_merge_docs_checksum_v(
-    cols: ChainColumns, rank_impl: Optional[str] = None
+@functools.partial(jax.jit, static_argnames=("rank_impl", "ring_budget"))
+def _chain_merge_docs_checksum_v_jit(
+    cols: ChainColumns,
+    rank_impl: Optional[str] = None,
+    ring_budget: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    codes, counts = jax.vmap(lambda c: chain_materialize(c, rank_impl))(cols)
+    codes, counts = jax.vmap(lambda c: chain_materialize(c, rank_impl, ring_budget))(
+        cols
+    )
     return _weighted_checksum(codes), counts
 
 
-@functools.partial(jax.jit, static_argnames=("rank_impl",))
+def chain_merge_docs_checksum_v(
+    cols: ChainColumns,
+    rank_impl: Optional[str] = None,
+    ring_budget: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    _tick_rank_obs(cols.c_parent.shape[0], cols.c_parent.shape[1], rank_impl, ring_budget)
+    return _chain_merge_docs_checksum_v_jit(cols, rank_impl, ring_budget)
+
+
+@functools.partial(jax.jit, static_argnames=("rank_impl", "ring_budget"))
 def chain_rank_checksum_v(
-    cols: ChainColumns, rank_impl: Optional[str] = None
+    cols: ChainColumns,
+    rank_impl: Optional[str] = None,
+    ring_budget: Optional[int] = None,
 ) -> jax.Array:
     """Ranking phase ONLY (scalar-reduced for cheap fetches): the
     measured-roofline bench phase times this against the full merge to
     split rank vs placement cost on chip."""
 
     def one(c: ChainColumns) -> jax.Array:
-        crank = _order_core(c.c_parent, c.c_side, c.c_valid, rank_impl=rank_impl)
+        crank = _order_core(
+            c.c_parent,
+            c.c_side,
+            c.c_valid,
+            rank_impl=rank_impl,
+            ring_budget=ring_budget,
+        )
         return crank.astype(jnp.uint32).sum(dtype=jnp.uint32)
 
     return jax.vmap(one)(cols)
@@ -807,8 +1243,13 @@ def chain_contract_materialize_u(
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
-def chain_merge_docs_u(cols: SeqColumnsU, c_pad: int):
+def _chain_merge_docs_u_jit(cols: SeqColumnsU, c_pad: int):
     return jax.vmap(lambda c: chain_contract_materialize_u(c, c_pad))(cols)
+
+
+def chain_merge_docs_u(cols: SeqColumnsU, c_pad: int):
+    _tick_rank_obs(cols.parent.shape[0], c_pad, None)
+    return _chain_merge_docs_u_jit(cols, c_pad)
 
 
 @jax.jit
@@ -876,17 +1317,19 @@ def pad_seq_columns(cols: SeqColumns, n: int) -> SeqColumns:
 
 
 @functools.partial(jax.jit, donate_argnums=())
-def merge_docs(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
-    """One XLA launch: resolve order + materialize visible content for a
-    whole batch of documents.  cols arrays are [D, N]."""
+def _merge_docs_jit(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
     return materialize_content_batch(cols)
 
 
+def merge_docs(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """One XLA launch: resolve order + materialize visible content for a
+    whole batch of documents.  cols arrays are [D, N]."""
+    _tick_rank_obs(cols.parent.shape[0], cols.parent.shape[1], None)
+    return _merge_docs_jit(cols)
+
+
 @jax.jit
-def merge_docs_checksum(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
-    """Merge but return only a per-doc order-sensitive checksum [D] +
-    counts [D].  Used by benchmarks: the merged state stays device-
-    resident (the fleet model); only O(D) scalars cross the host link."""
+def _merge_docs_checksum_jit(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
     codes, counts = materialize_content_batch(cols)
     n = codes.shape[1]
     w = (jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)) % jnp.uint32(1 << 30)
@@ -894,3 +1337,11 @@ def merge_docs_checksum(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
         axis=1, dtype=jnp.uint32
     )
     return cs, counts
+
+
+def merge_docs_checksum(cols: SeqColumns) -> Tuple[jax.Array, jax.Array]:
+    """Merge but return only a per-doc order-sensitive checksum [D] +
+    counts [D].  Used by benchmarks: the merged state stays device-
+    resident (the fleet model); only O(D) scalars cross the host link."""
+    _tick_rank_obs(cols.parent.shape[0], cols.parent.shape[1], None)
+    return _merge_docs_checksum_jit(cols)

@@ -78,6 +78,16 @@ def _fat_checkpoint():
         resident_pipeline_note="p" * 400,
         pipeline={"rounds": 48, "groups": 6, "overlap_fraction": 0.4,
                   "stage_s": 1.0, "commit_s": 0.5, "note": "q" * 200},
+        rank_gather_reduction=2.57,
+        rank_gather_rows_per_op=2.25,
+        rank={"algo_base": "xla:wyllie", "algo_new": "xla:coalesced",
+              "ring_tokens": 8194, "n_runs_max": 5010, "mean_run": 1.8,
+              "ring_budget": 5248, "gather_rows_base": 458864,
+              "gather_rows_new": 178537, "gather_rows_base_per_op": 5.79,
+              "gather_rows_new_per_op": 2.25, "model_rows_base": 458864,
+              "model_rows_new": 320224, "rank_ms_base": 6.9,
+              "rank_ms_new": 11.6, "gather_rows_per_sec_base": 66168692,
+              "gather_rows_per_sec_new": 15363933, "note": "g" * 300},
         resident_durable_rows_per_sec=90_000,
         resident_durable_replayed_rounds=2,
         resident_durable_fsyncs=11,
@@ -104,11 +114,11 @@ class TestFlagshipLine:
         # flagship numerics survive the split
         for k in ("metric", "value", "unit", "vs_baseline", "device",
                   "resident_pipeline_speedup", "resident_durable_fsyncs",
-                  "resident_durable_group_fsyncs"):
+                  "resident_durable_group_fsyncs", "rank_gather_reduction"):
             assert k in back, k
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
-        for k in ("metrics", "resilience", "pipeline", "baseline_note",
+        for k in ("metrics", "resilience", "pipeline", "rank", "baseline_note",
                   "roofline_note", "resident_pipeline_note"):
             assert k in side, k
             assert k not in back, k

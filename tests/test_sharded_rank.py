@@ -30,6 +30,34 @@ def test_sharded_matches_wyllie(op_parallel):
     assert (got == want).all()
 
 
+@pytest.mark.parametrize("op_parallel", [2, 4])
+def test_sharded_blocked_matches_wyllie(op_parallel):
+    """algo="blocked": shard-local phase A + adaptive all_gather
+    doubling must stay bit-identical to the plain sharded path on
+    arbitrary rings (incl. the all-runs ring where the adaptive loop
+    exits after one round)."""
+    mesh = make_mesh(op_parallel=op_parallel)
+    d = mesh.shape["docs"] * 2
+    m = 512
+    rng = np.random.default_rng(7)
+    succ = np.stack([_ring(rng, m) for _ in range(d)])
+    # one doc is a pure index-run: phase A collapses it entirely
+    succ[0] = np.arange(1, m + 1, dtype=np.int32)
+    succ[0, -1] = m - 1
+    fn = make_ring_rank_sharded(mesh, m, algo="blocked")
+    got = np.asarray(fn(jax.device_put(succ)))
+    want = np.stack([np.asarray(jax.jit(_wyllie_dist)(s)) for s in succ])
+    assert (got == want).all()
+
+
+def test_sharded_algo_validation():
+    from loro_tpu.errors import ConfigError
+
+    mesh = make_mesh(op_parallel=2)
+    with pytest.raises(ConfigError):
+        make_ring_rank_sharded(mesh, 512, algo="bogus")
+
+
 def test_sharded_flagship_shape_runs():
     mesh = make_mesh(op_parallel=4)
     d = mesh.shape["docs"]

@@ -153,7 +153,7 @@ _CORE_KEYS = (
 )
 # always routed to the sidecar line: prose, dict sidecars, series
 _SIDECAR_KEYS = (
-    "metrics", "resilience", "pipeline", "rank",
+    "metrics", "resilience", "pipeline", "rank", "sync",
     "baseline_note", "latency_note", "roofline_note",
     "roofline_measured_note", "resident_note", "resident_durable_note",
     "resident_pipeline_note", "e2e_note", "e2e_unit", "richtext_unit",
@@ -276,6 +276,11 @@ def assemble_record(ck: dict) -> dict:
         "richtext_value",
         "richtext_unit",
         "richtext_vs_baseline",
+        "sync_sessions",
+        "sync_pushes_per_sec",
+        "sync_push_to_visible_ms_p50",
+        "sync_push_to_visible_ms_p99",
+        "sync",
         "trace",
         "metrics",
         "resilience",
@@ -1466,6 +1471,137 @@ def main() -> None:
                     _shutil.rmtree(_gdir, ignore_errors=True)
         except Exception as e:
             note(f"resident phase failed ({type(e).__name__}: {e})")
+
+    # ---- phase: sync front-end (BENCH_SYNC=1, ISSUE 7) ----------------
+    # the repo's first end-to-end many-writers-many-readers benchmark:
+    # concurrent sessions push client update blobs through the SyncServer
+    # fan-in (batched into pipelined resident groups), committed epochs
+    # fan out, readers pull deltas.  Banks sessions, pushes/sec and
+    # p50/p99 push-to-visible latency into the `sync` sidecar.
+    if remaining() > 30 and os.environ.get("BENCH_SYNC") == "1":
+        try:
+            import random as _random
+
+            from loro_tpu import LoroDoc
+            from loro_tpu.obs import metrics as _obsm
+            from loro_tpu.sync import SyncServer
+
+            S_DOCS, S_WRITERS, S_EPOCHS = 8, 2, 6
+            n_sess = S_DOCS * S_WRITERS
+            note(
+                f"sync phase: {n_sess} writer sessions x {S_DOCS} docs x "
+                f"{S_EPOCHS} epochs through the fan-in..."
+            )
+            _rng3 = _random.Random(0x5E51DE19)
+            _clients = []  # [doc][writer] replicas
+            for i in range(S_DOCS):
+                b = LoroDoc(peer=3000 + 10 * i)
+                b.get_text("t").insert(0, f"sync bench base {i}")
+                b.commit()
+                reps = [b]
+                for w in range(1, S_WRITERS):
+                    r = LoroDoc(peer=3000 + 10 * i + w)
+                    r.import_(b.export_snapshot())
+                    reps.append(r)
+                _clients.append(reps)
+            _scid = _clients[0][0].get_text("t").id
+            _ssrv = SyncServer(
+                "text", S_DOCS, cid=_scid, capacity=1 << 14,
+                coalesce=8, max_queue=128,
+            )
+            _sess = [[_ssrv.connect(sid=f"d{i}w{w}")
+                      for w in range(S_WRITERS)] for i in range(S_DOCS)]
+            _smarks = [[{} for _ in range(S_WRITERS)]
+                       for _ in range(S_DOCS)]
+            _boot = []
+            for i in range(S_DOCS):
+                _boot.append(_sess[i][0].push(
+                    i, _clients[i][0].export_updates({})
+                ))
+                _smarks[i][0] = _clients[i][0].oplog_vv()
+                for w in range(1, S_WRITERS):
+                    _sess[i][w]._vv[i] = _clients[i][w].oplog_vv()
+                    _smarks[i][w] = _clients[i][w].oplog_vv()
+            for _tk in _boot:
+                _tk.epoch(120)
+            _p2v = _obsm.histogram("sync.push_to_visible_seconds")
+            _pushes = 0
+            _s0 = time.perf_counter()
+            for _e in range(S_EPOCHS):
+                _tks = []
+                for i in range(S_DOCS):
+                    for w in range(S_WRITERS):
+                        d = _clients[i][w]
+                        t = d.get_text("t")
+                        made = 0
+                        while made < 96:
+                            L = len(t)
+                            if L > 8 and _rng3.random() < 0.15:
+                                p0 = _rng3.randrange(L - 1)
+                                dl = min(_rng3.randint(1, 3), L - p0)
+                                t.delete(p0, dl)
+                                made += dl
+                            else:
+                                run = _rng3.randint(1, 12)
+                                t.insert(_rng3.randint(0, L),
+                                         "abcdefghijkl"[:run])
+                                made += run
+                        d.commit()
+                        _tks.append(_sess[i][w].push(
+                            i, d.export_updates(_smarks[i][w])
+                        ))
+                        _smarks[i][w] = d.oplog_vv()
+                        _pushes += 1
+                for _tk in _tks:
+                    _tk.epoch(120)
+                # the many-readers half: every session pulls the delta
+                # and integrates it (cross-writer merge)
+                for i in range(S_DOCS):
+                    for w in range(S_WRITERS):
+                        _clients[i][w].import_(_sess[i][w].pull(i))
+                        _smarks[i][w] = _clients[i][w].oplog_vv()
+            _ssec = time.perf_counter() - _s0
+            _ssrv.flush()
+            # convergence gate: replicas agree and match the resident
+            _stexts = _ssrv.texts()
+            for i in range(S_DOCS):
+                want = _clients[i][0].get_text("t").to_string()
+                assert _clients[i][1].get_text("t").to_string() == want
+                assert _stexts[i] == want, f"sync bench doc {i} diverged"
+            _p50 = _p2v.quantile(0.50) or 0.0
+            _p99 = _p2v.quantile(0.99) or 0.0
+            _pull_b = _obsm.histogram("sync.pull_bytes").summary()
+            _srep = _ssrv.report()
+            _srep.update(
+                docs=S_DOCS, epochs=S_EPOCHS,
+                push_to_visible_ms_p50=round(_p50 * 1e3, 2),
+                push_to_visible_ms_p99=round(_p99 * 1e3, 2),
+                pull_bytes_mean=round(_pull_b["mean"], 1),
+                pulls=_pull_b["count"],
+                note=(
+                    "many-writers-many-readers: 2 writer sessions per doc "
+                    "push ~96-row client deltas through the bounded fan-in "
+                    "(pipelined resident groups), every session pulls + "
+                    "integrates per epoch; p50/p99 = push submit -> "
+                    "committed + oracle-visible; convergence gated vs the "
+                    "resident reads"
+                ),
+            )
+            _ssrv.close()
+            bank(
+                "sync",
+                sync_sessions=n_sess,
+                sync_pushes_per_sec=round(_pushes / _ssec, 1),
+                sync_push_to_visible_ms_p50=round(_p50 * 1e3, 2),
+                sync_push_to_visible_ms_p99=round(_p99 * 1e3, 2),
+                sync=_srep,
+            )
+            note(
+                f"sync: {n_sess} sessions, {_pushes/_ssec:.0f} pushes/s, "
+                f"push-to-visible p50 {_p50*1e3:.1f}ms p99 {_p99*1e3:.1f}ms"
+            )
+        except Exception as e:
+            note(f"sync phase failed ({type(e).__name__}: {e})")
 
     bank("done", partial=None)
     emit_record(_final_record())

@@ -45,6 +45,7 @@ from .models.handlers import (
 from . import obs
 from . import persist
 from . import resilience
+from . import sync
 from .awareness import Awareness, EphemeralStore
 from .codec.json_schema import RedactError, redact_json_updates
 from .cursor import AbsolutePosition, Cursor, CursorSide, get_cursor, get_cursor_pos

@@ -45,6 +45,29 @@ class PersistError(LoroError):
     subclasses instead — this type is for the write/lifecycle side."""
 
 
+class SyncError(LoroError):
+    """Base for the sync front-end (loro_tpu/sync/, docs/SYNC.md)."""
+
+
+class PushRejected(SyncError):
+    """A pushed update payload did not decode (poison): the push's
+    ticket fails typed with this, other sessions' pushes in the same
+    fan-in batch land normally.  The client should re-export and retry;
+    the server state never half-applied the payload."""
+
+
+class StaleFrontier(SyncError):
+    """The client's frontier is below the server oracle's shallow root
+    (history there was trimmed by the checkpoint ladder) AND the client
+    is not empty, so neither a delta nor a snapshot can be served — the
+    client must resync from scratch (fresh doc, then ``pull()`` takes
+    the first-sync snapshot path)."""
+
+
+class SessionClosed(SyncError):
+    """Operation on a session that was closed or TTL-expired."""
+
+
 class ResilienceError(LoroError):
     """Base for the resilience subsystem (loro_tpu/resilience/)."""
 

@@ -220,6 +220,9 @@ class ResidentServer:
         # attached PipelinedIngest executor (parallel/pipeline.py):
         # close()/checkpoint() drain it so no staged round is stranded
         self._pipeline = None
+        # epoch-commit subscribers (loro_tpu/sync fan-out): called with
+        # each newly VISIBLE epoch, on whichever thread committed it
+        self._epoch_subs: List = []
         # bounded recover(): batch bytes to re-seed from (the last
         # checkpoint blob) + the visible epoch it covers
         self._replay_base: Optional[bytes] = replay_base
@@ -419,6 +422,9 @@ class ResidentServer:
         before this method returns (fsync'd per round, or deferred to
         the group-commit window in ``durable_fsync="group"`` mode —
         ``durable_epoch`` is the watermark a crash cannot lose)."""
+        if epoch is None:
+            epoch = self.epoch
+        self._notify_epoch(epoch)
         if not (self._host_fallback or self._durable is not None):
             return
         from ..codec.binary import encode_changes
@@ -428,8 +434,6 @@ class ResidentServer:
             else bytes(encode_changes(list(u)))
             for u in updates
         ]
-        if epoch is None:
-            epoch = self.epoch
         # in-memory journal FIRST: the round is already on the device,
         # and the mirror/recover() paths must see it even if the
         # durable append below fails
@@ -828,13 +832,7 @@ class ResidentServer:
         # rounds of the failed group that committed before the drain
         # raised — the offset keeps visible epochs monotone)
         self._epoch_base = self.epoch
-        host = self._seed_mirror()
-        floor = self._anchor.epoch if anchored else 0
-        for _e, ups, c in self._history:
-            if _e > floor:
-                host.apply(ups, c)
-        if self._cid is not None and cid is None:
-            host._cid = self._cid
+        host = self.seed_mirror_engine()
         self._host = host
         self._degraded = True
         self._host_rounds = 0
@@ -861,6 +859,50 @@ class ResidentServer:
         from ..resilience.hostpath import HostEngine
 
         return HostEngine(self.family, self.n_docs)
+
+    def seed_mirror_engine(self):
+        """A ``hostpath.HostEngine`` at the server's current APPLIED
+        state: the mirror-anchor seed plus the journal tail.  The one
+        replay rule both consumers share — the degradation mirror
+        (``_degrade_rounds``) and the sync front-end's delta-export
+        oracle (``loro_tpu/sync``).  Requires ``host_fallback`` (the
+        journal/anchor machinery); callers that may hold a pre-v3
+        restore check ``_history_complete``/``_anchor`` first."""
+        host = self._seed_mirror()
+        floor = self._anchor.epoch if self._anchor is not None else 0
+        for _e, ups, c in self._history:
+            if _e > floor:
+                host.apply(ups, c)
+        if self._cid is not None:
+            host._cid = self._cid
+        return host
+
+    # -- epoch-commit subscription (loro_tpu/sync fan-out) -------------
+    def subscribe_epochs(self, cb) -> "callable":
+        """Register ``cb(epoch)`` to run for every newly VISIBLE epoch
+        (device commit, coalesced group member, isolated per-doc round,
+        or degraded host-mirror round alike).  Fires on the committing
+        thread, after the round is applied but before pipeline epoch
+        futures resolve — a subscriber observes a commit no later than
+        the client that pushed it.  Commit-visibility semantics, not
+        durability: in ``durable_fsync="group"`` mode the epoch may not
+        be fsync'd yet (gate on ``durable_epoch`` for that).  Recovery
+        replay (``_replay_journal_tail``) does NOT re-fire — those
+        epochs were announced in their original life.  Returns an
+        unsubscribe callable."""
+        self._epoch_subs.append(cb)
+        return lambda: self._epoch_subs.remove(cb)
+
+    def _notify_epoch(self, epoch: int) -> None:
+        for cb in list(self._epoch_subs):
+            try:
+                cb(epoch)
+            except Exception:
+                # a broken subscriber must never poison the ingest path
+                obs.counter(
+                    "server.epoch_sub_errors_total",
+                    "epoch-commit subscriber callbacks that raised",
+                ).inc(family=self.family)
 
     def attach_durable(self, log) -> None:
         """Adopt a ``persist.DurableLog`` (recover_server re-attaches

@@ -20,6 +20,14 @@ instrumented choke points of the device pipeline:
                      write for the reopen-tolerance tests)
 - ``ckpt_corrupt`` — persist.checkpoints save: mangle the framed blob
                      (recovery must fall back down the ladder)
+- ``sync_push``    — sync.SyncServer push entry: raise/delay before the
+                     fan-in queue, or mangle the client's update bytes
+                     (typed PushRejected / poison-ticket paths)
+- ``sync_pull``    — sync.Session.pull: raise/delay before the delta
+                     export (client-visible read-path failures)
+- ``session_stall``— sync fan-out delivery: delay one session's
+                     notification slot (slow-consumer backpressure and
+                     the soak's stalled-session churn)
 
 Arm programmatically::
 

@@ -1,0 +1,29 @@
+"""Session-oriented sync front-end (docs/SYNC.md).
+
+``SyncServer`` fronts a ``ResidentServer`` with many concurrent client
+sessions: per-session version vectors, delta export since the client
+frontier (``Session.pull``), batched fan-in of pushes into pipelined
+ingest rounds with backpressure (``fanin.FanIn``), fan-out of committed
+epochs as delta notifications, and an ephemeral presence plane
+(``presence.PresencePlane`` over ``loro_tpu.awareness``).
+
+Typed errors live in ``loro_tpu.errors``: ``SyncError``,
+``PushRejected``, ``StaleFrontier``, ``SessionClosed``.
+"""
+from ..errors import PushRejected, SessionClosed, StaleFrontier, SyncError
+from .fanin import FanIn, PushTicket
+from .presence import PresencePlane
+from .server import SyncServer
+from .session import Session
+
+__all__ = [
+    "SyncServer",
+    "Session",
+    "FanIn",
+    "PushTicket",
+    "PresencePlane",
+    "SyncError",
+    "PushRejected",
+    "StaleFrontier",
+    "SessionClosed",
+]

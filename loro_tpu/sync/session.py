@@ -1,0 +1,232 @@
+"""One client session of a SyncServer (docs/SYNC.md).
+
+A session is the server-side half of one connected client replica:
+
+- a per-doc **client version vector** — what the client is known to
+  hold.  ``pull(di)`` exports only the delta since that frontier
+  (``ExportMode.Updates`` on the per-doc oracle — columnar-updates
+  bytes a stock client ``import_()``s), then advances the frontier and
+  acks the covered epoch into the resident compaction floors;
+- ``push(di, data)`` feeds the client's own update bytes through the
+  server's bounded fan-in (``fanin.PushTicket`` resolves at commit);
+- a **delta-notification plane**: committed epochs mark the session's
+  dirty-doc set (self-coalescing — a slow reader accumulates one flag
+  per doc, never an unbounded event log) and ``poll()`` waits on it;
+- a **presence inbox**: Awareness/EphemeralStore blobs broadcast by
+  other sessions (bounded, drop-oldest — presence is ephemeral by
+  definition, docs/SYNC.md "Presence plane").
+
+First-sync contract: when the server oracle is *shallow* (its history
+floor was trimmed by the checkpoint ladder — every recovered server is)
+and the client frontier sits below that floor, a delta cannot exist.
+An EMPTY client gets the documented first-sync path instead: ``pull``
+returns a full snapshot (the oracle's shallow base rides along, a
+fresh ``LoroDoc`` imports it directly).  A NON-empty client below the
+floor raises typed ``errors.StaleFrontier`` — it must resync from a
+fresh doc.  (Before this path existed, ``_export_shallow`` raised a
+bare ``LoroError`` at the caller.)
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..core.version import VersionVector
+from ..errors import SessionClosed, StaleFrontier
+from ..obs import metrics as obs
+from ..resilience import faultinject
+
+# presence inbox bound: a session that never polls drops its OLDEST
+# presence blobs (counted) — presence is last-writer-wins ephemeral
+# state, so the newest blobs are the ones that matter
+PRESENCE_INBOX_CAP = 256
+
+
+class Session:
+    """Construct via ``SyncServer.connect()`` (never directly): the
+    server owns the registry, replica registration and presence
+    lifecycle this object participates in."""
+
+    def __init__(self, server, sid: str, peer: int, subscribe: bool = True):
+        self._server = server
+        self.sid = sid
+        self.peer = peer  # presence-plane peer id (never a CRDT peer)
+        self.subscribed = subscribe
+        self.closed = False
+        self.last_seen = time.monotonic()
+        self._polling = 0  # threads blocked in poll(): never TTL-idle
+        # di -> VersionVector the client is known to hold
+        self._vv: Dict[int, VersionVector] = {}
+        # committed docs the client has not pulled yet (self-coalescing)
+        self._dirty: Dict[int, int] = {}  # di -> newest committed epoch
+        self._presence: deque = deque()   # encoded presence blobs
+        self._dropped_presence = 0
+
+    # -- internal (called by the server under its lock) ----------------
+    def _touch(self) -> None:
+        self.last_seen = time.monotonic()
+
+    def _check_open(self) -> None:
+        if self.closed:
+            raise SessionClosed(f"session {self.sid!r} is closed")
+
+    def _mark_dirty(self, di: int, epoch: int) -> None:
+        if self._dirty.get(di, -1) < epoch:
+            self._dirty[di] = epoch
+
+    def _push_presence(self, blob: bytes) -> None:
+        if len(self._presence) >= PRESENCE_INBOX_CAP:
+            self._presence.popleft()
+            self._dropped_presence += 1
+            obs.counter(
+                "sync.presence_dropped_total",
+                "presence blobs dropped from slow sessions' inboxes",
+            ).inc(family=self._server.family)
+        self._presence.append(blob)
+
+    # -- sync ----------------------------------------------------------
+    def push(self, di: int, data: bytes):
+        """Queue the client's update bytes (a ``doc.export_updates``
+        blob) for doc ``di``.  Returns a ``fanin.PushTicket``; blocks
+        only on fan-in backpressure.  Malformed envelopes raise typed
+        ``errors.PushRejected`` here, before anything is queued."""
+        self._check_open()
+        return self._server._push(self, di, data)
+
+    def pull(self, di: int, to_frontiers=None) -> bytes:
+        """Delta since this client's frontier for doc ``di`` as
+        columnar-updates bytes (``client_doc.import_()`` them), or the
+        first-sync snapshot when the oracle is shallow and the client
+        is empty.  ``to_frontiers`` bounds the delta
+        (``ExportMode.UpdatesInRange``) — e.g. replaying up to a known
+        stable point; default is everything the server holds.  Advances
+        the client frontier and acks the covered epoch."""
+        from ..doc import ExportMode
+
+        self._check_open()
+        faultinject.check("sync_pull", doc=di)
+        srv = self._server
+        with srv._lock:
+            self._touch()
+            d = srv._oracle.docs[di]
+            from_vv = self._vv.get(di) or VersionVector()
+            first_sync = False
+            if d.is_shallow() and not (d.shallow_since_vv() <= from_vv):
+                if len(from_vv) == 0:
+                    # documented first-sync path: full snapshot (the
+                    # shallow base rides along; a fresh doc imports it)
+                    first_sync = True
+                    data = d.export(ExportMode.Snapshot)
+                    new_vv = d.oplog_vv()
+                    obs.counter(
+                        "sync.first_sync_snapshots_total",
+                        "pulls served as snapshots (client below the "
+                        "oracle's shallow root)",
+                    ).inc(family=srv.family)
+                else:
+                    raise StaleFrontier(
+                        f"doc {di}: client frontier {from_vv.to_json()} is "
+                        "below the server oracle's shallow root "
+                        f"{d.shallow_since_vv().to_json()} — history there "
+                        "was trimmed; resync from a fresh doc (empty "
+                        "frontier pulls take the first-sync snapshot path)"
+                    )
+            elif to_frontiers is not None:
+                to_vv = d.oplog.dag.frontiers_to_vv(to_frontiers)
+                data = d.export(ExportMode.UpdatesInRange(from_vv, to_vv))
+                new_vv = from_vv.copy()
+                for peer, end in to_vv.items():
+                    if end > new_vv.get(peer):
+                        new_vv.set_end(peer, end)
+            else:
+                data = d.export(ExportMode.Updates(from_vv))
+                new_vv = d.oplog_vv()
+            self._vv[di] = new_vv
+            if to_frontiers is None:
+                self._dirty.pop(di, None)
+                # a FULL pull covers everything committed: ack it into
+                # the compaction floors.  A bounded pull integrates
+                # strictly less — acking the committed epoch for it
+                # would let compact() reclaim rows this client still
+                # needs (ResidentServer.ack's contract), so it never
+                # acks and the dirty flag survives for the catch-up
+                srv._ack(self, di)
+        obs.counter("sync.pulls_total").inc(
+            family=srv.family, kind="snapshot" if first_sync else "delta"
+        )
+        obs.histogram(
+            "sync.pull_bytes", "bytes served per pull",
+            buckets=(64, 256, 1024, 4096, 16384, 65536, 262144, 1048576),
+        ).observe(len(data), family=srv.family)
+        return data
+
+    def frontier(self, di: int) -> VersionVector:
+        """The client's known frontier for doc ``di`` (copy)."""
+        vv = self._vv.get(di)
+        return vv.copy() if vv is not None else VersionVector()
+
+    # -- notifications -------------------------------------------------
+    def poll(self, timeout: Optional[float] = None) -> dict:
+        """Wait up to ``timeout`` for activity, then drain it:
+        ``{"docs": {di: newest_epoch, ...}, "presence": [blobs...]}``.
+        Empty dict members mean nothing happened (timeout).  The docs
+        map is self-coalesced: however many epochs landed since the
+        last poll, the client does ONE pull per dirty doc."""
+        self._check_open()
+        srv = self._server
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with srv._lock:
+            self._touch()
+            # a BLOCKED poller is not idle: TTL expiry skips sessions
+            # with a live poll (expire_sessions), so a quiet reader is
+            # never disconnected mid-wait
+            self._polling += 1
+            try:
+                while not self._dirty and not self._presence:
+                    if deadline is None:
+                        srv._wakeup.wait()
+                    else:
+                        left = deadline - time.monotonic()
+                        if left <= 0 or not srv._wakeup.wait(left):
+                            break
+                    self._check_open()
+            finally:
+                self._polling -= 1
+                self._touch()
+            docs = dict(self._dirty)
+            self._dirty.clear()
+            presence = list(self._presence)
+            self._presence.clear()
+        return {"docs": docs, "presence": presence}
+
+    def dirty_docs(self) -> Dict[int, int]:
+        """Non-blocking view of docs with unpulled commits."""
+        with self._server._lock:
+            return dict(self._dirty)
+
+    # -- presence ------------------------------------------------------
+    def set_presence(self, state) -> None:
+        """Publish this session's presence state (cursor, name, ...) to
+        every other subscribed session.  Never touches the oplog."""
+        self._check_open()
+        self._server.presence.set_state(self, state)
+
+    def broadcast_presence(self, blob: bytes) -> None:
+        """Relay a client-encoded Awareness or EphemeralStore blob."""
+        self._check_open()
+        self._server.presence.broadcast(self, blob)
+
+    def presence_states(self) -> dict:
+        """The server's aggregated presence view (peer -> state)."""
+        return self._server.presence.states()
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        self._server.disconnect(self)
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

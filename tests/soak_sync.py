@@ -1,0 +1,283 @@
+"""Sync front-end soak — NOT collected by pytest.
+
+Run: python tests/soak_sync.py  (~1-3 min at defaults)
+
+Drives a fleet of SyncServers (one per resident family, all fed the
+same client pushes — the soak_resident pattern lifted to the session
+plane) through many epochs of session churn:
+
+- SOAK_SYNC_SESSIONS (6) writer sessions over SOAK_SYNC_DOCS (3) docs
+  (multiple writers per doc — concurrent edits merge through the
+  server); SOAK_SYNC_EPOCHS (8), SOAK_SYNC_SEED (0);
+- every epoch, each live session edits all five container families in
+  its client doc and pushes the delta; a random subset STALLS (skips
+  its pull — its dirty set and the replica floors must tolerate it), a
+  random session LEAVES (disconnect: floors unpinned, presence
+  departure), and a random fresh session JOINS mid-run (its first pull
+  reconstructs a client doc from the empty frontier);
+- per-epoch gate: every family server's reads match an independent
+  host oracle (per-doc LoroDocs replaying the same pushed payloads),
+  and every non-stalled client doc converges to it;
+- SOAK_SYNC_DURABLE=1 rides durable resident servers (WAL group
+  commit), checkpoints mid-run, and after the final epoch reopens
+  every family via persist.recover_server + SyncServer.over: a fresh
+  session's first pull must take the shallow first-sync snapshot path
+  and still match the host oracle.
+"""
+import os
+import os.path as _p
+import random
+import sys
+import time
+
+_here = _p.dirname(_p.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, _p.dirname(_here))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from loro_tpu import LoroDoc  # noqa: E402
+from loro_tpu.sync import SyncServer  # noqa: E402
+
+SESSIONS = int(os.environ.get("SOAK_SYNC_SESSIONS", "6"))
+DOCS = int(os.environ.get("SOAK_SYNC_DOCS", "3"))
+EPOCHS = int(os.environ.get("SOAK_SYNC_EPOCHS", "8"))
+SEED = int(os.environ.get("SOAK_SYNC_SEED", "0"))
+DURABLE = os.environ.get("SOAK_SYNC_DURABLE", "0") == "1"
+
+FAMILIES = ("text", "map", "tree", "counter", "movable")
+CAPS = {
+    "text": dict(capacity=1 << 13),
+    "map": dict(slot_capacity=128),
+    "tree": dict(move_capacity=1 << 12, node_capacity=512),
+    "counter": dict(slot_capacity=32),
+    "movable": dict(capacity=1 << 12, elem_capacity=512),
+}
+
+t0 = time.time()
+rng = random.Random(SEED)
+
+# host oracle: one doc per index, replaying every pushed payload
+base = []
+for i in range(DOCS):
+    d = LoroDoc(peer=1000 + i)
+    d.get_text("t").insert(0, f"sync soak base {i}")
+    d.get_map("m").set("k", i)
+    d.get_tree("tr").create()
+    d.get_counter("c").increment(i + 1)
+    d.get_movable_list("ml").push("a", "b")
+    d.commit()
+    base.append(d)
+cids = {
+    "text": base[0].get_text("t").id,
+    "tree": base[0].get_tree("tr").id,
+    "movable": base[0].get_movable_list("ml").id,
+    "map": None,
+    "counter": None,
+}
+
+_soak_dir = None
+if DURABLE:
+    import tempfile
+
+    _soak_dir = tempfile.mkdtemp(prefix="soak_sync_durable_")
+    print(f"durable mode: journaling to {_soak_dir}")
+
+
+def _mk_server(fam):
+    kw = dict(CAPS[fam])
+    if DURABLE:
+        kw["durable_dir"] = os.path.join(_soak_dir, fam)
+        kw["durable_fsync"] = "group"
+        kw["fsync_window"] = 4
+    return SyncServer(fam, DOCS, cid=cids[fam], coalesce=4, **kw)
+
+
+servers = {fam: _mk_server(fam) for fam in FAMILIES}
+oracle = [LoroDoc(peer=2000 + i) for i in range(DOCS)]
+
+
+class Client:
+    """One writer session (per family server) over one doc index."""
+
+    _next = 0
+
+    def __init__(self, di, seed_from_server: bool):
+        Client._next += 1
+        self.n = Client._next
+        self.di = di
+        self.doc = LoroDoc(peer=100 + self.n)
+        self.mark = {}
+        self.sess = {fam: servers[fam].connect(sid=f"c{self.n}-{fam}")
+                     for fam in FAMILIES}
+        if seed_from_server:
+            # mid-run join: reconstruct the client from pulls only
+            self.doc.import_(self.sess["text"].pull(di))
+            self.mark = self.doc.oplog_vv()
+        else:
+            self.doc.import_(base[di].export_snapshot())
+            self.mark = self.doc.oplog_vv()
+            for fam in FAMILIES:
+                self.sess[fam]._vv[di] = self.doc.oplog_vv()
+
+    def edit_and_push(self, rng, tickets):
+        d = self.doc
+        for _ in range(rng.randint(2, 5)):
+            kind = rng.randint(0, 4)
+            if kind == 0:
+                t = d.get_text("t")
+                L = len(t)
+                if L > 4 and rng.random() < 0.3:
+                    t.delete(rng.randrange(L - 2), 2)
+                else:
+                    t.insert(rng.randint(0, L), rng.choice(["xy", "q ", "lo"]))
+            elif kind == 1:
+                d.get_map("m").set(rng.choice(["k1", "k2"]), rng.randrange(99))
+            elif kind == 2:
+                tr = d.get_tree("tr")
+                nodes = tr.nodes()
+                if not nodes or rng.random() < 0.5:
+                    tr.create(rng.choice(nodes) if nodes else None)
+                else:
+                    tr.delete(rng.choice(nodes))
+            elif kind == 3:
+                d.get_counter("c").increment(rng.randint(-9, 9))
+            else:
+                ml = d.get_movable_list("ml")
+                L = len(ml)
+                if L >= 2 and rng.random() < 0.4:
+                    ml.move(rng.randrange(L), rng.randrange(L))
+                else:
+                    ml.insert(rng.randint(0, L), f"s{self.n}")
+        d.commit()
+        payload = d.export_updates(self.mark)
+        self.mark = d.oplog_vv()
+        oracle[self.di].import_(bytes(payload))
+        for fam in FAMILIES:
+            tickets.append(self.sess[fam].push(self.di, payload))
+
+    def pull(self):
+        self.doc.import_(self.sess["text"].pull(self.di))
+        self.mark = self.doc.oplog_vv()
+        # ack the other planes too (floors advance on every family)
+        for fam in FAMILIES:
+            if fam != "text":
+                self.sess[fam].pull(self.di)
+
+    def leave(self):
+        for s in self.sess.values():
+            s.close()
+
+
+def _gate(epoch, clients):
+    for fam, srv in servers.items():
+        srv.flush()
+    texts = servers["text"].texts()
+    segs = servers["text"].richtexts()
+    mvals = servers["map"].root_value_maps("m")
+    parents = servers["tree"].parent_maps()
+    cvals = servers["counter"].value_maps()
+    mls = servers["movable"].value_lists()
+    for i in range(DOCS):
+        o = oracle[i]
+        t = o.get_text("t")
+        assert texts[i] == t.to_string(), f"text epoch {epoch} doc {i}"
+        assert segs[i] == t.get_richtext_value(), f"richtext epoch {epoch} doc {i}"
+        assert mvals[i] == o.get_map("m").get_value(), f"map epoch {epoch} doc {i}"
+        tr = o.get_tree("tr")
+        assert parents[i] == {x: tr.parent(x) for x in tr.nodes()}, \
+            f"tree epoch {epoch} doc {i}"
+        c = o.get_counter("c")
+        assert cvals[i].get(c.id, 0.0) == c.get_value(), \
+            f"counter epoch {epoch} doc {i}"
+        assert mls[i] == o.get_movable_list("ml").get_value(), \
+            f"movable epoch {epoch} doc {i}"
+    for cl in clients:
+        assert cl.doc.get_deep_value() == oracle[cl.di].get_deep_value(), \
+            f"client {cl.n} epoch {epoch} diverged"
+
+
+# seed the servers with the base history (writer 0 per doc pushes it)
+clients = [Client(i % DOCS, seed_from_server=False) for i in range(SESSIONS)]
+boot = []
+for i in range(DOCS):
+    payload = base[i].export_updates({})
+    oracle[i].import_(bytes(payload))
+    first = next(c for c in clients if c.di == i)
+    for fam in FAMILIES:
+        boot.append(first.sess[fam].push(i, payload))
+for tk in boot:
+    tk.epoch(120)
+
+stalled: set = set()
+for epoch in range(EPOCHS):
+    tickets = []
+    # churn: maybe one leave, maybe one join, a few stalls
+    if len(clients) > 2 and rng.random() < 0.3:
+        gone = clients.pop(rng.randrange(len(clients)))
+        gone.leave()
+        print(f"  epoch {epoch}: session c{gone.n} left")
+    if rng.random() < 0.4:
+        joined = Client(rng.randrange(DOCS), seed_from_server=True)
+        clients.append(joined)
+        print(f"  epoch {epoch}: session c{joined.n} joined doc {joined.di}")
+    stalled = {c.n for c in clients if rng.random() < 0.2}
+    for cl in clients:
+        cl.edit_and_push(rng, tickets)
+    for tk in tickets:
+        tk.epoch(120)
+    active = [cl for cl in clients if cl.n not in stalled]
+    for cl in active:
+        cl.pull()
+    if stalled:
+        print(f"  epoch {epoch}: {len(stalled)} session(s) stalled their pull")
+    _gate(epoch, active)
+    if DURABLE and epoch % 3 == 2:
+        for srv in servers.values():
+            srv.flush()
+            srv.resident.checkpoint()
+        print(f"  epoch {epoch}: checkpointed all five families")
+    print(f"epoch {epoch}: {len(clients)} sessions, all 5 family servers "
+          f"match the host oracle ({time.time()-t0:.0f}s)")
+
+# let every straggler catch up, then gate one last time on everyone
+for cl in clients:
+    cl.pull()
+_gate("final", clients)
+
+if DURABLE:
+    import shutil
+
+    from loro_tpu.persist import recover_server
+
+    for cl in clients:
+        cl.leave()
+    for srv in servers.values():
+        srv.close()
+    rec = {fam: recover_server(os.path.join(_soak_dir, fam))
+           for fam in FAMILIES}
+    backs = {fam: SyncServer.over(r) for fam, r in rec.items()}
+    fresh = backs["text"].connect()
+    c = LoroDoc(peer=9999)
+    c.import_(fresh.pull(0))  # shallow first-sync snapshot path
+    assert c.get_deep_value() == oracle[0].get_deep_value(), \
+        "post-reopen first-sync client diverged"
+    texts = backs["text"].texts()
+    for i in range(DOCS):
+        assert texts[i] == oracle[i].get_text("t").to_string(), \
+            f"recovered text doc {i}"
+    for fam in FAMILIES:
+        backs[fam].close()
+        rec[fam].close()
+    shutil.rmtree(_soak_dir, ignore_errors=True)
+    print("durable reopen: first-sync snapshot client matches the oracle")
+else:
+    for cl in clients:
+        cl.leave()
+    for srv in servers.values():
+        srv.close()
+
+print(f"SYNC SOAK CLEAN: {SESSIONS} sessions x {DOCS} docs x {EPOCHS} "
+      f"epochs in {time.time()-t0:.0f}s")

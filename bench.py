@@ -153,7 +153,7 @@ _CORE_KEYS = (
 )
 # always routed to the sidecar line: prose, dict sidecars, series
 _SIDECAR_KEYS = (
-    "metrics", "resilience", "pipeline", "rank", "sync",
+    "metrics", "resilience", "pipeline", "rank", "sync", "shard",
     "baseline_note", "latency_note", "roofline_note",
     "roofline_measured_note", "resident_note", "resident_durable_note",
     "resident_pipeline_note", "e2e_note", "e2e_unit", "richtext_unit",
@@ -281,6 +281,10 @@ def assemble_record(ck: dict) -> dict:
         "sync_push_to_visible_ms_p50",
         "sync_push_to_visible_ms_p99",
         "sync",
+        "shard_count",
+        "shard_rows_per_sec",
+        "shard_scaling_x",
+        "shard",
         "trace",
         "metrics",
         "resilience",
@@ -1602,6 +1606,141 @@ def main() -> None:
             )
         except Exception as e:
             note(f"sync phase failed ({type(e).__name__}: {e})")
+
+    # ---- phase: sharded resident fleet (BENCH_SHARDS=N, ISSUE 8) ------
+    # doc-batch parallelism as the distributed axis: the same serving-
+    # granularity rounds through a 1-shard vs an N-shard
+    # ShardedResidentServer (per-shard PipelinedIngest executors, so
+    # coalesced groups launch concurrently across the mesh's doc rows).
+    # Banks shard_scaling_x + the `shard` sidecar.  Needs >= N doc rows
+    # (the 8-device CPU mesh in CI; chip numbers pending pool return —
+    # probe-compile sharded shapes in a disposable run per CLAUDE.md).
+    if remaining() > 30 and os.environ.get("BENCH_SHARDS"):
+        try:
+            import random as _random
+
+            from loro_tpu import LoroDoc
+            from loro_tpu.doc import strip_envelope
+            from loro_tpu.parallel.mesh import make_mesh as _make_mesh
+            from loro_tpu.parallel.sharded import ShardedResidentServer
+
+            n_sh = int(os.environ["BENCH_SHARDS"])
+            _smesh = _make_mesh()
+            rows_axis = int(np.asarray(_smesh.devices).shape[0])
+            if rows_axis < n_sh or rows_axis % n_sh:
+                note(
+                    f"shard phase skipped: mesh doc axis {rows_axis} "
+                    f"cannot host {n_sh} shards (run on the CPU mesh: "
+                    "JAX_PLATFORMS=cpu XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8)"
+                )
+            else:
+                SH_DOCS, SH_ROWS, SH_WARM, SH_BLOCK, SH_NBLK = 32, 192, 6, 8, 3
+                note(
+                    f"shard phase: {n_sh} shards vs 1, {SH_DOCS} docs x "
+                    f"{SH_BLOCK * SH_NBLK} {SH_ROWS}-row rounds..."
+                )
+                _rng4 = _random.Random(0x5E51DE20)
+                _doc4 = LoroDoc(peer=4)
+                _t4 = _doc4.get_text("t")
+                _shrounds = []
+                for _e in range(SH_WARM + SH_BLOCK * SH_NBLK):
+                    _vv = _doc4.oplog_vv()
+                    made = 0
+                    while made < SH_ROWS:
+                        L = len(_t4)
+                        if L > 8 and _rng4.random() < 0.15:
+                            p0 = _rng4.randrange(L - 1)
+                            dl = min(_rng4.randint(1, 3), L - p0)
+                            _t4.delete(p0, dl)
+                            made += dl
+                        else:
+                            run = _rng4.randint(1, 12)
+                            _t4.insert(_rng4.randint(0, L),
+                                       "abcdefghijkl"[:run])
+                            made += run
+                    _doc4.commit()
+                    _shrounds.append(strip_envelope(_doc4.export_updates(_vv)))
+                _cid4 = _doc4.get_text("t").id
+                _rows_round = SH_DOCS * SH_ROWS
+                import jax.numpy as _jnp
+
+                def _mk_fleet(k):
+                    f = ShardedResidentServer(
+                        "text", SH_DOCS, shards=k, mesh=_smesh,
+                        capacity=1 << 15,
+                    )
+                    return f, f.pipeline(cid=_cid4, coalesce=8, depth=2)
+
+                def _drain_fleet(f):
+                    for _s in f.shards:
+                        np.asarray(_jnp.count_nonzero(_s.batch.cols.valid))
+
+                _f1, _x1 = _mk_fleet(1)
+                _fn, _xn = _mk_fleet(n_sh)
+                for _pl in _shrounds[:SH_WARM]:  # compiles off the clock
+                    _x1.submit([_pl] * SH_DOCS)
+                    _xn.submit([_pl] * SH_DOCS)
+                _x1.flush()
+                _xn.flush()
+                _drain_fleet(_f1)
+                _drain_fleet(_fn)
+                _r1 = []
+                _rn = []
+                for _b in range(SH_NBLK):  # interleaved turns (r4 lesson)
+                    _blk = _shrounds[
+                        SH_WARM + _b * SH_BLOCK : SH_WARM + (_b + 1) * SH_BLOCK
+                    ]
+                    for _ex, _fl, _acc in ((_x1, _f1, _r1), (_xn, _fn, _rn)):
+                        _t0 = time.perf_counter()
+                        for _pl in _blk:
+                            _ex.submit([_pl] * SH_DOCS)
+                        _ex.flush()
+                        _drain_fleet(_fl)
+                        _acc.append(
+                            SH_BLOCK * _rows_round
+                            / (time.perf_counter() - _t0)
+                        )
+                _r1.sort()
+                _rn.sort()
+                _m1 = _r1[len(_r1) // 2]
+                _mn = _rn[len(_rn) // 2]
+                # correctness gate: both fleets serve the host text
+                assert _f1.texts() == _fn.texts()
+                assert _fn.texts()[0] == _t4.to_string()
+                _scaling = _mn / _m1
+                _srep = _xn.report()
+                _srep.update(
+                    docs=SH_DOCS, rows_per_round=SH_ROWS,
+                    rows_per_sec_1shard=round(_m1),
+                    rows_per_sec=round(_mn),
+                    scaling_x=round(_scaling, 2),
+                    scaling_efficiency=round(_scaling / n_sh, 3),
+                    note=(
+                        f"interleaved A/B at serving granularity "
+                        f"({SH_ROWS}-row rounds, {SH_DOCS} docs, "
+                        f"{SH_NBLK} alternating blocks of {SH_BLOCK}): "
+                        f"1-shard vs {n_sh}-shard ShardedResidentServer, "
+                        "per-shard pipelines (coalesce=8), reads gated "
+                        "equal across fleets and vs the host doc"
+                    ),
+                )
+                _f1.close()
+                _fn.close()
+                bank(
+                    "shard",
+                    shard_count=n_sh,
+                    shard_rows_per_sec=round(_mn),
+                    shard_scaling_x=round(_scaling, 2),
+                    shard=_srep,
+                )
+                note(
+                    f"sharded: {n_sh} shards {_mn/1e3:.0f}k rows/s vs "
+                    f"1 shard {_m1/1e3:.0f}k ({_scaling:.2f}x, "
+                    f"eff {_scaling/n_sh:.2f})"
+                )
+        except Exception as e:
+            note(f"shard phase failed ({type(e).__name__}: {e})")
 
     bank("done", partial=None)
     emit_record(_final_record())

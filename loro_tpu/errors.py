@@ -68,6 +68,14 @@ class SessionClosed(SyncError):
     """Operation on a session that was closed or TTL-expired."""
 
 
+class ShardingError(LoroError):
+    """Sharded-fleet lifecycle misuse (loro_tpu/parallel/sharded.py,
+    docs/SHARDING.md): migrating to a shard with no free slot, moving a
+    doc on/off a degraded shard, a shard manifest that does not match
+    the durable directories under it.  Invalid shard-count *knob*
+    values (LORO_SHARDS, divisibility) raise ConfigError instead."""
+
+
 class ResilienceError(LoroError):
     """Base for the resilience subsystem (loro_tpu/resilience/)."""
 

@@ -47,6 +47,32 @@ def shard_doc_batch(mesh: Mesh, tree):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)
 
 
+def shard_meshes(mesh: Mesh, n_shards: int):
+    """Carve a ("docs",) / ("docs", "ops") mesh into ``n_shards``
+    contiguous doc-axis slices, one sub-mesh per shard (axis names
+    preserved, so per-shard batches still shard "ops" when present).
+    The sharded resident fleet places each shard's device batch on its
+    own sub-mesh; raises typed ConfigError when the doc axis does not
+    divide evenly (a ragged carve would skew per-shard capacity)."""
+    from ..errors import ConfigError
+
+    devs = np.asarray(mesh.devices)
+    rows = devs.shape[0]
+    if not isinstance(n_shards, int) or isinstance(n_shards, bool) \
+            or n_shards < 1:
+        raise ConfigError("shards", n_shards, "positive integer")
+    if rows % n_shards:
+        raise ConfigError(
+            "shards", n_shards,
+            f"a divisor of the mesh doc axis ({rows} device row(s))",
+        )
+    k = rows // n_shards
+    return [
+        Mesh(devs[s * k:(s + 1) * k], mesh.axis_names)
+        for s in range(n_shards)
+    ]
+
+
 def make_global_mesh(op_parallel: int = 1) -> Mesh:
     """Multi-host fleet mesh: all devices across all processes.
 

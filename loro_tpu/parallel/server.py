@@ -138,7 +138,7 @@ class ResidentServer:
                  durable_dir: Optional[str] = None,
                  durable_fsync=True,
                  fsync_window: int = 8,
-                 mirror_anchor: bool = True,
+                 mirror_anchor=True,
                  **caps):
         if family not in _FAMILIES:
             raise ValueError(f"unknown family {family!r} (one of {sorted(_FAMILIES)})")
@@ -166,6 +166,7 @@ class ResidentServer:
                     family=family, n_docs=n_docs, caps=dict(caps),
                     auto_grow=auto_grow, host_fallback=host_fallback,
                     fsync_mode=durable.fsync_mode,
+                    deep_anchor=(mirror_anchor == "deep"),
                 ))
             except BaseException:
                 durable.close()  # never leak the active segment handle
@@ -174,7 +175,11 @@ class ResidentServer:
         if host_fallback and mirror_anchor:
             from ..persist import MirrorAnchor
 
-            anchor = MirrorAnchor(family, n_docs)
+            # mirror_anchor="deep" folds full snapshots (history kept)
+            # instead of StateOnly blobs — the sharded fleet passes it
+            # so live doc migration can re-export history (SHARDING.md)
+            anchor = MirrorAnchor(family, n_docs,
+                                  deep=(mirror_anchor == "deep"))
         self._init_resilience(
             mesh=mesh, auto_grow=auto_grow, caps=dict(caps),
             supervisor=supervisor, host_fallback=host_fallback,

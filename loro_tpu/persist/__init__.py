@@ -40,4 +40,15 @@ __all__ = [
     "WriteAheadLog",
     "open_server",
     "recover_server",
+    "recover_sharded_server",
 ]
+
+
+def recover_sharded_server(durable_dir: str, mesh=None, fsync: bool = True):
+    """Reopen a sharded fleet directory (``sharding.json`` manifest +
+    per-shard WAL/ladder sub-dirs) — see
+    ``parallel.sharded.recover_sharded_server`` (lazy import: the
+    sharded module pulls in the jax-backed fleet)."""
+    from ..parallel.sharded import recover_sharded_server as _impl
+
+    return _impl(durable_dir, mesh=mesh, fsync=fsync)

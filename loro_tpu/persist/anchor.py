@@ -34,7 +34,7 @@ from ..errors import CodecDecodeError, DecodeError, PersistError
 from ..obs import metrics as obs
 from .wal import DurableLog, read_cid_opt, write_cid_opt
 
-ANCHOR_VERSION = 1
+ANCHOR_VERSION = 2
 
 
 class MirrorAnchor:
@@ -43,11 +43,18 @@ class MirrorAnchor:
     ``advance(rounds, cid)`` folds journal rounds (epoch-ascending,
     frozen wire bytes) newer than the anchor into the per-doc blobs;
     ``seed_engine()`` builds a ``hostpath.HostEngine`` whose docs start
-    from the anchors instead of from birth."""
+    from the anchors instead of from birth.
 
-    def __init__(self, family: str, n_docs: int):
+    ``deep=True`` anchors fold FULL snapshots (history included)
+    instead of StateOnly blobs: the seeded mirror docs can then export
+    updates since birth — the capability live doc migration between
+    shards needs (docs/SHARDING.md) — at the cost of history-sized
+    instead of state-sized anchor blobs."""
+
+    def __init__(self, family: str, n_docs: int, deep: bool = False):
         self.family = family
         self.n_docs = n_docs
+        self.deep = deep
         self.epoch = 0
         self.cid = None
         # per-doc StateOnly blob (b"" = doc still empty at the anchor)
@@ -106,15 +113,24 @@ class MirrorAnchor:
             self.cid = eng._cid
         for i in touched:
             d = eng.docs[i]
-            self.doc_blobs[i] = (
-                d.export(ExportMode.StateOnly) if len(d.oplog_vv()) else b""
-            )
+            if not len(d.oplog_vv()):
+                self.doc_blobs[i] = b""
+            elif self.deep:
+                self.doc_blobs[i] = d.export(ExportMode.Snapshot)
+            else:
+                self.doc_blobs[i] = d.export(ExportMode.StateOnly)
             self.seen_cids[i] = list(eng._seen_cids[i])
 
     # -- wire ----------------------------------------------------------
     def encode(self) -> bytes:
         w = Writer()
-        w.u8(ANCHOR_VERSION)
+        # non-deep anchors stay on the v1 layout byte-for-byte; the
+        # flags byte exists only in v2 (deep) blobs.  Literal layout
+        # versions on purpose: a future ANCHOR_VERSION bump must not
+        # silently re-tag these bytes
+        w.u8(2 if self.deep else 1)
+        if self.deep:
+            w.u8(1)
         w.str_(self.family)
         w.varint(self.n_docs)
         w.varint(self.epoch)
@@ -134,7 +150,8 @@ class MirrorAnchor:
             ver = r.u8()
             if ver > ANCHOR_VERSION:
                 raise CodecDecodeError(f"mirror anchor v{ver} too new")
-            a = cls(r.str_(), r.varint())
+            deep = bool(r.u8() & 1) if ver >= 2 else False
+            a = cls(r.str_(), r.varint(), deep=deep)
             a.epoch = r.varint()
             a.cid = read_cid_opt(r)
             a.doc_blobs = [r.bytes_() for _ in range(a.n_docs)]
@@ -231,7 +248,9 @@ def recover_server(durable_dir: str, mesh=None, fsync: bool = True):
             srv = ResidentServer(
                 meta.family, meta.n_docs, mesh=mesh,
                 auto_grow=meta.auto_grow, host_fallback=meta.host_fallback,
-                auto_checkpoint=False, **meta.caps,
+                auto_checkpoint=False,
+                mirror_anchor="deep" if meta.deep_anchor else True,
+                **meta.caps,
             )
         # bounded replay: only rounds after the restored epoch
         tail = log.wal.rounds_after(report.checkpoint_epoch)

@@ -7,13 +7,17 @@ Usage::
 Shows the WAL meta, every segment (records, epoch range, crc/torn
 status), every checkpoint rung (epoch, size, crc status) and the
 recovery preview (which rung would restore, how many rounds replay).
-Read-only: never truncates a torn tail, never prunes.
+A sharded fleet directory (``sharding.json`` manifest +
+``shard-NN/`` sub-dirs, docs/SHARDING.md) prints one screen per
+shard plus the fleet-wide minimum durable watermark.  Read-only:
+never truncates a torn tail, never prunes.
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
-from typing import List
+from typing import List, Optional
 
 from ..errors import DecodeError
 from .checkpoints import CheckpointManager
@@ -28,11 +32,95 @@ def _human(n: int) -> str:
     return f"{n}B"
 
 
-def inspect_dir(durable_dir: str, out=None) -> int:
+def _emap_to_global(bps, e: int) -> int:
+    """Manifest epoch-map interpolation: shard-local epoch → fleet
+    global, through the REAL `_EpochMap` (parallel/placement.py is
+    jax-free on purpose so this tool can import it)."""
+    from ..parallel.placement import _EpochMap
+
+    return _EpochMap.decode(bps).to_global(e)
+
+
+def _inspect_sharded(durable_dir: str, manifest: dict, out) -> int:
+    """Multi-shard report: one screen per shard + the fleet-wide min
+    durable watermark — the min over shards of each shard's durable
+    floor (newest journaled round, or newest valid checkpoint rung
+    when the WAL was legitimately pruned by it), translated to the
+    GLOBAL clock through the manifest's epoch maps (shard clocks tick
+    faster than fleet rounds — delete rounds, poison isolation).  A
+    shard with neither rounds nor rungs while its siblings have some
+    pins the floor to 0: lockstep clocks journal every fleet round to
+    every shard, so a bare directory next to full ones means that
+    shard lost its durable state."""
+    p = lambda s="": print(s, file=out)  # noqa: E731
+    n_shards = int(manifest.get("shards", 0))
+    placement = manifest.get("shard_of", [])
+    emaps = manifest.get("emaps") or [[[0, 0]]] * n_shards
+    p(f"sharded fleet: {durable_dir}")
+    p(f"manifest: family={manifest.get('family')} "
+      f"n_docs={manifest.get('n_docs')} shards={n_shards} "
+      f"global_epoch={manifest.get('global_epoch')}")
+    rc = 0
+    marks: List[tuple] = []  # (shard, shard-local floor or None)
+    for s in range(n_shards):
+        sub = os.path.join(durable_dir, f"shard-{s:02d}")
+        docs = [g for g, sh in enumerate(placement) if sh == s]
+        p()
+        p(f"--- shard-{s:02d} ({len(docs)} doc(s): "
+          f"{','.join(map(str, docs[:8]))}"
+          f"{',...' if len(docs) > 8 else ''}) ---")
+        if not os.path.isdir(sub):
+            p("  MISSING (manifest names it, directory absent)")
+            rc = 1
+            marks.append((s, None))
+            continue
+        stats: dict = {}
+        rc = max(rc, inspect_dir(sub, out=out, _stats=stats))
+        floors = [e for e in (stats.get("newest_round_epoch"),
+                              stats.get("newest_ckpt_epoch"))
+                  if e is not None]
+        marks.append((s, max(floors) if floors else None))
+    p()
+    known = [(s, e) for s, e in marks if e is not None]
+    if not known:
+        p("fleet-wide min durable watermark: (nothing journaled yet)")
+    elif len(known) < len(marks):
+        bare = ", ".join(f"shard-{s:02d}" for s, e in marks if e is None)
+        p(f"fleet-wide min durable watermark: global epoch 0 — {bare} "
+          "holds NO rounds and NO rungs while siblings do "
+          "(lost/missing durable state?)")
+        rc = 1
+    else:
+        s_min, g_min, e_min = min(
+            ((s, _emap_to_global(emaps[s] if s < len(emaps) else [[0, 0]],
+                                 e), e)
+             for s, e in known),
+            key=lambda x: x[1],
+        )
+        p(f"fleet-wide min durable watermark: global epoch {g_min} "
+          f"(shard-{s_min:02d} local e{e_min})")
+    return rc
+
+
+def inspect_dir(durable_dir: str, out=None, _stats: Optional[dict] = None) -> int:
     """Print the report; returns a process exit code (0 clean, 1 if
-    any segment is torn/corrupt or any rung fails its crc)."""
+    any segment is torn/corrupt or any rung fails its crc).  A
+    sharded fleet dir recurses into its shards.  ``_stats`` (internal)
+    receives facts the sharded summary needs from the single scan —
+    currently ``newest_round_epoch`` — so the fleet report never
+    re-reads segments."""
     out = out or sys.stdout
     p = lambda s="": print(s, file=out)  # noqa: E731
+    manifest_path = os.path.join(durable_dir, "sharding.json")
+    if os.path.isfile(manifest_path):
+        try:
+            with open(manifest_path, "r") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            p(f"sharded fleet: {durable_dir}")
+            p(f"  sharding.json UNREADABLE ({e})")
+            return 1
+        return _inspect_sharded(durable_dir, manifest, out)
     rc = 0
     wal_dir = os.path.join(durable_dir, "wal")
     p(f"persist dir: {durable_dir}")
@@ -67,11 +155,16 @@ def inspect_dir(durable_dir: str, out=None) -> int:
         p(f"meta: family={meta.family} n_docs={meta.n_docs} "
           f"auto_grow={meta.auto_grow} host_fallback={meta.host_fallback} "
           f"fsync={meta.fsync_mode}"
+          + (" deep_anchor=True" if meta.deep_anchor else "")
           + (f" {caps}" if caps else ""))
     else:
         p("meta: (none)")
     p(f"wal segments: {len(segs)}")
     rounds = [r for recs in seg_recs for r in recs if r.rtype == R_ROUND]
+    if _stats is not None:
+        _stats["newest_round_epoch"] = max(
+            (r.epoch for r in rounds), default=None
+        )
     for s in segs:
         span = ("-" if s.min_epoch is None
                 else f"e{s.min_epoch}..e{s.max_epoch}")
@@ -100,6 +193,10 @@ def inspect_dir(durable_dir: str, out=None) -> int:
             status = f"CORRUPT ({e})"
             rc = 1
         p(f"  {info.name}  {_human(info.size):>8}  epoch {info.epoch}  {status}")
+    if _stats is not None:
+        _stats["newest_ckpt_epoch"] = (
+            newest_valid.epoch if newest_valid is not None else None
+        )
 
     # -- recovery preview ----------------------------------------------
     if newest_valid is not None:

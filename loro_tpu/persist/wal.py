@@ -169,6 +169,10 @@ class WalMeta:
     auto_grow: bool = True
     host_fallback: bool = True
     fsync_mode: str = "per_round"
+    # deep (history-complete) mirror anchor — sharded fleets set it so
+    # a cold recovery rebuilds a migration-capable server; like
+    # fsync_mode it is informational for the reopen mismatch check
+    deep_anchor: bool = False
 
     def compatible(self, other: "WalMeta") -> bool:
         """Same server shape (the refusal check ignores fsync_mode)."""
@@ -190,6 +194,7 @@ class WalMeta:
             (1 if self.auto_grow else 0)
             | (2 if self.host_fallback else 0)
             | (4 if self.fsync_mode == "group" else 0)
+            | (8 if self.deep_anchor else 0)
         )
         write_caps(w, self.caps)
         return bytes(w.buf)
@@ -205,7 +210,7 @@ class WalMeta:
         caps = read_caps(r)
         return cls(
             family, n_docs, caps, bool(flags & 1), bool(flags & 2),
-            "group" if flags & 4 else "per_round",
+            "group" if flags & 4 else "per_round", bool(flags & 8),
         )
 
 

@@ -20,6 +20,14 @@ SECOND epoch after a flush (so consecutive epochs actually coalesce
 into one device group), and the coalesced state must still match the
 host oracles byte-for-byte.  Composes with SOAK_RES_DURABLE=1 (the
 pipelined rounds then ride the WAL group-commit window).
+
+SOAK_RES_SHARDS=N rides every family on a ShardedResidentServer over
+N doc-axis shards of the CPU mesh (ISSUE 8): ingest routes by
+rendezvous placement, reads merge back across shards, one doc
+migrates between shards at mid-run, and the per-epoch gates hold
+unchanged.  Composes with DURABLE (per-shard WALs + manifest, the
+reopen goes through persist.recover_sharded_server) and PIPELINE
+(per-shard executors behind one submit).
 """
 import os
 import os.path as _p
@@ -50,6 +58,7 @@ EPOCHS = int(os.environ.get("SOAK_RES_EPOCHS", "10"))
 SEED = int(os.environ.get("SOAK_RES_SEED", "0"))
 DURABLE = os.environ.get("SOAK_RES_DURABLE", "0") == "1"
 PIPELINE = os.environ.get("SOAK_RES_PIPELINE", "0") == "1"
+SHARDS = int(os.environ.get("SOAK_RES_SHARDS", "0"))
 
 t0 = time.time()
 rng = random.Random(SEED)
@@ -66,7 +75,7 @@ mesh = make_mesh()
 cid_t = pairs[0][0].get_text("t").id
 cid_ml = pairs[0][0].get_movable_list("ml").id
 cid_tr = pairs[0][0].get_tree("tr").id
-if DURABLE or PIPELINE:
+if DURABLE or PIPELINE or SHARDS:
     import shutil
     import tempfile
 
@@ -82,6 +91,12 @@ if DURABLE or PIPELINE:
                 # pipelined rounds ride the WAL group-commit window
                 kw["durable_fsync"] = "group"
                 kw["fsync_window"] = 4
+        if SHARDS:
+            from loro_tpu.parallel.sharded import ShardedResidentServer
+
+            return ShardedResidentServer(
+                fam, N, shards=SHARDS, mesh=mesh, **caps, **kw
+            )
         return ResidentServer(fam, N, mesh=mesh, **caps, **kw)
 
     docs_b = _srv("text", capacity=1 << 13)
@@ -91,6 +106,9 @@ if DURABLE or PIPELINE:
     ml_b = _srv("movable", capacity=1 << 12, elem_capacity=512)
     if DURABLE:
         print(f"durable mode: journaling to {_soak_dir}")
+    if SHARDS:
+        print(f"sharded mode: {SHARDS} shards per family, placement "
+              f"{docs_b.placement.shard_of}")
     if PIPELINE:
         for _b, _cid in ((docs_b, cid_t), (maps_b, None), (tree_b, cid_tr),
                          (ctr_b, None), (ml_b, cid_ml)):
@@ -107,7 +125,7 @@ else:
 def _ingest(b, ups, cid=None):
     if PIPELINE:
         b._soak_pipe.submit(ups)
-    elif DURABLE:
+    elif DURABLE or SHARDS:
         b.ingest(ups, cid)
     elif cid is not None:
         b.append_changes(ups, cid)
@@ -121,9 +139,12 @@ def _flush_all():
             b._soak_pipe.flush()
 
 
-def _batch(b):
-    """The device batch under either driver (compaction floors)."""
-    return b.batch if (DURABLE or PIPELINE) else b
+def _batches(b):
+    """The device batch(es) under any driver (compaction floors) —
+    a sharded fleet holds one per shard."""
+    if SHARDS:
+        return [s.batch for s in b.shards]
+    return [b.batch if (DURABLE or PIPELINE) else b]
 
 
 marks = [a.oplog_vv() for a, _ in pairs]
@@ -199,6 +220,18 @@ for epoch in range(EPOCHS):
     _ingest(ctr_b, ups)
     _ingest(ml_b, ups, cid_ml)
 
+    if SHARDS > 1 and epoch == EPOCHS // 2:
+        # live migration mid-soak (BEFORE the pipeline coalesce skip,
+        # which would silently drop this one-shot on even epochs):
+        # move doc 0 of every family to the next shard; the per-epoch
+        # gates below must hold unchanged.  migrate() drains the
+        # attached pipeline itself.
+        for b in (docs_b, maps_b, tree_b, ctr_b, ml_b):
+            src = b.placement.place(0)[0]
+            b.migrate(0, (src + 1) % SHARDS)
+        print(f"  epoch {epoch}: migrated doc 0 across shards "
+              "(all five families)")
+
     if PIPELINE and epoch % 2 == 0 and epoch != EPOCHS - 1:
         # pipeline mode: let consecutive epochs coalesce into one
         # device group — gates (and compaction) run on flush epochs
@@ -212,8 +245,8 @@ for epoch in range(EPOCHS):
         # tree child order, movable slot remaps)
         gc = 0
         for b in (docs_b, tree_b, ml_b):
-            db = _batch(b)
-            gc += db.compact([db.epoch] * db.d)
+            for db in _batches(b):
+                gc += db.compact([db.epoch] * db.d)
         print(f"  epoch {epoch}: compaction reclaimed {gc} rows")
 
     if DURABLE and epoch % 3 == 2:
@@ -251,18 +284,26 @@ if DURABLE:
     # crash-recovery gate: reopen every family from its durable dir
     # (newest checkpoint + bounded WAL replay) and re-verify all five
     # families byte-for-byte against the host oracles
-    from loro_tpu.persist import recover_server
+    from loro_tpu.persist import recover_server, recover_sharded_server
 
     for b in (docs_b, maps_b, tree_b, ctr_b, ml_b):
         b.close()
+    _reopen = recover_sharded_server if SHARDS else recover_server
     rec = {
-        fam: recover_server(os.path.join(_soak_dir, fam), mesh=mesh)
+        fam: _reopen(os.path.join(_soak_dir, fam), mesh=mesh)
         for fam in ("text", "map", "tree", "counter", "movable")
     }
     for fam, srv in rec.items():
-        r = srv.last_recovery
-        print(f"  recovered {fam}: ckpt epoch {r.checkpoint_epoch}, "
-              f"{r.rounds_replayed} rounds replayed")
+        if SHARDS:
+            for s, sub in enumerate(srv.shards):
+                r = sub.last_recovery
+                print(f"  recovered {fam} shard {s}: ckpt epoch "
+                      f"{r.checkpoint_epoch}, {r.rounds_replayed} "
+                      "rounds replayed")
+        else:
+            r = srv.last_recovery
+            print(f"  recovered {fam}: ckpt epoch {r.checkpoint_epoch}, "
+                  f"{r.rounds_replayed} rounds replayed")
     texts = rec["text"].texts()
     segs = rec["text"].richtexts()
     mvals = rec["map"].root_value_maps("m")

@@ -108,6 +108,16 @@ def _fat_checkpoint():
               "epochs": 6, "push_to_visible_ms_p50": 47.7,
               "push_to_visible_ms_p99": 952.7, "pull_bytes_mean": 272.1,
               "pulls": 96, "note": "s" * 300},
+        shard_count=8,
+        shard_rows_per_sec=900_000,
+        shard_scaling_x=2.4,
+        shard={"shards": 8, "rounds": 24, "groups": 12,
+               "coalesced_rounds": 20, "max_group": 8,
+               "backpressure_waits": 0, "stage_s": 1.2, "commit_s": 0.9,
+               "overlap_s": 0.5, "docs": 32, "rows_per_round": 192,
+               "rows_per_sec_1shard": 380_000, "rows_per_sec": 900_000,
+               "scaling_x": 2.4, "scaling_efficiency": 0.3,
+               "note": "h" * 300},
         metrics=fat_metrics,
         resilience={"launches": 100, "retries": 2, "failures": 0,
                     "note": "r" * 300},
@@ -128,12 +138,14 @@ class TestFlagshipLine:
                   "resident_durable_group_fsyncs", "rank_gather_reduction",
                   "sync_sessions", "sync_pushes_per_sec",
                   "sync_push_to_visible_ms_p50",
-                  "sync_push_to_visible_ms_p99"):
+                  "sync_push_to_visible_ms_p99",
+                  "shard_count", "shard_scaling_x", "shard_rows_per_sec"):
             assert k in back, k
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
         for k in ("metrics", "resilience", "pipeline", "rank", "sync",
-                  "baseline_note", "roofline_note", "resident_pipeline_note"):
+                  "shard", "baseline_note", "roofline_note",
+                  "resident_pipeline_note"):
             assert k in side, k
             assert k not in back, k
         assert side["sidecars_for"] == back["metric"]

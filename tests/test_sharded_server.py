@@ -1,0 +1,620 @@
+"""Sharded resident fleet (ISSUE 8): deterministic placement, the
+five-family differential gate (sharded state byte-identical per doc to
+a single-device ResidentServer fed the same rounds — including under a
+coalesced pipeline, an injected per-shard DeviceFailure, and a durable
+reopen via per-shard recover_server), live migration with a SyncServer
+on top, and typed ConfigError validation of the shard knobs."""
+import os
+import random
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.codec.binary import encode_changes
+from loro_tpu.doc import strip_envelope
+from loro_tpu.errors import ConfigError, ShardingError
+from loro_tpu.parallel.mesh import make_mesh, shard_meshes
+from loro_tpu.parallel.server import ResidentServer
+from loro_tpu.parallel.sharded import (
+    ShardedResidentServer,
+    ShardPlacement,
+    _EpochMap,
+    recover_sharded_server,
+    rendezvous_shard,
+)
+from loro_tpu.resilience import faultinject
+
+FAMILIES = ["text", "map", "tree", "movable", "counter"]
+
+CAPS = {
+    "text": dict(capacity=1 << 12),
+    "map": dict(slot_capacity=64),
+    "tree": dict(move_capacity=1 << 10, node_capacity=128),
+    "movable": dict(capacity=1 << 10, elem_capacity=128),
+    "counter": dict(slot_capacity=16),
+}
+
+
+def _mk_docs(n=6, seed=0):
+    """n host replicas edited across all five container families, plus
+    frozen per-round update bytes (the journal/wire contract, so
+    change-RLE aliasing never blurs a comparison)."""
+    rng = random.Random(seed)
+    docs = []
+    for i in range(n):
+        d = LoroDoc(peer=100 + 2 * i)
+        d.get_text("t").insert(0, f"shard base {i}")
+        d.get_map("m").set("k", i)
+        d.get_tree("tr").create()
+        d.get_counter("c").increment(i + 1)
+        d.get_movable_list("ml").push("a", "b")
+        d.commit()
+        docs.append(d)
+    cids = {
+        "text": docs[0].get_text("t").id,
+        "tree": docs[0].get_tree("tr").id,
+        "movable": docs[0].get_movable_list("ml").id,
+        "map": None,
+        "counter": None,
+    }
+    marks = [d.oplog_vv() for d in docs]
+    rounds = [[
+        bytes(encode_changes(list(d.oplog.changes_in_causal_order())))
+        for d in docs
+    ]]
+    for r in range(5):
+        ups = []
+        for i, d in enumerate(docs):
+            t = d.get_text("t")
+            L = len(t)
+            if L > 6 and rng.random() < 0.3:
+                t.delete(rng.randrange(L - 2), 2)
+            else:
+                t.insert(rng.randint(0, L), rng.choice(["xy", "q "]))
+            d.get_map("m").set(rng.choice(["k", "j"]), rng.randrange(50))
+            tr = d.get_tree("tr")
+            nodes = tr.nodes()
+            tr.create(rng.choice(nodes) if nodes and rng.random() < 0.5
+                      else None)
+            d.get_counter("c").increment(rng.randint(-5, 9))
+            ml = d.get_movable_list("ml")
+            L = len(ml)
+            if L >= 2 and rng.random() < 0.4:
+                ml.move(rng.randrange(L), rng.randrange(L))
+            else:
+                ml.insert(rng.randint(0, L), f"v{r}")
+            d.commit()
+            ups.append(bytes(encode_changes(
+                list(d.oplog.changes_between(marks[i], d.oplog_vv()))
+            )))
+            marks[i] = d.oplog_vv()
+        rounds.append(ups)
+    return docs, cids, rounds
+
+
+def _reads(srv, family, docs):
+    """(got, want) for the family's read surface vs the host docs."""
+    if family == "text":
+        return srv.texts(), [d.get_text("t").to_string() for d in docs]
+    if family == "map":
+        return (srv.root_value_maps("m"),
+                [d.get_map("m").get_value() for d in docs])
+    if family == "tree":
+        return srv.parent_maps(), [
+            {x: d.get_tree("tr").parent(x) for x in d.get_tree("tr").nodes()}
+            for d in docs
+        ]
+    if family == "movable":
+        return (srv.value_lists(),
+                [d.get_movable_list("ml").get_value() for d in docs])
+    return srv.value_maps(), None  # counter: compare sharded vs serial
+
+
+class TestPlacement:
+    def test_rendezvous_deterministic(self):
+        # stability across calls AND processes: blake2b, not hash()
+        got = [rendezvous_shard(str(i), 4) for i in range(16)]
+        assert got == [rendezvous_shard(str(i), 4) for i in range(16)]
+        p1 = ShardPlacement(32, 4)
+        p2 = ShardPlacement(32, 4)
+        assert p1.shard_of == p2.shard_of
+        assert p1.slot_of == p2.slot_of
+        # every shard owns someone at this size (balance sanity)
+        assert set(p1.shard_of) == set(range(4))
+
+    def test_rendezvous_minimal_movement_on_resize(self):
+        n = 256
+        before = [rendezvous_shard(str(i), 4) for i in range(n)]
+        after = [rendezvous_shard(str(i), 5) for i in range(n)]
+        moved = [i for i in range(n) if before[i] != after[i]]
+        # rendezvous: growing the shard set moves docs ONLY to the new
+        # shard — never between surviving shards
+        assert all(after[i] == 4 for i in moved)
+        # and roughly 1/5 of them (generous bound)
+        assert len(moved) < 2 * n // 5
+
+    def test_custom_keys_override_index(self):
+        keys = [f"cid:{i}" for i in range(8)]
+        p = ShardPlacement(8, 2, keys=keys)
+        assert p.shard_of == [rendezvous_shard(k, 2) for k in keys]
+        with pytest.raises(ValueError):
+            ShardPlacement(8, 2, keys=keys[:3])
+
+    def test_epoch_map_translation_never_leads(self):
+        m = _EpochMap()
+        for g in range(1, 5):
+            m.note(g, g)
+        m.note(5, 9)  # poison isolation: shard clock jumped by 5
+        for g in range(6, 9):
+            m.note(g, g + 4)
+        assert m.to_shard(4) == 4
+        assert m.to_shard(5) == 9
+        assert m.to_shard(8) == 12
+        # inverse stays conservative through the skew gap
+        assert m.to_global(4) == 4
+        for e in (5, 6, 7, 8, 9):
+            assert m.to_global(e) <= 5
+        assert m.to_global(12) == 8
+
+
+class TestKnobs:
+    def test_shards_must_divide_mesh(self):
+        with pytest.raises(ConfigError):
+            ShardedResidentServer("text", 4, shards=3, capacity=64)
+
+    def test_shards_positive_int(self):
+        with pytest.raises(ConfigError):
+            shard_meshes(make_mesh(), 0)
+
+    def test_loro_shards_env_typed(self):
+        os.environ["LORO_SHARDS"] = "two"
+        try:
+            with pytest.raises(ConfigError) as ei:
+                ShardedResidentServer("text", 4, capacity=64)
+            assert "LORO_SHARDS" in str(ei.value)
+            os.environ["LORO_SHARDS"] = "-1"
+            with pytest.raises(ConfigError):
+                ShardedResidentServer("text", 4, capacity=64)
+            os.environ["LORO_SHARDS"] = "2"
+            srv = ShardedResidentServer("text", 4, capacity=64)
+            assert srv.n_shards == 2
+            srv.close()
+        finally:
+            del os.environ["LORO_SHARDS"]
+
+
+class TestDifferentialGate:
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_sharded_state_byte_identical(self, family):
+        """The acceptance gate: per-shard batch state byte-identical to
+        a single-device ResidentServer fed the same rounds (serial AND
+        through the per-shard coalesced pipeline), reads equal to the
+        host docs."""
+        docs, cids, rounds = _mk_docs(6, seed=hash(family) & 0xFFFF)
+        srv = ShardedResidentServer(family, 6, shards=2, **CAPS[family])
+        for r in rounds:
+            srv.ingest(list(r), cids[family])
+        # per-shard byte gate: a single-device reference on the SAME
+        # sub-mesh fed the shard-local slices of every round
+        for s in range(srv.n_shards):
+            ref = ResidentServer(
+                family, srv.placement.widths[s], mesh=srv.meshes[s],
+                **CAPS[family],
+            )
+            for r in rounds:
+                part = [None] * srv.placement.widths[s]
+                for g, u in enumerate(r):
+                    ss, l = srv.placement.place(g)
+                    if ss == s:
+                        part[l] = u
+                ref.ingest(part, cids[family])
+            assert ref.batch.export_state() == \
+                srv.shards[s].batch.export_state(), f"shard {s} diverged"
+        got, want = _reads(srv, family, docs)
+        if want is not None:
+            assert got == want
+        # pipelined: same rounds through per-shard executors
+        pl = ShardedResidentServer(family, 6, shards=2, **CAPS[family])
+        ex = pl.pipeline(cid=cids[family], coalesce=4)
+        prs = [ex.submit(list(r)) for r in rounds]
+        eps = [pr.epoch(60) for pr in prs]
+        assert eps == list(range(1, len(rounds) + 1))
+        ex.flush()
+        for s in range(srv.n_shards):
+            assert pl.shards[s].batch.export_state() == \
+                srv.shards[s].batch.export_state(), \
+                f"pipelined shard {s} diverged"
+        got_p, _ = _reads(pl, family, docs)
+        assert got_p == got
+        ex.close()
+        srv.close()
+        pl.close()
+
+    @pytest.mark.parametrize("family", ["text", "map"])
+    @pytest.mark.faultinject
+    def test_per_shard_device_failure_isolates(self, family):
+        """A DeviceFailure poisons ONE shard's batch onto its host
+        mirror; the other shard never notices, reads stay exact, and
+        recover() brings the failed shard back."""
+        docs, cids, rounds = _mk_docs(6, seed=7)
+        srv = ShardedResidentServer(family, 6, shards=2, **CAPS[family])
+        srv.ingest(list(rounds[0]), cids[family])
+        try:
+            faultinject.inject(
+                "launch",
+                exc=RuntimeError("INTERNAL: injected device death"),
+                times=1,
+            )
+            srv.ingest(list(rounds[1]), cids[family])
+        finally:
+            faultinject.clear()
+        assert len(srv.degraded_shards()) == 1
+        healthy = [s for s in range(2) if s not in srv.degraded_shards()]
+        assert not srv.shards[healthy[0]].degraded
+        for r in rounds[2:]:
+            srv.ingest(list(r), cids[family])
+        got, want = _reads(srv, family, docs)
+        if want is not None:
+            assert got == want
+        assert srv.recover()
+        assert not srv.degraded
+        got2, _ = _reads(srv, family, docs)
+        assert got2 == got
+        srv.close()
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_durable_reopen_per_shard(self, family, tmp_path):
+        """Durable fleet: per-shard WALs + ladders reopen independently
+        through recover_sharded_server; state re-gates against the host
+        docs and the single-device byte reference."""
+        docs, cids, rounds = _mk_docs(6, seed=11)
+        ddir = str(tmp_path / "fleet")
+        srv = ShardedResidentServer(
+            family, 6, shards=2, durable_dir=ddir, **CAPS[family]
+        )
+        for r in rounds[:3]:
+            srv.ingest(list(r), cids[family])
+        srv.checkpoint()
+        for r in rounds[3:]:
+            srv.ingest(list(r), cids[family])
+        assert srv.durable_epoch == len(rounds)
+        base_states = [s.batch.export_state() for s in srv.shards]
+        srv.close()
+        rec = recover_sharded_server(ddir)
+        assert rec.epoch == len(rounds)
+        assert [s.batch.export_state() for s in rec.shards] == base_states
+        # bounded replay happened per shard (3 rounds after the rung)
+        for s in rec.shards:
+            assert s.last_recovery.rounds_replayed == len(rounds) - 3
+        got, want = _reads(rec, family, docs)
+        if want is not None:
+            assert got == want
+        # the reopened fleet keeps serving
+        rec.ingest([None] * 6, cids[family])
+        assert rec.epoch == len(rounds) + 1
+        rec.close()
+
+    def test_durable_group_commit_watermark(self, tmp_path):
+        """durable_fsync="group": the fleet watermark is the min over
+        shards and advances at flush points; a migration flushes the
+        window BEFORE publishing the new placement (a manifest that
+        durably pointed a doc at a never-fsynced slot would serve it
+        empty after a crash)."""
+        docs, cids, rounds = _mk_docs(4, seed=3)
+        srv = ShardedResidentServer(
+            "text", 4, shards=2, durable_dir=str(tmp_path / "g"),
+            durable_fsync="group", fsync_window=64, **CAPS["text"],
+        )
+        for r in rounds:
+            srv.ingest(list(r), cids["text"])
+        assert srv.durable_epoch < len(rounds)  # window not hit yet
+        srv.flush_durable()
+        assert srv.durable_epoch == len(rounds)
+        src, _ = srv.placement.place(0)
+        e = srv.migrate(0, 1 - src)
+        assert srv.durable_epoch == e  # migration round fsync'd
+        srv.close()
+
+    def test_recovery_never_rewinds_global_clock(self, tmp_path):
+        """Crash inside checkpoint() — per-shard rungs written, the
+        manifest write lost: the recovered global clock must resume at
+        or past every previously-issued epoch (reusing one would let
+        stale acks translate into floors that LEAD the shard clock)."""
+        docs, cids, rounds = _mk_docs(4, seed=29)
+        ddir = str(tmp_path / "fleet")
+        srv = ShardedResidentServer(
+            "text", 4, shards=2, durable_dir=ddir, **CAPS["text"]
+        )
+        issued = 0
+        for r in rounds:
+            issued = srv.ingest(list(r), cids["text"])
+        # simulate the torn checkpoint: rungs land (journals trim, WAL
+        # rotates) but the wrapper manifest is never rewritten
+        for s in srv.shards:
+            s.checkpoint()
+        before = srv.texts()
+        srv.close()
+        rec = recover_sharded_server(ddir)
+        assert rec.epoch >= issued
+        assert rec.texts() == before
+        e2 = rec.ingest([None] * 4, cids["text"])
+        assert e2 > issued  # fresh epochs never collide with acked ones
+        rec.close()
+
+
+class TestMigration:
+    def test_live_migration_exact_state(self):
+        """Move a doc between shards mid-stream (after a checkpoint, so
+        the deep-anchor history export is load-bearing): reads stay
+        exact through and after the move, rounds fed after it land
+        under the new placement exactly once."""
+        docs, cids, rounds = _mk_docs(6, seed=5)
+        srv = ShardedResidentServer("text", 6, shards=2, **CAPS["text"])
+        for r in rounds[:3]:
+            srv.ingest(list(r), cids["text"])
+        srv.checkpoint()  # trims journals; anchors must carry history
+        before = srv.texts()
+        g_doc = 1
+        src, _ = srv.placement.place(g_doc)
+        dst = 1 - src
+        e = srv.migrate(g_doc, dst)
+        assert e == srv.epoch
+        assert srv.placement.place(g_doc)[0] == dst
+        assert srv.texts() == before  # the move itself changes nothing
+        for r in rounds[3:]:
+            srv.ingest(list(r), cids["text"])
+        got, want = _reads(srv, "text", docs)
+        assert got == want
+        # the retired source slot stopped absorbing the doc's rounds:
+        # the target's mirror holds the post-move history
+        eng = srv.seed_mirror_engine()
+        assert eng.docs[g_doc].get_text("t").to_string() == want[g_doc]
+        srv.close()
+
+    def test_migration_slot_exhaustion_typed(self):
+        srv = ShardedResidentServer(
+            "map", 4, shards=2, spare_slots=0, slot_capacity=16
+        )
+        srv.ingest([None] * 4)
+        src, _ = srv.placement.place(0)
+        with pytest.raises(ShardingError):
+            srv.migrate(0, 1 - src)
+        srv.close()
+
+    @pytest.mark.faultinject
+    def test_migration_poison_rolls_back(self):
+        """A poison-skipped history payload must never leave the doc
+        silently empty at its new slot: placement rolls back, the
+        spare slot is reclaimed, and the error is typed."""
+        docs, cids, rounds = _mk_docs(4, seed=21)
+        srv = ShardedResidentServer("text", 4, shards=2, **CAPS["text"])
+        srv.ingest(list(rounds[0]), cids["text"])
+        before = srv.texts()
+        src, src_slot = srv.placement.place(0)
+        dst = 1 - src
+        slot = srv.placement.free[dst][0]
+        try:
+            faultinject.inject(
+                "poison_doc", action="truncate", keep_bytes=3, docs=[slot]
+            )
+            with pytest.raises(ShardingError):
+                srv.migrate(0, dst)
+        finally:
+            faultinject.clear()
+        assert srv.placement.place(0) == (src, src_slot)
+        assert srv.placement.free[dst][0] == slot  # slot reclaimed
+        assert srv.texts() == before  # still serves from the source
+        # and a clean retry succeeds
+        srv.migrate(0, dst)
+        assert srv.placement.place(0)[0] == dst
+        assert srv.texts() == before
+        srv.close()
+
+    def test_recovery_retires_stale_manifest_free_slots(self, tmp_path):
+        """Crash window between a migration round's WAL fsync and the
+        manifest write: recovery with the OLD manifest must retire the
+        populated spare slot instead of letting a later migrate() land
+        a second doc on top of it."""
+        import json
+
+        from loro_tpu.parallel.sharded import MANIFEST_NAME
+
+        docs, cids, rounds = _mk_docs(4, seed=23)
+        ddir = str(tmp_path / "fleet")
+        srv = ShardedResidentServer(
+            "text", 4, shards=2, durable_dir=ddir, **CAPS["text"]
+        )
+        srv.ingest(list(rounds[0]), cids["text"])
+        pre = open(os.path.join(ddir, MANIFEST_NAME)).read()
+        src, _ = srv.placement.place(0)
+        dst = 1 - src
+        srv.migrate(0, dst)
+        before = srv.texts()
+        srv.close()
+        # simulate the crash: the migration round is fsync'd in the
+        # WAL but the manifest write was lost
+        with open(os.path.join(ddir, MANIFEST_NAME), "w") as f:
+            f.write(pre)
+        rec = recover_sharded_server(ddir)
+        # old placement: the doc serves from its source slot, exact
+        assert rec.placement.place(0)[0] == src
+        assert rec.texts() == before
+        # the populated spare slot was retired from the free list, so
+        # re-running the migration refuses typed instead of merging
+        # two docs into one slot (default spare_slots=1)
+        assert json.loads(pre)["free"][dst]  # manifest SAID it was free
+        assert rec.placement.free[dst] == []
+        with pytest.raises(ShardingError):
+            rec.migrate(0, dst)
+        assert rec.texts() == before
+        rec.close()
+
+    @pytest.mark.faultinject
+    def test_migration_error_rolls_back_placement(self, tmp_path):
+        """A migration round that RAISES (e.g. a WAL append failure on
+        a durable shard) must leave the doc serving from its source
+        slot — never pointing at a slot the round may not have
+        populated (the confirmed silent-empty-doc repro)."""
+        docs, cids, rounds = _mk_docs(4, seed=27)
+        srv = ShardedResidentServer(
+            "text", 4, shards=2, durable_dir=str(tmp_path / "m"),
+            **CAPS["text"],
+        )
+        srv.ingest(list(rounds[0]), cids["text"])
+        before = srv.texts()
+        src, src_slot = srv.placement.place(1)
+        dst = 1 - src
+        try:
+            faultinject.inject("wal_write", exc=OSError("disk full"),
+                               times=1)
+            with pytest.raises(Exception):
+                srv.migrate(1, dst)
+        finally:
+            faultinject.clear()
+        assert srv.placement.place(1) == (src, src_slot)
+        assert srv.texts() == before  # no doc serves empty
+        srv.close()
+
+    @pytest.mark.faultinject
+    def test_migration_refuses_degraded_shard(self):
+        docs, cids, rounds = _mk_docs(4, seed=9)
+        srv = ShardedResidentServer("text", 4, shards=2, **CAPS["text"])
+        srv.ingest(list(rounds[0]), cids["text"])
+        try:
+            faultinject.inject(
+                "launch",
+                exc=RuntimeError("INTERNAL: injected device death"),
+                times=1,
+            )
+            srv.ingest(list(rounds[1]), cids["text"])
+        finally:
+            faultinject.clear()
+        bad = srv.degraded_shards()[0]
+        victim = srv.placement.docs_of(bad)[0]
+        with pytest.raises(ShardingError):
+            srv.migrate(victim, 1 - bad)
+        srv.close()
+
+    def test_sync_sessions_observe_contiguous_epochs_across_move(self):
+        """SyncServer rides the sharded fleet unchanged; a live
+        migration under it keeps the session-visible epoch stream
+        contiguous and pulls converging."""
+        from loro_tpu.sync import SyncServer
+
+        base = LoroDoc(peer=9000)
+        base.get_text("t").insert(0, "sync base ")
+        base.commit()
+        cid = base.get_text("t").id
+        fleet = ShardedResidentServer("text", 4, shards=2, capacity=1 << 12)
+        fleet.ingest(
+            [strip_envelope(base.export_updates({}))] + [None] * 3, cid
+        )
+        ss = SyncServer.over(fleet, cid=cid)
+        try:
+            sess = ss.connect(sid="c1")
+            client = LoroDoc(peer=9001)
+            client.import_(sess.pull(0))
+            seen = []
+            mark = client.oplog_vv()
+            for step in range(4):
+                client.get_text("t").insert(0, f"s{step} ")
+                client.commit()
+                tk = sess.push(0, client.export_updates(mark))
+                mark = client.oplog_vv()
+                seen.append(tk.epoch(60))
+                if step == 1:
+                    src = fleet.placement.place(0)[0]
+                    mig = fleet.migrate(0, 1 - src)
+                    seen.append(mig)
+            # contiguous, strictly increasing epoch stream across the
+            # move (the migration round is an ordinary fleet epoch)
+            assert seen == list(range(seen[0], seen[0] + len(seen)))
+            ss.flush()
+            assert ss.texts()[0] == client.get_text("t").to_string()
+            client.import_(sess.pull(0))
+            assert ss.texts()[0] == client.get_text("t").to_string()
+        finally:
+            ss.close()
+
+
+class TestServingSurface:
+    def test_acks_and_compaction_across_shards(self):
+        docs, cids, rounds = _mk_docs(6, seed=13)
+        srv = ShardedResidentServer("text", 6, shards=2, **CAPS["text"])
+        for i in range(6):
+            srv.register_replica(i, "r0")
+        e = 0
+        for r in rounds:
+            e = srv.ingest(list(r), cids["text"])
+        for i in range(6):
+            srv.ack(i, "r0", e)
+            assert srv.stable_epoch(i) == e
+        n = srv.compact()
+        assert n > 0  # tombstones from the edit rounds reclaimed
+        got, want = _reads(srv, "text", docs)
+        assert got == want
+        srv.close()
+
+    def test_checkpoint_restore_round_trip(self):
+        docs, cids, rounds = _mk_docs(4, seed=17)
+        srv = ShardedResidentServer("movable", 4, shards=2,
+                                    **CAPS["movable"])
+        for r in rounds:
+            srv.ingest(list(r), cids["movable"])
+        blob = srv.checkpoint()
+        rest = ShardedResidentServer.restore(blob)
+        assert rest.epoch == srv.epoch
+        assert rest.value_lists() == srv.value_lists()
+        assert rest.placement.shard_of == srv.placement.shard_of
+        srv.close()
+
+    def test_empty_rounds_keep_clocks_lockstep(self):
+        srv = ShardedResidentServer("map", 4, shards=4, slot_capacity=16)
+        d = LoroDoc(peer=1)
+        d.get_map("m").set("k", 1)
+        d.commit()
+        up = strip_envelope(d.export_updates({}))
+        # a round touching ONE doc still ticks every shard's clock
+        e1 = srv.ingest([up, None, None, None])
+        assert e1 == 1
+        assert all(s.epoch == 1 for s in srv.shards)
+        e2 = srv.ingest([None, None, None, None])
+        assert e2 == 2
+        assert all(s.epoch == 2 for s in srv.shards)
+        srv.close()
+
+    def test_subscribe_epochs_fires_once_per_global_round(self):
+        srv = ShardedResidentServer("counter", 4, shards=2, slot_capacity=8)
+        seen = []
+        unsub = srv.subscribe_epochs(seen.append)
+        srv.ingest([None] * 4)
+        srv.ingest_coalesced([[None] * 4, [None] * 4])
+        assert seen == [1, 2, 3]
+        unsub()
+        srv.ingest([None] * 4)
+        assert seen == [1, 2, 3]
+        srv.close()
+
+
+class TestInspect:
+    def test_inspect_multi_shard_dir(self, tmp_path, capsys):
+        from loro_tpu.persist.inspect import inspect_dir
+
+        docs, cids, rounds = _mk_docs(4, seed=19)
+        ddir = str(tmp_path / "fleet")
+        srv = ShardedResidentServer(
+            "text", 4, shards=2, durable_dir=ddir, **CAPS["text"]
+        )
+        for r in rounds[:2]:
+            srv.ingest(list(r), cids["text"])
+        srv.checkpoint()
+        srv.ingest(list(rounds[2]), cids["text"])
+        srv.close()
+        rc = inspect_dir(ddir)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "sharded fleet" in out
+        assert "shard-00" in out and "shard-01" in out
+        assert "min durable watermark" in out
+        # per-shard screens show their own WAL/ladders
+        assert out.count("checkpoint ladder:") == 2

@@ -58,6 +58,18 @@ BASELINE_NOTE = (
 # peak HBM bandwidth by TPU generation (bytes/s) for the roofline fields
 HBM_PEAK = {"v5e": 819e9, "v5": 819e9, "v4": 1228e9, "v6": 1640e9}
 
+
+def _fetch_sync(out) -> None:
+    """Honest device sync for the kernel micro-phases: fetch the
+    smallest array leaf with np.asarray.  block_until_ready is NOT a
+    sync under the axon tunnel (timings come back ~0ms while the queue
+    drains later) — the LT-TUNNEL post-mortem in docs/ANALYSIS.md."""
+    import jax
+
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "dtype")]
+    if leaves:
+        np.asarray(min(leaves, key=lambda a: getattr(a, "size", 1 << 62)))
+
 T0 = time.time()
 
 
@@ -86,7 +98,7 @@ def _metrics_sidecar() -> dict | None:
 
         side = sidecar()
         return side or None
-    except Exception:
+    except Exception:  # tpulint: disable=LT-EXC(sidecars are optional; the flagship JSON line must always emit)
         return None
 
 
@@ -102,7 +114,7 @@ def _resilience_sidecar() -> dict | None:
         if probe:
             rep["probe"] = probe
         return rep if (rep.get("launches") or probe) else None
-    except Exception:
+    except Exception:  # tpulint: disable=LT-EXC(sidecars are optional; the flagship JSON line must always emit)
         return None
 
 
@@ -337,12 +349,12 @@ def bench_map() -> None:
     )
     dev = MapOpCols(*[jax.device_put(a) for a in cols])
     out = lww_merge_batch(dev, s)
-    jax.block_until_ready(out)
+    _fetch_sync(out)
     t0 = time.perf_counter()
     reps = 5
     for _ in range(reps):
         out = lww_merge_batch(dev, s)
-    jax.block_until_ready(out)
+    _fetch_sync(out)
     dt = (time.perf_counter() - t0) / reps
     _emit_simple(f"lww_map ops merged/sec ({docs}-doc batch, {m} ops/doc)", docs * m / dt)
 
@@ -364,12 +376,12 @@ def bench_tree() -> None:
     d_max = os.environ.get("BENCH_TREE_DEPTH")
     d_max = int(d_max) if d_max else None
     out = tree_merge_batch(dev, n_nodes, d_max)
-    jax.block_until_ready(out)
+    _fetch_sync(out)
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
         out = tree_merge_batch(dev, n_nodes, d_max)
-    jax.block_until_ready(out)
+    _fetch_sync(out)
     dt = (time.perf_counter() - t0) / reps
     _emit_simple(f"tree moves merged/sec ({docs}-doc batch, {m} moves/doc)", docs * m / dt)
 
@@ -423,12 +435,12 @@ def bench_movable() -> None:
         set_valid=jax.device_put(np.ones((docs, n_elems), bool)),
     )
     out = movable_merge_batch(cols, n_elems)
-    jax.block_until_ready(out)
+    _fetch_sync(out)
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
         out = movable_merge_batch(cols, n_elems)
-    jax.block_until_ready(out)
+    _fetch_sync(out)
     dt = (time.perf_counter() - t0) / reps
     _emit_simple(f"movable_list ops merged/sec ({docs}-doc batch, {s} slots/doc)", docs * s / dt)
 
@@ -893,7 +905,7 @@ def main() -> None:
                     ),
                 },
             )
-        except Exception as e:  # an extra, never the headline
+        except Exception as e:  # an extra, never the headline — tpulint: disable=LT-EXC(rank-A/B extra, never the headline)
             note(f"rank A/B phase failed ({type(e).__name__}: {e})")
             bank("rank_ab_failed", partial=f"rank A/B failed: {type(e).__name__}")
 
@@ -948,7 +960,7 @@ def main() -> None:
                 pallas_flight_median=round(p_med) if p_med is not None else None,
                 pallas_flight_ms=[round(t * 1e3, 1) for t in p_flights],
             )
-        except Exception as e:  # pallas is an upgrade, never a downgrade
+        except Exception as e:  # pallas is an upgrade, never a downgrade — tpulint: disable=LT-EXC(pallas is an upgrade, never a downgrade)
             note(f"pallas phase failed ({type(e).__name__}: {e}); keeping XLA numbers")
             bank("pallas_failed", partial=f"pallas failed: {type(e).__name__}")
     else:
@@ -1037,7 +1049,7 @@ def main() -> None:
         try:
             t_rank_m = timed(lambda b: chain_rank_checksum_v(b, rank_impl=impl))
             t_full_m = timed(flagship_fn)
-        except Exception as e:
+        except Exception as e:  # tpulint: disable=LT-EXC(roofline extra, never the headline)
             note(f"measured-roofline phase failed ({type(e).__name__}: {e})")
         else:
             t_rank_net = max(t_rank_m - rtt, 1e-4)
@@ -1117,7 +1129,7 @@ def main() -> None:
                 richtext_unit="ops/s (concurrent marks+edits merge, correctness-gated)",
                 richtext_vs_baseline=round(rt_ops_s / RUST_SINGLE_THREAD_OPS_PER_SEC, 2),
             )
-        except Exception as e:  # an extra, never the headline
+        except Exception as e:  # an extra, never the headline  # tpulint: disable=LT-EXC(richtext extra, never the headline)
             note(f"richtext phase failed ({type(e).__name__}: {e})")
 
     # ---- phase: end-to-end ingest pipeline ---------------------------
@@ -1473,7 +1485,7 @@ def main() -> None:
                 finally:
                     _shutil.rmtree(_ddir, ignore_errors=True)
                     _shutil.rmtree(_gdir, ignore_errors=True)
-        except Exception as e:
+        except Exception as e:  # tpulint: disable=LT-EXC(resident extra, never the headline)
             note(f"resident phase failed ({type(e).__name__}: {e})")
 
     # ---- phase: sync front-end (BENCH_SYNC=1, ISSUE 7) ----------------
@@ -1604,7 +1616,7 @@ def main() -> None:
                 f"sync: {n_sess} sessions, {_pushes/_ssec:.0f} pushes/s, "
                 f"push-to-visible p50 {_p50*1e3:.1f}ms p99 {_p99*1e3:.1f}ms"
             )
-        except Exception as e:
+        except Exception as e:  # tpulint: disable=LT-EXC(sync extra, never the headline)
             note(f"sync phase failed ({type(e).__name__}: {e})")
 
     # ---- phase: sharded resident fleet (BENCH_SHARDS=N, ISSUE 8) ------
@@ -1739,7 +1751,7 @@ def main() -> None:
                     f"1 shard {_m1/1e3:.0f}k ({_scaling:.2f}x, "
                     f"eff {_scaling/n_sh:.2f})"
                 )
-        except Exception as e:
+        except Exception as e:  # tpulint: disable=LT-EXC(shard extra, never the headline)
             note(f"shard phase failed ({type(e).__name__}: {e})")
 
     bank("done", partial=None)
@@ -1762,7 +1774,7 @@ def _tunnel_alive(timeout_s: float = 75.0) -> bool:
         from loro_tpu.resilience.probe import tunnel_alive
 
         return tunnel_alive(timeout_s)
-    except Exception:
+    except Exception:  # tpulint: disable=LT-EXC(inline probe twin must work even when the repo import is broken)
         pass
     import subprocess
 

@@ -1,0 +1,327 @@
+"""tpulint core: findings, the rule registry, pragmas, the baseline.
+
+A *rule* is a function over one parsed module (``ModuleSource``) that
+yields ``Finding``s.  Rules declare their own path *scope* — the
+project invariants are path-shaped (pad-bucket applies to fleet
+paths, keyed-hash to placement/wire paths, device-routing to
+everything EXCEPT the blessed kernel modules) — so a rule never fires
+where its post-mortem does not apply, and the scope is documented per
+rule in docs/ANALYSIS.md rather than hidden in pragma noise.
+
+Suppression is per line and must carry a reason::
+
+    except Exception:  # tpulint: disable=LT-EXC(subscriber isolation)
+
+A pragma on its own line suppresses the NEXT line (for statements that
+do not fit a trailing comment).  A reasonless or unknown-rule pragma
+does not suppress anything and is itself reported (rule LT-PRAGMA) —
+"every suppression carries a reason" is enforced, not hoped for.
+
+The *baseline* (``baseline.json`` next to this file, or ``--baseline``)
+tolerates known findings by ``(rule, path, stripped source line)`` so
+line drift does not churn it; the checked-in baseline is empty — it
+exists so a future emergency landing can be staged, not so debt can
+hide.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+# a pragma may share its comment with other markers (noqa, prose), so
+# the marker is matched anywhere inside the comment text
+PRAGMA_RE = re.compile(r"#.*?tpulint:\s*disable=(.*)$")
+# one pragma entry: RULE-ID(reason...)  — reason runs to the matching
+# close paren (no nesting needed in practice; greedy-to-last-paren
+# keeps parenthesised prose intact)
+ENTRY_RE = re.compile(r"(LT-[A-Z]+)\s*(?:\((.*?)\))?\s*(?:,|$)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    source_line: str = ""
+    suppressed: bool = False
+    reason: str = ""
+    baselined: bool = False
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: survives line-number drift."""
+        return (self.rule, self.path, self.source_line.strip())
+
+    def to_json(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.suppressed:
+            d["suppressed"] = True
+            d["reason"] = self.reason
+        if self.baselined:
+            d["baselined"] = True
+        return d
+
+    def render(self) -> str:
+        tag = ""
+        if self.suppressed:
+            tag = f"  [suppressed: {self.reason}]"
+        elif self.baselined:
+            tag = "  [baselined]"
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} {self.message}{tag}"
+        )
+
+
+class ModuleSource:
+    """One parsed module: path (repo-relative, posix), source, AST."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+
+    def line(self, n: int) -> str:
+        return self.lines[n - 1] if 0 < n <= len(self.lines) else ""
+
+
+@dataclass
+class Rule:
+    id: str
+    name: str
+    summary: str
+    post_mortem: str
+    scope: Callable[[str], bool]
+    check: Callable[[ModuleSource], Iterable[Finding]] = field(repr=False,
+                                                              default=None)
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Callable:
+    """Decorator: attach a check function to ``rule`` and register it."""
+    def deco(fn: Callable[[ModuleSource], Iterable[Finding]]):
+        rule.check = fn
+        if rule.id in _RULES:
+            raise ValueError(f"duplicate rule id {rule.id}")
+        _RULES[rule.id] = rule
+        return fn
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    # import side effect: the rule definitions live in rules.py
+    from . import rules  # noqa: F401
+
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    from . import rules  # noqa: F401
+
+    return _RULES[rule_id]
+
+
+def known_rule_ids() -> List[str]:
+    from . import rules  # noqa: F401
+
+    return sorted(_RULES) + ["LT-PRAGMA"]
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+
+def parse_pragmas(mod: ModuleSource) -> Tuple[Dict[int, Dict[str, str]],
+                                              List[Finding]]:
+    """Per-line suppression map ``{line: {rule_id: reason}}`` plus the
+    LT-PRAGMA findings for malformed pragmas (no reason / unknown
+    rule).  A pragma on a line whose code is only the comment applies
+    to the next line."""
+    import io
+    import tokenize
+
+    supp: Dict[int, Dict[str, str]] = {}
+    bad: List[Finding] = []
+    ids = set(known_rule_ids())
+    # real COMMENT tokens only: a pragma example inside a docstring or
+    # string literal is prose, not a suppression
+    comments: List[Tuple[int, int, str]] = []  # (line, col, text)
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(mod.source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.start[1], tok.string))
+    except (tokenize.TokenError, IndentationError):
+        pass  # unparseable tails: the ast parse already succeeded
+    for i, col, text in comments:
+        m = PRAGMA_RE.search(text)
+        if not m:
+            continue
+        target = i
+        if mod.line(i)[:col].strip() == "":
+            target = i + 1  # comment-only line: suppress the next line
+        entries = list(ENTRY_RE.finditer(m.group(1)))
+        if not entries:
+            bad.append(Finding(
+                "LT-PRAGMA", mod.path, i, col + m.start() + 1,
+                "unparseable tpulint pragma (expected "
+                "disable=LT-RULE(reason))", source_line=mod.line(i),
+            ))
+            continue
+        for e in entries:
+            rid, reason = e.group(1), (e.group(2) or "").strip()
+            if rid not in ids:
+                bad.append(Finding(
+                    "LT-PRAGMA", mod.path, i, col + m.start() + 1,
+                    f"pragma names unknown rule {rid!r}", source_line=mod.line(i),
+                ))
+                continue
+            if not reason:
+                bad.append(Finding(
+                    "LT-PRAGMA", mod.path, i, col + m.start() + 1,
+                    f"pragma for {rid} carries no reason — every "
+                    "suppression must say why", source_line=mod.line(i),
+                ))
+                continue  # reasonless pragma does NOT suppress
+            supp.setdefault(target, {})[rid] = reason
+    return supp, bad
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """``{(rule, path, line_text): allowance}`` from a baseline file;
+    empty when the file does not exist."""
+    import os
+
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "r") as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for row in data.get("findings", []):
+        k = (row["rule"], row["path"], row["line_text"])
+        out[k] = out.get(k, 0) + int(row.get("count", 1))
+    return out
+
+
+def baseline_payload(findings: List[Finding]) -> dict:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    return {
+        "comment": "tpulint baseline: tolerated findings by "
+                   "(rule, path, stripped line). Keep this EMPTY; it "
+                   "exists for staged emergency landings only.",
+        "findings": [
+            {"rule": r, "path": p, "line_text": t, "count": n}
+            for (r, p, t), n in sorted(counts.items())
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# result
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]          # everything, suppressed included
+    files: int = 0
+
+    @property
+    def active(self) -> List[Finding]:
+        """Unsuppressed, unbaselined — what fails the build."""
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.active:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "files": self.files,
+            "active": [f.to_json() for f in self.active],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "baselined": [f.to_json() for f in self.baselined],
+            "counts": self.counts(),
+            "ok": not self.active,
+        }
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by rules.py)
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap(ast.NodeVisitor):
+    """name -> dotted module/object path, from import statements."""
+
+    def __init__(self, tree: ast.AST):
+        self.names: Dict[str, str] = {}
+        self.visit(tree)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.names[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module is None or node.level:
+            return  # relative imports never alias jax/time/etc
+        for a in node.names:
+            self.names[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Fully-resolved dotted path of an expression, through the
+        module's import aliases (``jnp.zeros`` -> ``jax.numpy.zeros``)."""
+        d = dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        base = self.names.get(head)
+        if base is None:
+            return d
+        return f"{base}.{rest}" if rest else base

@@ -1,0 +1,133 @@
+"""Declared partial lock order for the threaded fleet planes.
+
+PRs 5–8 grew four interacting thread planes — PipelinedIngest
+stage/commit, ShardedPipeline fan-out/collector, the sync FanIn, and
+epoch subscribers — whose locks nest across module boundaries.  This
+module is the single written-down order; the runtime witness
+(``lockwitness.py``) checks every observed acquisition against it and
+the static rule LT-LOCK flags inverted ``with`` nestings at lint time.
+
+Levels are OUTERMOST-FIRST: a thread holding lock A may acquire lock B
+iff ``level(A) < level(B)`` (or the pair is explicitly allowed).
+Same-name reentrant acquisition (RLocks) is always allowed.  Locks not
+named here (obs registry, native decoder, tracing, faultinject — all
+strict leaves that call nothing while held) are outside the witness on
+purpose; add them the day they stop being leaves.
+
+The order, with the paths that establish each edge:
+
+- ``sync.server``      — SyncServer session/oracle lock; strictly a
+  root: _commit_batch submits to the pipeline BEFORE taking it, and
+  epoch subscribers are lock-free by contract, so nothing below ever
+  acquires it.
+- ``fanin.queue``      — FanIn intake; the drain worker runs the
+  commit callback with it RELEASED, so it orders before everything the
+  callback touches.
+- ``sharded.route``    — ShardedResidentServer placement/routing
+  RLock; held across per-shard fan-out (→ pipeline/collect/dev/epoch).
+- ``sharded.collect``  — ShardedPipeline collector queue
+  (route→collect in submit()).
+- ``pipeline.queue``   — PipelinedIngest queue/cv (route→queue when a
+  sharded submit feeds per-shard pipes; stage/commit workers run
+  server calls with it RELEASED).
+- ``fleet.dev``        — per-batch device RLock (serializes grow vs
+  in-flight commit; wraps supervised launches).
+- ``sharded.epoch``    — the global epoch/_EpochMap lock
+  (route→dev→…→epoch on every fleet commit).
+- ``supervisor.state`` — DeviceSupervisor counters; a strict leaf
+  under every launch (dev→supervisor).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+LEVELS: Dict[str, int] = {
+    "sync.server": 10,
+    "fanin.queue": 20,
+    "sharded.route": 30,
+    "sharded.collect": 40,
+    "pipeline.queue": 50,
+    "fleet.dev": 60,
+    "sharded.epoch": 70,
+    "supervisor.state": 80,
+}
+
+# explicitly-allowed extra edges that the pure level order forbids —
+# each entry carries its justification in a comment.  Empty today:
+# keep it that way unless a post-mortem proves an edge safe.
+ALLOWED_EXTRA: Set[Tuple[str, str]] = set()
+
+# attribute-name -> lock-name map for the STATIC rule (LT-LOCK).  Only
+# attributes whose name is unambiguous across the codebase belong
+# here; generic `_lock`/`_cv` attributes are witnessed at runtime
+# instead (their identity depends on the owning class).
+STATIC_ATTR_LOCKS: Dict[str, str] = {
+    "_dev_lock": "fleet.dev",
+    "_route_lock": "sharded.route",
+    "_epoch_lock": "sharded.epoch",
+}
+
+
+def level(name: str):
+    return LEVELS.get(name)
+
+
+def allowed(outer: str, inner: str) -> bool:
+    """May a thread holding ``outer`` acquire ``inner``?  Unknown lock
+    names are permitted (the witness records them; the declaration
+    only constrains the names it knows)."""
+    if outer == inner:
+        return True  # reentrant
+    if (outer, inner) in ALLOWED_EXTRA:
+        return True
+    lo, li = LEVELS.get(outer), LEVELS.get(inner)
+    if lo is None or li is None:
+        return True
+    return lo < li
+
+
+def check_edges(edges: Iterable[Tuple[str, str]]) -> List[str]:
+    """Violation strings for every witnessed edge the declaration
+    forbids (empty = conformant)."""
+    out = []
+    for a, b in edges:
+        if not allowed(a, b):
+            out.append(
+                f"{a!r} (level {LEVELS.get(a)}) held while acquiring "
+                f"{b!r} (level {LEVELS.get(b)}) — declared order forbids it"
+            )
+    return out
+
+
+def find_cycle(edges: Iterable[Tuple[str, str]]):
+    """A witnessed-lock-graph cycle as a node list (closed: first ==
+    last), or None.  Any cycle — declared locks or not — is a latent
+    deadlock."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        if a != b:
+            adj.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in set(adj) | {b for bs in adj.values() for b in bs}}
+    stack: List[str] = []
+
+    def dfs(n: str):
+        color[n] = GREY
+        stack.append(n)
+        for m in adj.get(n, ()):
+            if color[m] == GREY:
+                return stack[stack.index(m):] + [m]
+            if color[m] == WHITE:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        color[n] = BLACK
+        stack.pop()
+        return None
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None

@@ -25,15 +25,21 @@ class PeerInfo:
 
 
 class Awareness:
-    def __init__(self, peer: PeerID, timeout_s: float = 30.0):
+    """``clock`` is injectable (fake-clock tests drive TTL expiry the
+    way DeviceSupervisor retry tests do); the produced wall-clock
+    timestamps are presence metadata, never CRDT history."""
+
+    def __init__(self, peer: PeerID, timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.time):
         self.peer = peer
         self.timeout_s = timeout_s
+        self.clock = clock
         self.peers: Dict[PeerID, PeerInfo] = {}
 
     def set_local_state(self, state: Any) -> None:
         cur = self.peers.get(self.peer)
         counter = (cur.counter + 1) if cur else 1
-        self.peers[self.peer] = PeerInfo(state, counter, time.time())
+        self.peers[self.peer] = PeerInfo(state, counter, self.clock())
 
     def get_local_state(self) -> Any:
         info = self.peers.get(self.peer)
@@ -79,7 +85,7 @@ class Awareness:
         except (IndexError, ValueError, struct.error) as e:
             raise ValueError(f"malformed awareness blob: {e}") from e
         updated, added = [], []
-        now = time.time()
+        now = self.clock()
         for p, counter, state in entries:
             cur = self.peers.get(p)
             if cur is None:
@@ -91,7 +97,7 @@ class Awareness:
         return updated, added
 
     def remove_outdated(self) -> List[PeerID]:
-        now = time.time()
+        now = self.clock()
         dead = [p for p, i in self.peers.items() if now - i.timestamp > self.timeout_s]
         for p in dead:
             del self.peers[p]
@@ -112,21 +118,23 @@ class EphemeralStore:
     """key -> LWW-by-timestamp value with inactivity expiry.
     reference: awareness.rs:250+ EphemeralStore."""
 
-    def __init__(self, timeout_ms: int = 30_000):
+    def __init__(self, timeout_ms: int = 30_000,
+                 clock: Callable[[], float] = time.time):
         self.timeout_ms = timeout_ms
+        self.clock = clock  # injectable (fake-clock expiry tests)
         self._data: Dict[str, _Entry] = {}
         self._local_subs: List[Callable[[bytes], None]] = []
         self._subs: List[Callable[[dict], None]] = []
 
     # -- local mutation -----------------------------------------------
     def set(self, key: str, value: Any) -> None:
-        self._data[key] = _Entry(value, time.time() * 1000)
+        self._data[key] = _Entry(value, self.clock() * 1000)
         self._emit_local([key])
         self._emit({"by": "local", "added": [], "updated": [key], "removed": []})
 
     def delete(self, key: str) -> None:
         if key in self._data:
-            self._data[key] = _Entry(None, time.time() * 1000, deleted=True)
+            self._data[key] = _Entry(None, self.clock() * 1000, deleted=True)
             self._emit_local([key])
             self._emit({"by": "local", "added": [], "updated": [], "removed": [key]})
 
@@ -198,7 +206,7 @@ class EphemeralStore:
             self._emit({"by": "import", "added": added, "updated": updated, "removed": removed})
 
     def remove_outdated(self) -> List[str]:
-        now = time.time() * 1000
+        now = self.clock() * 1000
         dead = [k for k, e in self._data.items() if now - e.timestamp > self.timeout_ms]
         removed = []
         for k in dead:

@@ -12,6 +12,8 @@ from __future__ import annotations
 import gzip
 import json
 import os
+import zipfile
+import zlib
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -59,7 +61,9 @@ def _load_extract_cache(path: str):
             valid=z["valid"],
             peers=[int(p) for p in z["peers"]],
         ), int(z["n_ops"])
-    except Exception:
+    except (OSError, EOFError, KeyError, ValueError, zipfile.BadZipFile,
+            zlib.error):
+        # stale/foreign/truncated cache file: rebuild instead of crashing
         return None
 
 

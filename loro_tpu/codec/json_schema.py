@@ -26,6 +26,7 @@ from ..core.change import (
 )
 from ..core.ids import ContainerID, ID, IdSpan, TreeID
 from ..core.value import from_json, to_json
+from ..errors import LoroError
 from ..core.version import Frontiers, VersionVector
 
 SCHEMA_VERSION = 1
@@ -181,9 +182,11 @@ def export_json_updates(
 REDACTED_CHAR = "�"
 
 
-class RedactError(ValueError):
+class RedactError(LoroError, ValueError):
     """reference: json_schema.rs RedactError (InvalidSchema /
-    UnknownOperationType)."""
+    UnknownOperationType).  Rooted in LoroError (the typed-error
+    discipline) while keeping the historical ValueError base for
+    pre-existing ``except ValueError`` callers."""
 
 
 def _op_json_len(d: Dict[str, Any]) -> int:

@@ -76,6 +76,18 @@ class ShardingError(LoroError):
     values (LORO_SHARDS, divisibility) raise ConfigError instead."""
 
 
+class AnalysisError(LoroError):
+    """Base for the static-analysis / invariant-witness subsystem
+    (loro_tpu/analysis/, docs/ANALYSIS.md)."""
+
+
+class LockOrderViolation(AnalysisError):
+    """The runtime lock witness observed an acquisition the declared
+    partial order in analysis/lockorder.py forbids, or a cycle in the
+    witnessed lock graph (a latent deadlock).  Raised only in strict
+    witness mode (tests) — production code never enables it."""
+
+
 class ResilienceError(LoroError):
     """Base for the resilience subsystem (loro_tpu/resilience/)."""
 

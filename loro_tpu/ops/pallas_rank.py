@@ -44,7 +44,7 @@ try:  # pallas is part of jax, but keep the import soft for safety
     from jax.experimental.pallas import tpu as pltpu
 
     HAVE_PALLAS = True
-except Exception:  # pragma: no cover
+except Exception:  # pragma: no cover — tpulint: disable=LT-EXC(soft import probe: any pallas breakage means "no pallas", not a crash)
     HAVE_PALLAS = False
 
 
@@ -75,7 +75,7 @@ def use_pallas_rank() -> bool:
         return True
     try:
         return jax.default_backend() == "tpu"
-    except Exception:  # backend init failure — stay on the XLA path
+    except Exception:  # tpulint: disable=LT-EXC(backend init failure means stay on the XLA path, whatever the backend threw)
         return False
 
 

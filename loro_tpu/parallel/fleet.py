@@ -29,6 +29,7 @@ from ..core.change import Change
 from ..core.ids import ContainerID
 from ..errors import DeviceFailure
 from ..obs import metrics as obs
+from ..analysis.lockwitness import named_rlock
 from ..resilience import get_supervisor
 from ..utils import tracing
 from ..ops.columnar import MapExtract, SeqExtract, extract_seq_container
@@ -903,7 +904,7 @@ class DeviceDocBatch:
         # serializes device-array writers: a detached commit (pipeline
         # commit thread) vs a grow() triggered by the NEXT group's host
         # staging — the only two that can ever overlap
-        self._dev_lock = threading.RLock()
+        self._dev_lock = named_rlock("fleet.dev")
 
     # column fill values shared by __init__, grow() and compact() —
     # one table so the three cannot drift
@@ -2296,7 +2297,7 @@ class DeviceMapBatch:
         # reclaim, so unlike theirs it never gates a compact())
         self.epoch = 0
         self._defer = None  # coalesced-ingest accumulator
-        self._dev_lock = threading.RLock()
+        self._dev_lock = named_rlock("fleet.dev")
 
     # -- round coalescing (LWW fold is associative: one merged fold of
     # the group's rows lands the same winners as one fold per round;
@@ -2682,7 +2683,7 @@ class DeviceTreeBatch:
             valid=z(bool, False),
         )
         self._defer = None  # coalesced-ingest accumulator
-        self._dev_lock = threading.RLock()
+        self._dev_lock = named_rlock("fleet.dev")
 
     # -- round coalescing (same contract as DeviceDocBatch) ------------
     def begin_coalesce(self) -> None:
@@ -3441,7 +3442,7 @@ class DeviceMovableBatch:
         self.vals = mk(-2)  # value = winning value ordinal
         self._defer_moves = None  # coalesced-ingest accumulators
         self._defer_vals = None
-        self._dev_lock = threading.RLock()
+        self._dev_lock = named_rlock("fleet.dev")
 
     # -- round coalescing (slots ride the inner seq batch's deferral;
     # the two element folds accumulate here — both associative) --------
@@ -3982,7 +3983,7 @@ class DeviceMovableBatch:
         batch.e_cap = e_cap
         batch.auto_grow = auto_grow  # review r5: __new__ skips __init__
         batch._defer_moves = batch._defer_vals = None
-        batch._dev_lock = threading.RLock()
+        batch._dev_lock = named_rlock("fleet.dev")
         batch.elem_ids = [dict() for _ in range(batch.d)]
         batch.values = [[] for _ in range(batch.d)]
         sh = doc_sharding(batch.mesh)
@@ -4199,7 +4200,7 @@ class DeviceCounterBatch:
         # server journals rounds against it; folds never compact)
         self.epoch = 0
         self._defer = None  # coalesced-ingest accumulator
-        self._dev_lock = threading.RLock()
+        self._dev_lock = named_rlock("fleet.dev")
 
     # -- round coalescing (float add is associative for the documented
     # integer-delta precision contract; epoch still bumps per round) ---

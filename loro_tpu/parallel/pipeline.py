@@ -46,6 +46,7 @@ import time
 from collections import deque
 from typing import List, Optional, Sequence
 
+from ..analysis.lockwitness import named_lock
 from ..obs import metrics as obs
 
 
@@ -101,7 +102,7 @@ class PipelinedIngest:
         self._cid = cid
         self._coalesce = max(1, int(coalesce))
         self._max_queued = self._coalesce * max(1, int(depth))
-        self._lock = threading.Lock()
+        self._lock = named_lock("pipeline.queue")
         self._cv = threading.Condition(self._lock)
         self._q: deque = deque()        # (updates, cid, PendingRound)
         self._commit_q: deque = deque() # (handle, [PendingRound]) — len <= 1

@@ -459,7 +459,7 @@ class ResidentServer:
                 self._durable_closed = True  # later ingests raise typed
                 try:
                     log.close()
-                except Exception:
+                except Exception:  # tpulint: disable=LT-EXC(best-effort WAL close while the typed fail-stop PersistError is already in flight)
                     pass
                 obs.counter("server.errors_total").inc(family=self.family)
                 raise PersistError(
@@ -502,7 +502,7 @@ class ResidentServer:
             self._durable_closed = True
             try:
                 log.close()
-            except Exception:
+            except Exception:  # tpulint: disable=LT-EXC(best-effort WAL close while the typed fail-stop PersistError is already in flight)
                 pass
             obs.counter("server.errors_total").inc(family=self.family)
             raise PersistError(
@@ -902,8 +902,7 @@ class ResidentServer:
         for cb in list(self._epoch_subs):
             try:
                 cb(epoch)
-            except Exception:
-                # a broken subscriber must never poison the ingest path
+            except Exception:  # tpulint: disable=LT-EXC(subscriber isolation: a broken epoch subscriber must never poison ingest; counted below)
                 obs.counter(
                     "server.epoch_sub_errors_total",
                     "epoch-commit subscriber callbacks that raised",

@@ -57,6 +57,7 @@ import threading
 from collections import deque
 from typing import List, Optional, Sequence
 
+from ..analysis.lockwitness import named_lock, named_rlock
 from ..errors import ConfigError, LoroError, PersistError, ShardingError
 from ..obs import metrics as obs
 from .mesh import make_mesh, shard_meshes
@@ -123,7 +124,7 @@ class ShardedPipeline:
             srv.pipeline(cid=cid, coalesce=coalesce, depth=depth)
             for srv in server.shards
         ]
-        self._lock = threading.Lock()
+        self._lock = named_lock("sharded.collect")
         self._cv = threading.Condition(self._lock)
         self._q: deque = deque()  # (aggregate PendingRound, [shard prs])
         self._collecting = False
@@ -351,7 +352,7 @@ class ShardedResidentServer:
             for srv in self.shards:
                 try:
                     srv.close()
-                except Exception:
+                except Exception:  # tpulint: disable=LT-EXC(best-effort shard close while the constructor error propagates)
                     pass
             raise
         self._init_runtime(cid=None, global_epoch=0,
@@ -360,8 +361,8 @@ class ShardedResidentServer:
             self._write_manifest()
 
     def _init_runtime(self, cid, global_epoch: int, emaps) -> None:
-        self._route_lock = threading.RLock()
-        self._epoch_lock = threading.Lock()
+        self._route_lock = named_rlock("sharded.route")
+        self._epoch_lock = named_lock("sharded.epoch")
         self._emaps = emaps
         self._global_epoch = global_epoch
         self._epoch_subs: List = []
@@ -488,7 +489,7 @@ class ShardedResidentServer:
         for cb in list(self._epoch_subs):
             try:
                 cb(epoch)
-            except Exception:
+            except Exception:  # tpulint: disable=LT-EXC(subscriber isolation: a broken epoch subscriber must never poison ingest; counted below)
                 obs.counter(
                     "server.epoch_sub_errors_total",
                     "epoch-commit subscriber callbacks that raised",
@@ -1025,7 +1026,7 @@ def recover_sharded_server(durable_dir: str, mesh=None,
         for srv in shard_srvs:
             try:
                 srv.close()
-            except Exception:
+            except Exception:  # tpulint: disable=LT-EXC(best-effort shard close while the recovery error propagates)
                 pass
         raise
     srv = ShardedResidentServer._assemble(

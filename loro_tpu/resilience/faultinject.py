@@ -240,8 +240,8 @@ def _load_env() -> None:
             if entry:
                 try:
                     _install_env_entry(entry)
-                except Exception:
-                    pass  # a typo'd spec must not take the process down
+                except Exception:  # tpulint: disable=LT-EXC(a typo'd LORO_FAULT spec must not take the process down)
+                    pass
 
 
 def _install_env_entry(entry: str) -> None:

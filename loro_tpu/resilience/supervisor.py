@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..analysis.lockwitness import named_lock
 from ..errors import DeadlineExceeded, DeviceFailure, LoroError
 from ..obs import metrics as obs
 from . import faultinject
@@ -100,7 +101,7 @@ class DeviceSupervisor:
         self.clock = clock
         self.sleep = sleep
         self._deadline = None if deadline_s is None else clock() + deadline_s
-        self._lock = threading.Lock()
+        self._lock = named_lock("supervisor.state")
         self._in_flight = 0
         # report counters (reset via reset_report)
         self._launches = 0

@@ -31,6 +31,7 @@ import time
 from collections import deque
 from typing import List, Optional
 
+from ..analysis.lockwitness import named_lock
 from ..obs import metrics as obs
 
 
@@ -84,7 +85,7 @@ class FanIn:
             self._max_queue if max_batch is None else max(1, int(max_batch))
         )
         self._family = family
-        self._lock = threading.Lock()
+        self._lock = named_lock("fanin.queue")
         self._cv = threading.Condition(self._lock)
         self._q: deque = deque()  # (di, payload, ticket, session)
         self._busy = False        # worker inside a commit call

@@ -24,6 +24,7 @@ never fanned out.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 from ..awareness import Awareness, EphemeralStore
@@ -32,15 +33,20 @@ from ..resilience import faultinject
 
 
 class PresencePlane:
-    """Owned by a SyncServer; all methods take the server lock."""
+    """Owned by a SyncServer; all methods take the server lock.
+    ``clock`` is the injectable presence wall clock, threaded into the
+    Awareness/EphemeralStore LWW timestamps and TTL expiry (fake-clock
+    tests drive expiry without sleeping)."""
 
-    def __init__(self, server, ttl_s: float = 30.0):
+    def __init__(self, server, ttl_s: float = 30.0, clock=None):
         self._server = server
         self.ttl_s = ttl_s
+        self.clock = clock if clock is not None else time.time
         # the aggregated view: peer 0 is the server itself (it never
         # publishes state, so it never appears in the peers map)
-        self.awareness = Awareness(peer=0, timeout_s=ttl_s)
-        self.ephemeral = EphemeralStore(timeout_ms=int(ttl_s * 1000))
+        self.awareness = Awareness(peer=0, timeout_s=ttl_s, clock=self.clock)
+        self.ephemeral = EphemeralStore(timeout_ms=int(ttl_s * 1000),
+                                        clock=self.clock)
 
     # -- publishing ----------------------------------------------------
     def set_state(self, session, state) -> None:
@@ -53,9 +59,8 @@ class PresencePlane:
             cur = aw.peers.get(session.peer)
             counter = (cur.counter + 1) if cur else 1
             from ..awareness import PeerInfo
-            import time as _time
 
-            aw.peers[session.peer] = PeerInfo(state, counter, _time.time())
+            aw.peers[session.peer] = PeerInfo(state, counter, self.clock())
             blob = aw.encode([session.peer])
         self._fan_out(blob, origin=session)
 
@@ -106,11 +111,10 @@ class PresencePlane:
             if cur is None:
                 return
             from ..awareness import PeerInfo
-            import time as _time
 
             # transient re-insert at a bumped counter so the encoded
             # departure wins LWW against the peer's last real state
-            aw.peers[peer] = PeerInfo(None, cur.counter + 1, _time.time())
+            aw.peers[peer] = PeerInfo(None, cur.counter + 1, self.clock())
             blob = aw.encode([peer])
             del aw.peers[peer]
         self._fan_out(blob)

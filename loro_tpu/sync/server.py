@@ -44,6 +44,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..errors import DecodeError, PushRejected, SyncError
+from ..analysis.lockwitness import named_rlock
 from ..obs import metrics as obs
 from ..resilience import faultinject
 from .fanin import FanIn, PushTicket
@@ -106,7 +107,7 @@ class SyncServer:
                 f"{self.family} SyncServer needs the served container id "
                 "(cid=), same contract as ResidentServer.ingest"
             )
-        self._lock = threading.RLock()
+        self._lock = named_rlock("sync.server")
         self._wakeup = threading.Condition(self._lock)
         self._oracle = self._seed_oracle()
         # newest epoch the ORACLE reflects (pulls/acks key on this; the
@@ -380,7 +381,7 @@ class SyncServer:
                         self._oracle.docs[di]._import_changes(
                             list(chs), origin="sync"
                         )
-                    except Exception as e:  # noqa: BLE001 — typed reject
+                    except Exception as e:  # noqa: BLE001 — tpulint: disable=LT-EXC(typed reject: the ticket fails PushRejected and the counter below is the reseed signal)
                         # should be unreachable: the causality gate
                         # above rejects dep-gap pushes before ANY plane
                         # applies them.  If something still slips

@@ -107,7 +107,8 @@ class Transaction:
         if self.timestamp_override is not None:
             ts = self.timestamp_override
         else:
-            ts = int(time.time()) if self.doc.config.record_timestamp else 0
+            # op timestamps are WIRE DATA (record_timestamp), not logic
+            ts = int(time.time()) if self.doc.config.record_timestamp else 0  # tpulint: disable=LT-TIME(change timestamps are wire metadata, not scheduling logic)
         return Change(
             id=ID(self.peer, self.start_counter),
             lamport=self.start_lamport,

@@ -122,7 +122,7 @@ def dump(path: Optional[str] = None) -> str:
     """Write chrome://tracing JSON; returns the path."""
     if path is None:
         os.makedirs("log", exist_ok=True)
-        path = os.path.join("log", f"trace-{int(time.time())}.json")
+        path = os.path.join("log", f"trace-{int(time.time())}.json")  # tpulint: disable=LT-TIME(artifact filename stamp; wall time is the point)
     with _lock:
         data = {"traceEvents": list(_events)}
     with open(path, "w") as f:

@@ -30,6 +30,12 @@ The order, with the paths that establish each edge:
 - ``pipeline.queue``   — PipelinedIngest queue/cv (route→queue when a
   sharded submit feeds per-shard pipes; stage/commit workers run
   server calls with it RELEASED).
+- ``residency.plan``   — TieredBatch/ResidencyManager tier state
+  (parallel/residency.py): held across revive landings and slot
+  releases, which acquire the device lock beneath it (plan→dev); the
+  pipeline workers call the tiered server with ``pipeline.queue``
+  released, and a sharded fan-out reaches it under ``sharded.route``
+  (route→…→plan→dev).
 - ``fleet.dev``        — per-batch device RLock (serializes grow vs
   in-flight commit; wraps supervised launches).
 - ``sharded.epoch``    — the global epoch/_EpochMap lock
@@ -47,6 +53,7 @@ LEVELS: Dict[str, int] = {
     "sharded.route": 30,
     "sharded.collect": 40,
     "pipeline.queue": 50,
+    "residency.plan": 55,
     "fleet.dev": 60,
     "sharded.epoch": 70,
     "supervisor.state": 80,
@@ -65,6 +72,7 @@ STATIC_ATTR_LOCKS: Dict[str, str] = {
     "_dev_lock": "fleet.dev",
     "_route_lock": "sharded.route",
     "_epoch_lock": "sharded.epoch",
+    "_plan_lock": "residency.plan",
 }
 
 

@@ -76,6 +76,19 @@ class ShardingError(LoroError):
     values (LORO_SHARDS, divisibility) raise ConfigError instead."""
 
 
+class ResidencyError(LoroError):
+    """Tiered-residency lifecycle failure (loro_tpu/parallel/residency.py,
+    docs/RESIDENCY.md): a round touched more docs than the hot-slot
+    budget can hold, no evictable victim exists (every hot doc is still
+    un-journaled), or an injected/real failure interrupted an evict or
+    revive.  The contract: a failed EVICT leaves the doc hot (no torn
+    tier state); a failed REVIVE fails only the triggering round/ticket
+    and leaves the doc warm/cold — the server itself stays healthy
+    either way.  Passes through DeviceSupervisor untouched (LoroError),
+    so it can never be misread as a device failure and trigger
+    degradation."""
+
+
 class AnalysisError(LoroError):
     """Base for the static-analysis / invariant-witness subsystem
     (loro_tpu/analysis/, docs/ANALYSIS.md)."""

@@ -1140,6 +1140,47 @@ class DeviceDocBatch:
                 setattr(self, name, ne)
             self.cap = new_capacity
 
+    def release_doc(self, di: int) -> None:
+        """Reset doc ``di`` to a never-used slot (tiered-residency
+        eviction, parallel/residency.py): every host structure back to
+        its construction value, every device row back to its fill.  The
+        CALLER owns the safety argument — the doc's state must already
+        be preserved elsewhere (deep mirror anchor + journal) and no
+        staged/in-flight device work may reference the doc (the
+        residency manager only releases journal-stable docs).  Inside
+        an open coalesce group the deferred base offset for the doc
+        resets too, so a later round in the same group can land a new
+        doc at row 0."""
+        from .idmap import make_idmap
+
+        self.counts[di] = 0
+        self.tomb_epoch[di, :] = -1
+        self.row_epoch[di, :] = -1
+        self.id2row[di] = make_idmap()
+        self.value_store[di] = []
+        self.anchor_meta[di] = {}
+        self.anchor_by_row[di] = {}
+        self.order[di] = self._fresh_order()
+        if self._defer is not None:
+            self._defer.base0[di] = 0
+            self._defer.renumbered.discard(di)
+        with self._dev_lock:
+            fields = list(self.cols._fields)
+            arrays = tuple(getattr(self.cols, f) for f in fields) + (
+                self.key_hi, self.key_lo,
+            )
+            fills = tuple(self._COL_FILLS[f] for f in fields) + (
+                0xFFFFFFFF, 0xFFFFFFFF,
+            )
+            out = _release_rows(arrays, jnp.int32(di), fills)
+            from ..ops.fugue_batch import SeqColumnsU
+
+            self.cols = SeqColumnsU(**dict(zip(fields, out[: len(fields)])))
+            self.key_hi, self.key_lo = out[len(fields):]
+        obs.counter("fleet.doc_releases_total").inc(
+            family="text" if self.as_text else "list"
+        )
+
     def compact(
         self,
         stable_epochs: Sequence[Optional[int]],
@@ -2351,6 +2392,21 @@ class DeviceMapBatch:
                 f"{self.s}); grow slot_capacity or pass auto_grow=True"
             )
 
+    def release_doc(self, di: int) -> None:
+        """Reset doc ``di`` to a never-used slot (tiered-residency
+        eviction; see DeviceDocBatch.release_doc for the contract)."""
+        from ..ops.lww import NEG, LwwResident
+
+        self.slot_of[di] = {}
+        self.values[di] = []
+        with self._dev_lock:
+            out = _release_rows(
+                tuple(self.res), jnp.int32(di),
+                (int(NEG), 0, 0, -2),
+            )
+            self.res = LwwResident(*out)
+        obs.counter("fleet.doc_releases_total").inc(family="map")
+
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]]) -> None:
         from ..core.change import MapSet
         from ..ops.fugue_batch import pad_bucket
@@ -2760,6 +2816,32 @@ class DeviceTreeBatch:
                     np.asarray(offsets, np.int32), replicated(self.mesh)
                 ),
             )
+
+    def release_doc(self, di: int) -> None:
+        """Reset doc ``di`` to a never-used slot (tiered-residency
+        eviction; see DeviceDocBatch.release_doc for the contract)."""
+        from ..ops.tree_batch import ROOT, TreeLogCols
+
+        self.counts[di] = 0
+        self.move_epoch[di, :] = -1
+        self.node_ids[di] = {}
+        self.nodes[di] = []
+        self.move_meta[di] = []
+        if self._defer is not None:
+            self._defer.base0[di] = 0
+        with self._dev_lock:
+            fields = list(self.cols._fields)
+            fills = dict(
+                lamport=0, peer_hi=0, peer_lo=0, counter=0, target=0,
+                parent=ROOT, valid=False,
+            )
+            out = _release_rows(
+                tuple(getattr(self.cols, f) for f in fields),
+                jnp.int32(di),
+                tuple(fills[f] for f in fields),
+            )
+            self.cols = TreeLogCols(**dict(zip(fields, out)))
+        obs.counter("fleet.doc_releases_total").inc(family="tree")
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
         """Incremental ingest: each doc's new causally-ordered changes
@@ -3386,6 +3468,22 @@ def _set_deleted(deleted, d_idx, r_idx):
     return deleted.at[d_idx, r_idx].set(True)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(2,))
+def _release_rows(arrays, di, fills):
+    """Reset doc row ``di`` of every [d, cap] array to its construction
+    fill (donated, one launch) — the device half of ``release_doc``:
+    the tiered-residency eviction path (parallel/residency.py) recycles
+    the slot for a different doc, so the row must be indistinguishable
+    from a never-used one.  ``fills`` is a static tuple aligned with
+    ``arrays``; shapes are the resident capacities, so there is exactly
+    one compile per family per capacity bucket (LT-PAD holds: no
+    data-dependent shapes)."""
+    return tuple(
+        a.at[di].set(jnp.full((a.shape[1],), f, a.dtype))  # tpulint: disable=LT-PAD(in-jit row fill at the array's OWN static capacity — already bucketed at allocation, no new shape can exist)
+        for a, f in zip(arrays, fills)
+    )
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows(state, blk, offsets):
     """Write each doc's new-row block at its per-doc offset (donated
@@ -3474,6 +3572,25 @@ class DeviceMovableBatch:
 
     def flush_coalesce(self) -> None:
         self.commit_detached(self.detach_coalesce())
+
+    def release_doc(self, di: int) -> None:
+        """Reset doc ``di`` to a never-used slot (tiered-residency
+        eviction; see DeviceDocBatch.release_doc for the contract).
+        The inner seq batch releases its slot rows; both element folds
+        reset to their construction fills."""
+        from ..ops.lww import NEG, LwwResident
+
+        self.seq.release_doc(di)
+        self.elem_ids[di] = {}
+        self.values[di] = []
+        with self._dev_lock:
+            self.moves = LwwResident(*_release_rows(
+                tuple(self.moves), jnp.int32(di), (int(NEG), 0, 0, 0),
+            ))
+            self.vals = LwwResident(*_release_rows(
+                tuple(self.vals), jnp.int32(di), (int(NEG), 0, 0, -2),
+            ))
+        obs.counter("fleet.doc_releases_total").inc(family="movable")
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]], cid) -> None:
         """Incremental ingest: slots append into the internal seq batch
@@ -4235,6 +4352,16 @@ class DeviceCounterBatch:
                 doc_sharding(self.mesh),
             )["sums"]
             self.s = new_slot_capacity
+
+    def release_doc(self, di: int) -> None:
+        """Reset doc ``di`` to a never-used slot (tiered-residency
+        eviction; see DeviceDocBatch.release_doc for the contract)."""
+        self.slot_of[di] = {}
+        with self._dev_lock:
+            (self.sums,) = _release_rows(
+                (self.sums,), jnp.int32(di), (0.0,)
+            )
+        obs.counter("fleet.doc_releases_total").inc(family="counter")
 
     def append_changes(self, per_doc_changes: Sequence[Optional[Sequence[Change]]]) -> None:
         from ..core.change import CounterIncr

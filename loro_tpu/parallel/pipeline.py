@@ -101,6 +101,11 @@ class PipelinedIngest:
         self._server = server
         self._cid = cid
         self._coalesce = max(1, int(coalesce))
+        # tiered residency (parallel/residency.py): the server may bound
+        # how many DISTINCT docs one group touches — a group's docs
+        # co-reside in device slots until it commits, so unbounded
+        # grouping could outgrow the hot set.  None = no bound.
+        self._doc_budget = getattr(server, "pipeline_doc_budget", None)
         self._max_queued = self._coalesce * max(1, int(depth))
         self._lock = named_lock("pipeline.queue")
         self._cv = threading.Condition(self._lock)
@@ -228,12 +233,27 @@ class PipelinedIngest:
     # -- stage worker --------------------------------------------------
     def _pop_group(self) -> List[tuple]:
         """Up to ``coalesce`` queued rounds sharing one cid (groups
-        never mix container ids — ingest_stage takes one)."""
+        never mix container ids — ingest_stage takes one).  With a
+        server doc budget, the group also stops before its DISTINCT
+        touched docs would exceed it (tiered hot-set bound); the first
+        round is always taken, so an over-budget single round reaches
+        the server and fails typed there."""
         group: List[tuple] = []
+        docs_seen: set = set()
         while self._q and len(group) < self._coalesce:
             if group and self._q[0][1] != group[0][1]:
                 break
+            if self._doc_budget is not None and group:
+                nxt = {
+                    di for di, u in enumerate(self._q[0][0]) if u is not None
+                }
+                if len(docs_seen | nxt) > self._doc_budget:
+                    break
             group.append(self._q.popleft())
+            if self._doc_budget is not None:
+                docs_seen.update(
+                    di for di, u in enumerate(group[-1][0]) if u is not None
+                )
         return group
 
     def _fail_all(self, e: BaseException, group=None) -> None:

@@ -139,11 +139,33 @@ class ResidentServer:
                  durable_fsync=True,
                  fsync_window: int = 8,
                  mirror_anchor=True,
+                 hot_slots: Optional[int] = None,
                  **caps):
         if family not in _FAMILIES:
             raise ValueError(f"unknown family {family!r} (one of {sorted(_FAMILIES)})")
+        if hot_slots is not None:
+            # tiered residency (parallel/residency.py, docs/RESIDENCY.md):
+            # the device batch holds only the hot set; warm/cold tiers
+            # live on the anchor+journal plane, so both are required —
+            # and the anchor must be DEEP (history-complete) because a
+            # revive re-exports the doc's full history for the landing
+            from ..errors import ResidencyError
+
+            if not host_fallback:
+                raise ResidencyError(
+                    "tiered residency (hot_slots=) needs host_fallback="
+                    "True — the warm/cold tiers are the mirror-anchor + "
+                    "journal plane"
+                )
+            if not mirror_anchor:
+                raise ResidencyError(
+                    "tiered residency (hot_slots=) needs a mirror anchor"
+                )
+            mirror_anchor = "deep"
+            caps = dict(caps)
+            caps["hot_slots"] = int(hot_slots)
         self.family = family
-        self.batch = _FAMILIES[family][1](n_docs, mesh, auto_grow, caps)
+        self.batch = self._build_batch(family, n_docs, mesh, auto_grow, caps)
         self.n_docs = n_docs
         # acks[di][replica] = newest epoch that replica confirmed
         self.acks: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
@@ -186,6 +208,44 @@ class ResidentServer:
             auto_checkpoint=auto_checkpoint, history_complete=True,
             anchor=anchor, durable=durable, fsync_window=fsync_window,
         )
+        self._bind_batch(self.batch)
+
+    # -- batch construction (tiered-aware; parallel/residency.py) -------
+    @staticmethod
+    def _build_batch(family: str, n_docs: int, mesh, auto_grow, caps):
+        """One construction point for the device batch: a ``hot_slots``
+        entry in ``caps`` builds a TieredBatch (doc-space window over a
+        hot-set device batch) instead of the plain family batch — the
+        same caps dict rides the WAL meta and v3 checkpoints, so cold
+        recovery and restore rebuild the same shape."""
+        hs = (caps or {}).get("hot_slots")
+        if hs:
+            from .residency import TieredBatch
+
+            return TieredBatch(family, n_docs, hs, mesh, auto_grow, caps)
+        return _FAMILIES[family][1](n_docs, mesh, auto_grow, caps)
+
+    @staticmethod
+    def _import_batch(family: str, data: bytes, caps, mesh):
+        if (caps or {}).get("hot_slots"):
+            from .residency import TieredBatch
+
+            return TieredBatch.import_state(data, mesh=mesh)
+        return _FAMILIES[family][0].import_state(data, mesh=mesh)
+
+    def _bind_batch(self, batch) -> None:
+        """Attach a back-reference on batches that need the server's
+        anchor/journal plane (TieredBatch warm/cold mirrors)."""
+        b = getattr(batch, "bind", None)
+        if b is not None:
+            b(self)
+
+    @property
+    def residency(self):
+        """The ResidencyManager when this server is tiered
+        (``hot_slots=``), else None — tier queries, ``report()`` and
+        the demotion policy hang off it (docs/RESIDENCY.md)."""
+        return getattr(self.batch, "mgr", None)
 
     def _init_resilience(self, mesh, auto_grow, caps, supervisor,
                          host_fallback, auto_checkpoint,
@@ -444,6 +504,12 @@ class ResidentServer:
         # durable append below fails
         if self._host_fallback:
             self._history.append((epoch, frozen, cid))
+            if not self._degraded:
+                # tiered residency: a journaled round's device work is
+                # committed, so its docs become eviction-eligible
+                nj = getattr(self.batch, "note_journaled", None)
+                if nj is not None:
+                    nj()
         if self._durable is not None:
             # fail-stop durability: a failed append means served state
             # has diverged from the WAL — continuing to journal would
@@ -547,14 +613,22 @@ class ResidentServer:
         fetch the smallest device array the batch holds."""
         import jax
         import numpy as np
+        from contextlib import nullcontext
 
-        leaves = []
-        for v in self.batch.__dict__.values():
-            for leaf in jax.tree_util.tree_leaves(v):
-                if isinstance(leaf, jax.Array):
-                    leaves.append(leaf)
-        if leaves:
-            np.asarray(min(leaves, key=lambda a: a.size))
+        dev = getattr(self.batch, "device_batch", self.batch)
+        # under the device lock: a tiered eviction (release_doc) DONATES
+        # the old column buffers — collecting a leaf here and fetching
+        # it after the donation would read a deleted buffer.  The lock
+        # spans collect+fetch so the snapshot stays coherent.
+        lk = getattr(dev, "_dev_lock", None)
+        with (lk if lk is not None else nullcontext()):
+            leaves = []
+            for v in dev.__dict__.values():
+                for leaf in jax.tree_util.tree_leaves(v):
+                    if isinstance(leaf, jax.Array):
+                        leaves.append(leaf)
+            if leaves:
+                np.asarray(min(leaves, key=lambda a: a.size))
 
     # -- coalesced sync rounds ----------------------------------------
     def ingest_coalesced(self, rounds: Sequence[Sequence], cid=None) -> List[int]:
@@ -584,6 +658,24 @@ class ResidentServer:
             # host mirror rounds have no launch to amortize; a solo
             # round IS the serial path
             return [self.ingest(r, cid) for r in rounds]
+        hs = getattr(self.batch, "hot_slots", None)
+        if hs is not None:
+            # tiered residency: a group's distinct docs co-reside in
+            # device slots, so chunk the group to the hot budget (each
+            # chunk commits — and journals — before the next stages,
+            # so consecutive chunks may reuse the whole budget)
+            out: List[int] = []
+            chunk: List[list] = []
+            docs_seen: set = set()
+            for r in rounds:
+                nxt = {di for di, u in enumerate(r) if u is not None}
+                if chunk and len(docs_seen | nxt) > hs:
+                    out.extend(self.ingest_commit(self.ingest_stage(chunk, cid)))
+                    chunk, docs_seen = [], set()
+                chunk.append(r)
+                docs_seen |= nxt
+            out.extend(self.ingest_commit(self.ingest_stage(chunk, cid)))
+            return out
         return self.ingest_commit(self.ingest_stage(rounds, cid))
 
     def ingest_stage(self, rounds: Sequence[Sequence], cid=None):
@@ -873,6 +965,11 @@ class ResidentServer:
         oracle (``loro_tpu/sync``).  Requires ``host_fallback`` (the
         journal/anchor machinery); callers that may hold a pre-v3
         restore check ``_history_complete``/``_anchor`` first."""
+        rh = getattr(self.batch, "rehydrate_anchor", None)
+        if rh is not None:
+            # tiered residency: cold docs' blobs come back first — the
+            # mirror engine must hold EVERY doc, whatever its tier
+            rh()
         host = self._seed_mirror()
         floor = self._anchor.epoch if self._anchor is not None else 0
         for _e, ups, c in self._history:
@@ -918,6 +1015,21 @@ class ResidentServer:
         self._unsynced_rounds = 0
         self._journaled_epoch = self.epoch
         self._durable_epoch = self.epoch
+
+    @property
+    def pipeline_doc_budget(self) -> Optional[int]:
+        """Max DISTINCT docs a coalesced group may touch (None = no
+        bound).  Tiered servers (hot_slots=) bound it to half the hot
+        budget: a group's docs must co-reside in device slots — their
+        merged scatter references the slots, so none is evictable until
+        the group commits and journals — and the staging group overlaps
+        the in-flight one, so two groups' worth must fit.  A single
+        round touching more docs than hot_slots still fails typed
+        (ResidencyError) whatever the grouping."""
+        hs = getattr(self.batch, "hot_slots", None)
+        if hs is None:
+            return None
+        return max(1, hs // 2)
 
     def pipeline(self, cid=None, coalesce: int = 4, depth: int = 2):
         """Attach a ``PipelinedIngest`` executor (parallel/pipeline.py):
@@ -970,6 +1082,7 @@ class ResidentServer:
         but are NOT re-journaled (the WAL already holds them)."""
         sup = self._sup()
         last_epoch = self._ckpt_epoch
+        nj = getattr(self.batch, "note_journaled", None)
         for epoch, cid, ups in rounds:
             sup.launch(
                 lambda ups=ups, cid=cid: self._replay_round(self.batch, list(ups), cid),
@@ -981,6 +1094,11 @@ class ResidentServer:
                 self._cid = cid
             if self._host_fallback:
                 self._history.append((epoch, list(ups), cid))
+            if nj is not None:
+                # replayed rounds come FROM the WAL: journaled by
+                # definition, so tiered eviction stays possible while
+                # the replay revives the docs it touches
+                nj()
             last_epoch = epoch
         # visible epochs must continue exactly where the WAL left off
         self._epoch_offset = max(
@@ -1017,25 +1135,49 @@ class ResidentServer:
                 kv = MemKvStore()
                 kv.import_all(self._replay_base)
                 batch = sup.guard(
-                    lambda: _FAMILIES[self.family][0].import_state(
-                        kv.get(b"batch"),
-                        mesh=mesh if mesh is not None else self._mesh,
+                    lambda: self._import_batch(
+                        self.family, kv.get(b"batch"), self._caps,
+                        mesh if mesh is not None else self._mesh,
                     ),
                     label=f"server.recover.{self.family}",
                 )
                 tail = [r for r in self._history if r[0] > self._ckpt_epoch]
             else:
-                batch = _FAMILIES[self.family][1](
-                    self.n_docs, mesh if mesh is not None else self._mesh,
+                batch = self._build_batch(
+                    self.family, self.n_docs,
+                    mesh if mesh is not None else self._mesh,
                     self._auto_grow, self._caps,
                 )
                 tail = self._history
-            for _e, ups, c in tail:
-                sup.launch(
-                    lambda ups=ups, c=c: self._replay_round(batch, ups, c),
-                    label=f"server.recover.{self.family}",
-                    retry=False,
-                )
+            # bind BEFORE replay: a tiered batch builds its revive
+            # mirrors from this server's anchor + journal.  The journal
+            # is rebuilt INCREMENTALLY alongside the replay (same shape
+            # as persist's _replay_journal_tail): a tiered revive mid-
+            # replay must see only the rounds already replayed — a full
+            # journal would land FUTURE ops in the revive payload and
+            # the remaining replay would then duplicate them on device.
+            self._bind_batch(batch)
+            nj = getattr(batch, "note_journaled", None)
+            full_hist = self._history
+            self._history = (
+                [r for r in full_hist if r[0] <= self._ckpt_epoch]
+                if self._replay_base is not None else []
+            )
+            try:
+                for _e, ups, c in tail:
+                    sup.launch(
+                        lambda ups=ups, c=c: self._replay_round(batch, ups, c),
+                        label=f"server.recover.{self.family}",
+                        retry=False,
+                    )
+                    self._history.append((_e, ups, c))
+                    if nj is not None:
+                        nj()  # journal rounds are journaled by definition
+            except BaseException:
+                # stay degraded with the journal intact: the degraded
+                # mirror (and a later recover() retry) needs it whole
+                self._history = full_hist
+                raise
         except DeviceFailure:
             obs.counter("server.recovery_failures_total").inc(family=self.family)
             return False
@@ -1173,6 +1315,12 @@ class ResidentServer:
         from ..codec.binary import Writer
         from ..storage import MemKvStore
 
+        rh = getattr(self.batch, "rehydrate_anchor", None)
+        if rh is not None:
+            # tiered residency: cold docs' blobs come back into the
+            # anchor first — the rung this checkpoint writes must carry
+            # EVERY doc (it becomes the cold tier's new backing rung)
+            rh()
         if self._anchor is not None:
             # fold the journal tail into the shallow-snapshot anchor
             # BEFORE trimming: the mirror oracle re-anchors here
@@ -1224,8 +1372,9 @@ class ResidentServer:
             # journal from birth (recover() is bounded either way — it
             # filters the tail against _ckpt_epoch)
             self._history = [r for r in self._history if r[0] > self._ckpt_epoch]
+        ckpt_name = None
         if self._durable is not None:
-            self._durable.record_checkpoint(self._ckpt_epoch, blob)
+            ckpt_name = self._durable.record_checkpoint(self._ckpt_epoch, blob)
             # the rotation inside record_checkpoint fsyncs any pending
             # group-commit tail: everything JOURNALED is now durable
             # (self.epoch may already include concurrently-staged
@@ -1239,6 +1388,12 @@ class ResidentServer:
                 "persist.checkpoint_age_rounds",
                 "journaled rounds since the last checkpoint",
             ).set(0, family=self.family)
+        ac = getattr(self.batch, "after_checkpoint", None)
+        if ac is not None:
+            # tiered residency: re-back the cold tier on the fresh rung
+            # (and re-drop its blobs), run the warm-budget demotions,
+            # refresh residency.json
+            ac(ckpt_name)
         return blob
 
     @classmethod
@@ -1304,7 +1459,7 @@ class ResidentServer:
         srv.n_docs = n_docs
         srv.acks = acks
         srv._compacted_at = compacted_at
-        srv.batch = _FAMILIES[family][0].import_state(batch_b, mesh=mesh)
+        srv.batch = cls._import_batch(family, batch_b, caps, mesh)
         if srv.batch.n_docs < n_docs:
             raise DecodeError(
                 "ResidentServer state: batch narrower than the ack table"
@@ -1321,6 +1476,7 @@ class ResidentServer:
             auto_checkpoint=False, history_complete=False,
             anchor=anchor, replay_base=data,
         )
+        srv._bind_batch(srv.batch)
         srv._epoch_offset = epoch_offset
         srv.last_checkpoint = data
         srv._ckpt_epoch = srv.epoch

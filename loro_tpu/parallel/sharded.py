@@ -304,7 +304,8 @@ class ShardedResidentServer:
                  auto_grow: bool = True, host_fallback: bool = True,
                  auto_checkpoint: bool = True,
                  durable_dir: Optional[str] = None, durable_fsync=True,
-                 fsync_window: int = 8, **caps):
+                 fsync_window: int = 8, hot_slots: Optional[int] = None,
+                 **caps):
         from ..resilience import DeviceSupervisor
 
         mesh = mesh if mesh is not None else make_mesh()
@@ -328,6 +329,7 @@ class ShardedResidentServer:
         )
         self._durable_dir = durable_dir
         self._host_fallback_flag = host_fallback
+        self.hot_slots = hot_slots
         self.shards: List[ResidentServer] = []
         try:
             for s in range(n_shards):
@@ -338,6 +340,16 @@ class ShardedResidentServer:
                     )
                     kw["durable_fsync"] = durable_fsync
                     kw["fsync_window"] = fsync_window
+                if hot_slots is not None:
+                    # tiered residency per shard (docs/RESIDENCY.md):
+                    # each shard manages its own hot set over its slice
+                    # of the doc space — eviction never crosses shards.
+                    # The budget is per shard, clamped to the shard's
+                    # width (spares included, so migration landings can
+                    # always go hot).
+                    kw["hot_slots"] = min(
+                        int(hot_slots), self.placement.widths[s]
+                    )
                 self.shards.append(ResidentServer(
                     family, self.placement.widths[s], mesh=self.meshes[s],
                     auto_grow=auto_grow, supervisor=self.supervisors[s],
@@ -629,6 +641,9 @@ class ShardedResidentServer:
                 "free": [list(f) for f in self.placement.free],
                 "global_epoch": self._global_epoch,
                 "emaps": [m.encode() for m in self._emaps],
+                # informational (recovery reads per-shard WAL meta caps;
+                # inspect and operators read this)
+                "hot_slots": self.hot_slots,
             }
 
     def _write_manifest(self) -> None:
@@ -717,6 +732,7 @@ class ShardedResidentServer:
         self.placement = ShardPlacement.from_manifest(manifest)
         self.shards = shard_srvs
         self._durable_dir = durable_dir
+        self.hot_slots = manifest.get("hot_slots")
         self._host_fallback_flag = all(
             srv._host_fallback for srv in shard_srvs
         )

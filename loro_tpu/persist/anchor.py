@@ -271,6 +271,16 @@ def recover_server(durable_dir: str, mesh=None, fsync: bool = True):
         raise
     report.rounds_replayed = len(tail)
     report.recovered_epoch = srv.epoch
+    if report.checkpoint_name:
+        # tiered residency (parallel/residency.py): the restored rung
+        # carries every doc's anchor blob, so it becomes the backing
+        # rung for the docs that were cold at checkpoint time — their
+        # blobs drop out of RAM again unless the WAL replay already
+        # revived them.  No-op for plain servers.
+        hook = getattr(srv.batch, "note_restored_rung", None)
+        if hook is not None:
+            srv.attach_durable(log)  # the hook re-reads/writes the dir
+            hook(report.checkpoint_name)
     obs.counter(
         "persist.recovery_rounds_replayed_total",
         "WAL rounds replayed by recover_server",

@@ -198,6 +198,36 @@ def inspect_dir(durable_dir: str, out=None, _stats: Optional[dict] = None) -> in
             newest_valid.epoch if newest_valid is not None else None
         )
 
+    # -- tiered residency (parallel/residency.py, docs/RESIDENCY.md) ---
+    res_path = os.path.join(durable_dir, "residency.json")
+    if os.path.isfile(res_path):
+        try:
+            with open(res_path, "r") as f:
+                res = json.load(f)
+        except (OSError, ValueError) as e:
+            p(f"residency: residency.json UNREADABLE ({e})")
+            rc = 1
+        else:
+            hot = res.get("hot", {})
+            warm = res.get("warm", [])
+            cold = res.get("cold", {})
+            p(f"residency: hot_slots={res.get('hot_slots')}  "
+              f"hot={len(hot)} warm={len(warm)} cold={len(cold)}")
+            if hot:
+                pairs = ", ".join(
+                    f"doc {d}→slot {s}" for d, s in sorted(
+                        hot.items(), key=lambda kv: int(kv[0])
+                    )[:8]
+                )
+                p(f"  hot: {pairs}{', ...' if len(hot) > 8 else ''}")
+            rung_names = {r.name for r in rungs}
+            for d, rung in sorted(cold.items(), key=lambda kv: int(kv[0])):
+                ok = rung in rung_names
+                p(f"  cold doc {d}: backed by {rung}"
+                  + ("" if ok else "  MISSING RUNG"))
+                if not ok:
+                    rc = 1
+
     # -- recovery preview ----------------------------------------------
     if newest_valid is not None:
         tail = sum(

@@ -623,11 +623,19 @@ class WriteAheadLog:
             for rec in recs:
                 yield rec
 
-    def rounds_after(self, epoch: int) -> List[Tuple[int, Optional[ContainerID], List[Optional[bytes]]]]:
+    def rounds_after(self, epoch: int, doc: Optional[int] = None
+                     ) -> List[Tuple[int, Optional[ContainerID], List[Optional[bytes]]]]:
+        """Round records with epoch > ``epoch``; ``doc=`` narrows to
+        rounds carrying an update for that doc index — the
+        one-doc-scoped bounded replay the tiered cold tier uses
+        (parallel/residency.py revives a cold doc from its backing
+        checkpoint rung plus exactly these rounds)."""
         return [
             (r.epoch, r.cid, r.updates)
             for r in self.records()
             if r.rtype == R_ROUND and r.epoch > epoch
+            and (doc is None
+                 or (doc < len(r.updates) and r.updates[doc] is not None))
         ]
 
     def segments(self) -> List[SegmentInfo]:

@@ -28,6 +28,16 @@ instrumented choke points of the device pipeline:
 - ``session_stall``— sync fan-out delivery: delay one session's
                      notification slot (slow-consumer backpressure and
                      the soak's stalled-session churn)
+- ``evict_flush``  — residency.TieredBatch eviction: fires after the
+                     warm mirror is built but before any tier state
+                     mutates — a failure here must leave the doc HOT
+                     (no torn tier state), surfaced as a typed
+                     ResidencyError
+- ``revive_replay``— residency.TieredBatch revive: fires after the
+                     mirror/history export but before the slot landing
+                     — a failure fails only the triggering round or
+                     ticket (typed ResidencyError), the doc stays
+                     warm/cold and the server stays healthy
 
 Arm programmatically::
 

@@ -492,7 +492,12 @@ class SyncServer:
 
     # -- lifecycle -----------------------------------------------------
     def report(self) -> dict:
-        """Compact outcome dict (the bench ``sync`` sidecar core)."""
+        """Compact outcome dict (the bench ``sync`` sidecar core).
+        Fronting a tiered resident (hot_slots=, docs/RESIDENCY.md)
+        adds the residency report: pushes/pulls on warm/cold docs
+        revive them transparently — a push's ticket simply resolves
+        after the revived round commits — so the hit rate here is the
+        serving-path cache behavior clients actually saw."""
         with self._lock:
             n_sessions = len(self._sessions)
         out = self._fanin.report()
@@ -502,6 +507,9 @@ class SyncServer:
             committed_epoch=self._committed_epoch,
             pipeline=self._pipe is not None,
         )
+        res = getattr(self.resident, "residency", None)
+        if res is not None:
+            out["residency"] = res.report()
         return out
 
     def close(self) -> None:

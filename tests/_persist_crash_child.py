@@ -42,10 +42,10 @@ CAPS = {
 _PEER = {f: 9000 + i for i, f in enumerate(FAMILIES)}
 
 
-def make_doc(family):
+def make_doc(family, idx=0):
     from loro_tpu import LoroDoc
 
-    d = LoroDoc(peer=_PEER[family])
+    d = LoroDoc(peer=_PEER[family] + 100 * idx)
     if family == "text":
         d.get_text("t").insert(0, "crash base text")
     elif family == "map":
@@ -138,6 +138,16 @@ def read_oracle(d, family):
     return {c.id: float(c.get_value())}
 
 
+TIERED_DOCS = 3  # CRASH_TIERED mode: docs per family, hot_slots=1
+
+
+def tiered_doc_of_round(r: int) -> int:
+    """Which doc round ``r`` touches in CRASH_TIERED mode (rotating —
+    every round is a miss at hot_slots=1, maximal evict/revive churn).
+    Shared with the parent test's oracle."""
+    return (r - 1) % TIERED_DOCS
+
+
 def main(base_dir, rounds, ckpt_at, fsync_mode="per_round", fsync_window=0):
     import jax
 
@@ -145,30 +155,46 @@ def main(base_dir, rounds, ckpt_at, fsync_mode="per_round", fsync_window=0):
     from loro_tpu.parallel.server import ResidentServer
 
     group = fsync_mode == "group"
+    tiered = os.environ.get("CRASH_TIERED", "0") == "1"
+    n_docs = TIERED_DOCS if tiered else 1
     kw = {}
     if group:
         kw = dict(durable_fsync="group",
                   fsync_window=fsync_window or 4)
+    if tiered:
+        # SIGKILL-during-evict/revive-churn coverage (docs/RESIDENCY.md):
+        # 3 docs over 1 hot slot, every round revives a warm/cold doc
+        kw["hot_slots"] = 1
     servers, docs, marks = {}, {}, {}
     for fam in FAMILIES:
-        docs[fam] = make_doc(fam)
+        docs[fam] = [make_doc(fam, i) for i in range(n_docs)]
         servers[fam] = ResidentServer(
-            fam, 1, durable_dir=os.path.join(base_dir, fam),
+            fam, n_docs, durable_dir=os.path.join(base_dir, fam),
             **CAPS[fam], **kw,
         )
-        marks[fam] = None
+        marks[fam] = [None] * n_docs
     for r in range(1, rounds + 1):
         for fam in FAMILIES:
-            d, srv = docs[fam], servers[fam]
-            if marks[fam] is None:
+            srv = servers[fam]
+            di = tiered_doc_of_round(r) if tiered else 0
+            d = docs[fam][di]
+            if marks[fam][di] is None:
                 chs = d.oplog.changes_in_causal_order()
             else:
                 apply_edit(d, fam, r)
-                chs = d.oplog.changes_between(marks[fam], d.oplog_vv())
-            marks[fam] = d.oplog_vv()
-            srv.ingest([chs], container_id(fam, d))
+                chs = d.oplog.changes_between(marks[fam][di], d.oplog_vv())
+            marks[fam][di] = d.oplog_vv()
+            ups = [None] * n_docs
+            ups[di] = chs
+            srv.ingest(ups, container_id(fam, d))
             if r == ckpt_at:
                 srv.checkpoint()
+                if tiered:
+                    # push one warm doc to the cold tier so the crash
+                    # window covers a rung-backed doc too
+                    warm = srv.residency.tiers()["warm"]
+                    if warm:
+                        srv.batch.demote(warm[0])
             if group:
                 # one flushed line per round: the parent's watermark
                 # oracle (flush() reaches the OS, which survives the

@@ -28,6 +28,15 @@ migrates between shards at mid-run, and the per-epoch gates hold
 unchanged.  Composes with DURABLE (per-shard WALs + manifest, the
 reopen goes through persist.recover_sharded_server) and PIPELINE
 (per-shard executors behind one submit).
+
+SOAK_RES_TIERED=K rides every family on a tiered server (hot_slots=K
+<< docs, docs/RESIDENCY.md): each epoch edits a zipfian-skewed subset
+of at most K docs, so ingest constantly revives warm docs and evicts
+LRU ones, while the per-epoch gates still read EVERY doc (warm reads
+come from host mirrors).  Composes with DURABLE (the reopen restores
+tier assignments from the checkpoint; a warm doc is demoted cold at
+each checkpoint epoch) and PIPELINE (revival rides the same executor,
+groups bounded by the hot budget) and SHARDS (per-shard managers).
 """
 import os
 import os.path as _p
@@ -59,6 +68,7 @@ SEED = int(os.environ.get("SOAK_RES_SEED", "0"))
 DURABLE = os.environ.get("SOAK_RES_DURABLE", "0") == "1"
 PIPELINE = os.environ.get("SOAK_RES_PIPELINE", "0") == "1"
 SHARDS = int(os.environ.get("SOAK_RES_SHARDS", "0"))
+TIERED = int(os.environ.get("SOAK_RES_TIERED", "0"))
 
 t0 = time.time()
 rng = random.Random(SEED)
@@ -75,7 +85,7 @@ mesh = make_mesh()
 cid_t = pairs[0][0].get_text("t").id
 cid_ml = pairs[0][0].get_movable_list("ml").id
 cid_tr = pairs[0][0].get_tree("tr").id
-if DURABLE or PIPELINE or SHARDS:
+if DURABLE or PIPELINE or SHARDS or TIERED:
     import shutil
     import tempfile
 
@@ -91,6 +101,9 @@ if DURABLE or PIPELINE or SHARDS:
                 # pipelined rounds ride the WAL group-commit window
                 kw["durable_fsync"] = "group"
                 kw["fsync_window"] = 4
+        if TIERED:
+            # hot set of K device slots; warm/cold docs hold no rows
+            kw["hot_slots"] = TIERED
         if SHARDS:
             from loro_tpu.parallel.sharded import ShardedResidentServer
 
@@ -109,6 +122,9 @@ if DURABLE or PIPELINE or SHARDS:
     if SHARDS:
         print(f"sharded mode: {SHARDS} shards per family, placement "
               f"{docs_b.placement.shard_of}")
+    if TIERED:
+        print(f"tiered mode: hot_slots={TIERED} over {N} docs, "
+              "zipfian per-epoch active sets")
     if PIPELINE:
         for _b, _cid in ((docs_b, cid_t), (maps_b, None), (tree_b, cid_tr),
                          (ctr_b, None), (ml_b, cid_ml)):
@@ -125,7 +141,7 @@ else:
 def _ingest(b, ups, cid=None):
     if PIPELINE:
         b._soak_pipe.submit(ups)
-    elif DURABLE or SHARDS:
+    elif DURABLE or SHARDS or TIERED:
         b.ingest(ups, cid)
     elif cid is not None:
         b.append_changes(ups, cid)
@@ -144,20 +160,51 @@ def _batches(b):
     a sharded fleet holds one per shard."""
     if SHARDS:
         return [s.batch for s in b.shards]
-    return [b.batch if (DURABLE or PIPELINE) else b]
+    return [b.batch if (DURABLE or PIPELINE or TIERED) else b]
 
 
 marks = [a.oplog_vv() for a, _ in pairs]
 init = [a.oplog.changes_in_causal_order() for a, _ in pairs]
-_ingest(docs_b, init, cid_t)
-_ingest(maps_b, init)
-_ingest(tree_b, init, cid_tr)
-_ingest(ctr_b, init)
-_ingest(ml_b, init, cid_ml)
+if TIERED:
+    # hot budget bounds docs per round: land each doc's base history
+    # in its own round (the revive/evict churn starts immediately)
+    for i in range(N):
+        one = [init[i] if j == i else None for j in range(N)]
+        _ingest(docs_b, one, cid_t)
+        _ingest(maps_b, one)
+        _ingest(tree_b, one, cid_tr)
+        _ingest(ctr_b, one)
+        _ingest(ml_b, one, cid_ml)
+else:
+    _ingest(docs_b, init, cid_t)
+    _ingest(maps_b, init)
+    _ingest(tree_b, init, cid_tr)
+    _ingest(ctr_b, init)
+    _ingest(ml_b, init, cid_ml)
+
+_ZIPF_W = [1.0 / (i + 1) for i in range(N)]
+
+
+def _active_docs():
+    """The docs this epoch touches: everything normally; under TIERED
+    a zipfian-skewed set of at most hot_slots docs (run locality — the
+    same skew the Eg-walker paper exploits)."""
+    if not TIERED:
+        return list(range(N))
+    k = max(1, min(TIERED, N))
+    chosen = []
+    for i in rng.choices(range(N), weights=_ZIPF_W, k=4 * k):
+        if i not in chosen:
+            chosen.append(i)
+        if len(chosen) == k:
+            break
+    return chosen
+
 
 KEYS = ["k1", "k2", "k3"]
 for epoch in range(EPOCHS):
-    for a, b in pairs:
+    active = _active_docs()
+    for a, b in (pairs[i] for i in active):
         for d in (a, b):
             for _ in range(rng.randint(3, 10)):
                 kind = rng.randint(0, 5)
@@ -212,6 +259,9 @@ for epoch in range(EPOCHS):
         assert a.get_deep_value() == b.get_deep_value()
     ups = []
     for i, (a, _) in enumerate(pairs):
+        if i not in active:
+            ups.append(None)
+            continue
         ups.append(a.oplog.changes_between(marks[i], a.oplog_vv()))
         marks[i] = a.oplog_vv()
     _ingest(docs_b, ups, cid_t)
@@ -253,7 +303,15 @@ for epoch in range(EPOCHS):
         # checkpoint ladder + WAL rotation/prune + journal trim
         for b in (docs_b, maps_b, tree_b, ctr_b, ml_b):
             b.checkpoint()
-        print(f"  epoch {epoch}: checkpointed all five families")
+            if TIERED:
+                # exercise the cold tier: demote one warm doc per
+                # family onto the fresh rung (revives on next touch)
+                for sub in (b.shards if SHARDS else [b]):
+                    warm = sub.residency.tiers()["warm"]
+                    if warm:
+                        sub.batch.demote(warm[0])
+        print(f"  epoch {epoch}: checkpointed all five families"
+              + (" (+cold demotions)" if TIERED else ""))
 
     texts = docs_b.texts()
     segs = docs_b.richtexts()

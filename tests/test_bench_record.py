@@ -108,6 +108,20 @@ def _fat_checkpoint():
               "epochs": 6, "push_to_visible_ms_p50": 47.7,
               "push_to_visible_ms_p99": 952.7, "pull_bytes_mean": 272.1,
               "pulls": 96, "note": "s" * 300},
+        tier_hit_rate=0.91,
+        tier_revive_ms_p50=2.1,
+        tier_revive_ms_p99=14.7,
+        tier_rows_per_sec=850_000,
+        tier_all_hot_rows_per_sec=940_000,
+        tier_vs_all_hot=0.9,
+        tier_hot_path_ratio=0.97,
+        tier={"hot_slots": 4, "docs": 32, "hits": 30, "misses": 6,
+              "hit_rate": 0.91, "promotions": 6, "evictions": 2,
+              "demotions": 0, "cold_revives": 0, "revive_ms_p50": 2.1,
+              "revive_ms_p99": 14.7, "hot": 4, "warm": 28, "cold": 0,
+              "rows_per_round": 96, "skew": "85/15 over 4-doc core",
+              "rows_per_sec_all_hot": 940_000,
+              "rows_per_sec_tiered": 850_000, "note": "t" * 300},
         shard_count=8,
         shard_rows_per_sec=900_000,
         shard_scaling_x=2.4,
@@ -139,12 +153,15 @@ class TestFlagshipLine:
                   "sync_sessions", "sync_pushes_per_sec",
                   "sync_push_to_visible_ms_p50",
                   "sync_push_to_visible_ms_p99",
-                  "shard_count", "shard_scaling_x", "shard_rows_per_sec"):
+                  "shard_count", "shard_scaling_x", "shard_rows_per_sec",
+                  "tier_hit_rate", "tier_revive_ms_p50",
+                  "tier_revive_ms_p99", "tier_vs_all_hot",
+                  "tier_hot_path_ratio"):
             assert k in back, k
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
         for k in ("metrics", "resilience", "pipeline", "rank", "sync",
-                  "shard", "baseline_note", "roofline_note",
+                  "shard", "tier", "baseline_note", "roofline_note",
                   "resident_pipeline_note"):
             assert k in side, k
             assert k not in back, k

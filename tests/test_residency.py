@@ -1,0 +1,531 @@
+"""Tiered doc residency (ISSUE 10, docs/RESIDENCY.md): the five-family
+differential gate (tiered server under forced evict/revive churn ends
+read-identical to an always-hot server fed the same rounds, serial and
+pipelined), the evict/revive fault-site contracts, the durable cold
+tier (SIGKILL round trip included), and the residency.plan lock
+witness."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.codec.binary import encode_changes
+from loro_tpu.doc import strip_envelope
+from loro_tpu.errors import ResidencyError
+from loro_tpu.parallel.residency import TieredResidentServer
+from loro_tpu.parallel.server import ResidentServer
+from loro_tpu.resilience import faultinject
+
+N_DOCS = 4
+
+CAPS = {
+    "text": dict(capacity=1 << 12),
+    "map": dict(slot_capacity=64),
+    "tree": dict(move_capacity=1 << 10, node_capacity=128),
+    "movable": dict(capacity=1 << 10, elem_capacity=128),
+    "counter": dict(slot_capacity=16),
+}
+
+FAMILIES = ["text", "map", "tree", "movable", "counter"]
+
+
+def _mk_docs():
+    docs = []
+    for i in range(N_DOCS):
+        d = LoroDoc(peer=300 + 2 * i)
+        d.get_text("t").insert(0, f"residency base {i}")
+        d.get_map("m").set("k", i)
+        d.get_tree("tr").create()
+        d.get_counter("c").increment(i + 1)
+        d.get_movable_list("ml").push("a", "b")
+        d.commit()
+        docs.append(d)
+    return docs
+
+
+def _cids(docs):
+    return {
+        "text": docs[0].get_text("t").id,
+        "tree": docs[0].get_tree("tr").id,
+        "movable": docs[0].get_movable_list("ml").id,
+        "map": None,
+        "counter": None,
+    }
+
+
+def _edit(rng, d, r):
+    t = d.get_text("t")
+    L = len(t)
+    if L > 6 and rng.random() < 0.3:
+        t.delete(rng.randrange(L - 2), 2)
+    else:
+        t.insert(rng.randint(0, L), rng.choice(["xy", "q "]))
+    if rng.random() < 0.3:
+        t.mark(0, min(4, len(t)), "bold", True)
+    d.get_map("m").set(rng.choice(["k", "j"]), rng.randrange(50))
+    tr = d.get_tree("tr")
+    nodes = tr.nodes()
+    tr.create(rng.choice(nodes) if nodes and rng.random() < 0.5 else None)
+    d.get_counter("c").increment(rng.randint(-5, 9))
+    ml = d.get_movable_list("ml")
+    L = len(ml)
+    if L >= 2 and rng.random() < 0.4:
+        ml.move(rng.randrange(L), rng.randrange(L))
+    else:
+        ml.insert(rng.randint(0, L), f"v{r}")
+    d.commit()
+
+
+def _mk_rounds(docs, n_churn=12, seed=0xD0C5, max_docs=2):
+    """Base rounds (one doc's full history each) + churn rounds each
+    touching 1-``max_docs`` docs — frozen as wire bytes so change-RLE
+    aliasing cannot blur the cross-server comparison."""
+    import random
+
+    rng = random.Random(seed)
+    marks = [d.oplog_vv() for d in docs]
+    rounds = []
+    for i, d in enumerate(docs):
+        ups = [None] * N_DOCS
+        ups[i] = bytes(encode_changes(list(d.oplog.changes_in_causal_order())))
+        rounds.append(ups)
+    for r in range(n_churn):
+        ups = [None] * N_DOCS
+        for i in rng.sample(range(N_DOCS), rng.randint(1, max_docs)):
+            _edit(rng, docs[i], r)
+            ups[i] = bytes(encode_changes(
+                list(docs[i].oplog.changes_between(marks[i], docs[i].oplog_vv()))
+            ))
+            marks[i] = docs[i].oplog_vv()
+        rounds.append(ups)
+    return rounds
+
+
+def _reads(srv, family):
+    if family == "text":
+        return (srv.texts(), srv.richtexts())
+    if family == "map":
+        return (srv.root_value_maps("m"), srv.value_maps())
+    if family == "tree":
+        return (srv.parent_maps(), srv.children_maps())
+    if family == "movable":
+        return (srv.value_lists(),)
+    return (srv.value_maps(),)
+
+
+def _oracle(docs, family):
+    if family == "text":
+        return ([d.get_text("t").to_string() for d in docs],
+                [d.get_text("t").get_richtext_value() for d in docs])
+    if family == "map":
+        return [d.get_map("m").get_value() for d in docs]
+    if family == "tree":
+        return [
+            {x: d.get_tree("tr").parent(x) for x in d.get_tree("tr").nodes()}
+            for d in docs
+        ]
+    if family == "movable":
+        return [d.get_movable_list("ml").get_value() for d in docs]
+    return None  # counter compared across servers only
+
+
+class TestDifferentialGate:
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_tiered_matches_always_hot(self, family):
+        """Acceptance gate: a tiered server (hot_slots=2 << 4 docs,
+        forced evict/revive churn interleaved with ingest, reads and a
+        mid-stream checkpoint) ends READ-identical to an always-hot
+        ResidentServer fed the same rounds — serial and pipelined —
+        and matches the host oracle."""
+        docs = _mk_docs()
+        cid = _cids(docs)[family]
+        rounds = _mk_rounds(docs)
+        hot = ResidentServer(family, N_DOCS, **CAPS[family])
+        tiered = TieredResidentServer(family, N_DOCS, hot_slots=2,
+                                      **CAPS[family])
+        for j, ups in enumerate(rounds):
+            hot.ingest(list(ups), cid)
+            tiered.ingest(list(ups), cid)
+            if j == len(rounds) // 2:
+                # mid-stream: reads force warm mirrors, checkpoint
+                # folds the anchor under live tier state
+                assert _reads(tiered, family) == _reads(hot, family)
+                tiered.checkpoint()
+        rep = tiered.residency.report()
+        assert rep["evictions"] > 0, "churn must actually evict"
+        assert rep["promotions"] > rep["hot_slots"], "and revive"
+        assert _reads(tiered, family) == _reads(hot, family)
+        want = _oracle(docs, family)
+        if want is not None:
+            got = _reads(tiered, family)
+            got = got[0] if family != "text" else got
+            assert got == (want if family != "text" else want)
+        # pipelined tiered: same rounds through the executor
+        pl = TieredResidentServer(family, N_DOCS, hot_slots=2,
+                                  **CAPS[family])
+        ex = pl.pipeline(cid=cid, coalesce=4)
+        for ups in rounds:
+            ex.submit(list(ups))
+        ex.flush()
+        assert _reads(pl, family) == _reads(hot, family)
+        ex.close()
+
+    def test_checkpoint_restore_keeps_tiers(self):
+        docs = _mk_docs()
+        cid = _cids(docs)["text"]
+        rounds = _mk_rounds(docs, n_churn=8, seed=7)
+        srv = TieredResidentServer("text", N_DOCS, hot_slots=2,
+                                   **CAPS["text"])
+        for ups in rounds:
+            srv.ingest(list(ups), cid)
+        want = srv.texts()
+        blob = srv.checkpoint()
+        back = ResidentServer.restore(blob)
+        assert back.residency is not None
+        assert back.residency.counts()["hot"] == 2
+        assert back.texts() == want
+        # the restored server keeps serving through churn
+        import random
+
+        rng = random.Random(9)
+        marks = [d.oplog_vv() for d in docs]
+        for r in range(4):
+            i = rng.randrange(N_DOCS)
+            _edit(rng, docs[i], 100 + r)
+            ups = [None] * N_DOCS
+            ups[i] = bytes(encode_changes(list(
+                docs[i].oplog.changes_between(marks[i], docs[i].oplog_vv())
+            )))
+            marks[i] = docs[i].oplog_vv()
+            back.ingest(ups, cid)
+        assert back.texts() == [d.get_text("t").to_string() for d in docs]
+
+    def test_round_wider_than_hot_budget_fails_typed(self):
+        docs = _mk_docs()
+        cid = _cids(docs)["text"]
+        srv = TieredResidentServer("text", N_DOCS, hot_slots=2,
+                                   **CAPS["text"])
+        ups = [
+            bytes(encode_changes(list(d.oplog.changes_in_causal_order())))
+            for d in docs
+        ]
+        with pytest.raises(ResidencyError):
+            srv.ingest(ups, cid)
+
+    def test_tiered_needs_host_fallback(self):
+        with pytest.raises(ResidencyError):
+            ResidentServer("text", 4, hot_slots=2, host_fallback=False)
+
+
+class TestFaultSites:
+    def _two_doc_server(self):
+        docs = _mk_docs()[:2]
+        cid = docs[0].get_text("t").id
+        srv = TieredResidentServer("text", 2, hot_slots=1, **CAPS["text"])
+        base0 = [bytes(encode_changes(list(
+            docs[0].oplog.changes_in_causal_order()))), None]
+        srv.ingest(base0, cid)
+        round1 = [None, bytes(encode_changes(list(
+            docs[1].oplog.changes_in_causal_order())))]
+        return srv, docs, cid, round1
+
+    @pytest.mark.faultinject
+    def test_evict_fault_leaves_doc_hot(self):
+        """Satellite contract: an injected failure mid-evict leaves the
+        victim HOT (no torn tier state); the triggering round fails
+        typed and a retry succeeds."""
+        srv, docs, cid, round1 = self._two_doc_server()
+        assert srv.residency.tier_of(0) == "hot"
+        faultinject.inject("evict_flush", times=1)
+        try:
+            with pytest.raises(ResidencyError):
+                srv.ingest(list(round1), cid)
+        finally:
+            faultinject.clear()
+        assert srv.residency.tier_of(0) == "hot"
+        assert srv.residency.tier_of(1) == "warm"
+        assert not srv.degraded  # never misread as a device failure
+        # state untouched — the same round then lands exactly once
+        srv.ingest(list(round1), cid)
+        assert srv.texts() == [d.get_text("t").to_string() for d in docs]
+        assert srv.residency.tier_of(1) == "hot"
+
+    @pytest.mark.faultinject
+    def test_revive_fault_fails_only_the_round(self):
+        """Satellite contract: an injected failure mid-revive fails
+        only the triggering round with a typed ResidencyError; the doc
+        stays warm and the next round succeeds."""
+        srv, docs, cid, round1 = self._two_doc_server()
+        faultinject.inject("revive_replay", times=1)
+        try:
+            with pytest.raises(ResidencyError):
+                srv.ingest(list(round1), cid)
+        finally:
+            faultinject.clear()
+        assert srv.residency.tier_of(1) == "warm"
+        assert not srv.degraded
+        assert srv.epoch == 1  # the failed round never got an epoch
+        srv.ingest(list(round1), cid)
+        assert srv.texts() == [d.get_text("t").to_string() for d in docs]
+
+
+class TestDegradeRecover:
+    @pytest.mark.faultinject
+    def test_degrade_then_recover_replay_is_exact(self):
+        """Regression (found by the verify drive): in-process
+        ``recover()`` replays the journal tail through tiered appends —
+        a revive mid-replay must see only the rounds ALREADY replayed,
+        or the landing carries future ops the remaining replay then
+        duplicates on device (doubled text)."""
+        from loro_tpu.resilience import (
+            DeviceSupervisor, set_supervisor,
+        )
+
+        docs = _mk_docs()
+        cid = _cids(docs)["text"]
+        rounds = _mk_rounds(docs, n_churn=8, seed=44)
+        srv = TieredResidentServer("text", N_DOCS, hot_slots=2,
+                                   **CAPS["text"])
+        for ups in rounds[:-1]:
+            srv.ingest(list(ups), cid)
+        set_supervisor(DeviceSupervisor(sleep=lambda s: None))
+        try:
+            faultinject.inject("launch", exc=OSError("injected"), times=1)
+            srv.ingest(list(rounds[-1]), cid)
+            assert srv.degraded
+            want = _oracle(docs, "text")[0]
+            assert srv.texts() == want, "degraded reads"
+            assert srv.recover()
+            assert srv.texts() == want, "post-recover device reads"
+            # post-recover churn keeps converging (revives work on the
+            # rebuilt batch)
+            import random
+
+            rng = random.Random(45)
+            marks = [d.oplog_vv() for d in docs]
+            for r in range(4):
+                i = rng.randrange(N_DOCS)
+                _edit(rng, docs[i], 300 + r)
+                ups = [None] * N_DOCS
+                ups[i] = bytes(encode_changes(list(
+                    docs[i].oplog.changes_between(marks[i], docs[i].oplog_vv())
+                )))
+                marks[i] = docs[i].oplog_vv()
+                srv.ingest(ups, cid)
+            assert srv.texts() == _oracle(docs, "text")[0]
+        finally:
+            faultinject.clear()
+            set_supervisor(None)
+
+
+class TestDurableColdTier:
+    def test_demote_cold_revive_and_recover(self, tmp_path):
+        from loro_tpu.persist import recover_server
+
+        docs = _mk_docs()
+        cid = _cids(docs)["text"]
+        ddir = str(tmp_path / "tiered")
+        srv = TieredResidentServer("text", N_DOCS, hot_slots=2,
+                                   durable_dir=ddir, **CAPS["text"])
+        marks = [{} for _ in docs]
+        for i, d in enumerate(docs):
+            ups = [None] * N_DOCS
+            ups[i] = bytes(encode_changes(list(d.oplog.changes_in_causal_order())))
+            marks[i] = d.oplog_vv()
+            srv.ingest(ups, cid)
+        srv.checkpoint()
+        warm = srv.residency.tiers()["warm"]
+        srv.batch.demote(warm[0])
+        assert srv.residency.tier_of(warm[0]) == "cold"
+        assert srv._anchor.doc_blobs[warm[0]] == b""  # RAM released
+        # the manifest names the backing rung, inspect reads clean
+        man = json.loads(
+            (tmp_path / "tiered" / "residency.json").read_text()
+        )
+        assert str(warm[0]) in man["cold"]
+        from loro_tpu.persist.inspect import inspect_dir
+
+        class _Sink:
+            def __init__(self):
+                self.lines = []
+
+            def write(self, s):
+                self.lines.append(s)
+
+        sink = _Sink()
+        assert inspect_dir(ddir, out=sink) == 0
+        assert any("residency:" in ln for ln in sink.lines)
+        # a round touching the cold doc revives it transparently
+        import random
+
+        rng = random.Random(3)
+        _edit(rng, docs[warm[0]], 50)
+        ups = [None] * N_DOCS
+        ups[warm[0]] = bytes(encode_changes(list(
+            docs[warm[0]].oplog.changes_between(
+                marks[warm[0]], docs[warm[0]].oplog_vv())
+        )))
+        marks[warm[0]] = docs[warm[0]].oplog_vv()
+        srv.ingest(ups, cid)
+        assert srv.residency.report()["cold_revives"] == 1
+        assert srv.texts() == [d.get_text("t").to_string() for d in docs]
+        # demote another doc, checkpoint (re-backs cold on the fresh
+        # rung), close + recover: tier assignments restored, cold doc
+        # readable on first touch, durable watermark correct
+        warm2 = srv.residency.tiers()["warm"]
+        srv.batch.demote(warm2[0])
+        srv.checkpoint()
+        want = [d.get_text("t").to_string() for d in docs]
+        closed_epoch = srv.epoch
+        srv.close()
+        back = recover_server(ddir)
+        assert back.epoch == closed_epoch
+        assert back.durable_epoch == closed_epoch
+        assert back.residency.tier_of(warm2[0]) == "cold"
+        assert back._anchor.doc_blobs[warm2[0]] == b""
+        assert back.texts() == want  # cold doc revives on first touch
+        back.close()
+
+    def test_sigkill_during_churn_then_recover(self, tmp_path):
+        """Acceptance: SIGKILL during evict/revive churn (between
+        launches, CPU mesh), then recover_server reopens every family
+        with every doc readable and durable_epoch correct."""
+        sys.path.insert(0, os.path.dirname(__file__))
+        import _persist_crash_child as crash
+
+        base = str(tmp_path / "crash")
+        os.makedirs(base)
+        rounds, ckpt_at = 8, 4
+        child = os.path.join(os.path.dirname(__file__),
+                             "_persist_crash_child.py")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", CRASH_TIERED="1")
+        proc = subprocess.Popen(
+            [sys.executable, child, base, str(rounds), str(ckpt_at)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        ready = os.path.join(base, "READY")
+        deadline = time.time() + 300
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "crash child died early:\n"
+                    + proc.stderr.read().decode(errors="replace")[-2000:]
+                )
+            if time.time() > deadline:
+                proc.kill()
+                raise AssertionError("crash child never reached READY")
+            time.sleep(0.2)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        from loro_tpu.persist import recover_server
+
+        for fam in crash.FAMILIES:
+            srv = recover_server(os.path.join(base, fam))
+            assert srv.residency is not None
+            assert srv.durable_epoch == srv.epoch
+            # reproduce the oracle doc streams in-process
+            docs = [crash.make_doc(fam, i) for i in range(crash.TIERED_DOCS)]
+            marks = [None] * crash.TIERED_DOCS
+            for r in range(1, rounds + 1):
+                di = crash.tiered_doc_of_round(r)
+                if marks[di] is not None:
+                    crash.apply_edit(docs[di], fam, r)
+                marks[di] = docs[di].oplog_vv()
+            for di in range(crash.TIERED_DOCS):
+                got = _reads(srv, fam)
+                want_docs = docs
+            if fam == "text":
+                assert srv.texts() == [
+                    d.get_text("t").to_string() for d in want_docs
+                ], fam
+            elif fam == "map":
+                assert srv.root_value_maps("m") == [
+                    d.get_map("m").get_value() for d in want_docs
+                ], fam
+            elif fam == "tree":
+                assert srv.parent_maps() == [
+                    {x: d.get_tree("tr").parent(x)
+                     for x in d.get_tree("tr").nodes()}
+                    for d in want_docs
+                ], fam
+            elif fam == "movable":
+                assert srv.value_lists() == [
+                    d.get_movable_list("ml").get_value() for d in want_docs
+                ], fam
+            else:
+                vals = srv.value_maps()
+                for di, d in enumerate(want_docs):
+                    c = d.get_counter("c")
+                    assert vals[di].get(c.id, 0.0) == c.get_value(), fam
+            srv.close()
+
+
+class TestShardedTiered:
+    def test_sharded_tiered_with_migration(self):
+        """Per-shard residency managers under ShardedResidentServer:
+        churn + a live migration, reads gated vs an always-hot sharded
+        fleet and the host docs (eviction never crosses shards — each
+        shard owns its own manager)."""
+        from loro_tpu.parallel.sharded import ShardedResidentServer
+
+        docs = _mk_docs()
+        cid = _cids(docs)["text"]
+        # single-doc rounds: each shard runs hot_slots=1, so a round
+        # may touch at most one doc per shard
+        rounds = _mk_rounds(docs, n_churn=8, seed=21, max_docs=1)
+        hot = ShardedResidentServer("text", N_DOCS, shards=2, **CAPS["text"])
+        tiered = ShardedResidentServer("text", N_DOCS, shards=2,
+                                       hot_slots=1, **CAPS["text"])
+        mid = len(rounds) // 2
+        for ups in rounds[:mid]:
+            hot.ingest(list(ups), cid)
+            tiered.ingest(list(ups), cid)
+        for sh in (hot, tiered):
+            src = sh.placement.place(0)[0]
+            sh.migrate(0, (src + 1) % 2)
+        for ups in rounds[mid:]:
+            hot.ingest(list(ups), cid)
+            tiered.ingest(list(ups), cid)
+        assert tiered.texts() == hot.texts() == [
+            d.get_text("t").to_string() for d in docs
+        ]
+        assert sum(
+            s.residency.report()["evictions"] for s in tiered.shards
+        ) > 0
+
+
+class TestWitness:
+    def test_residency_plan_edges_conform(self):
+        """The residency.plan lock nests conformantly (plan -> dev
+        beneath the pipeline/sharded spine) and the witnessed graph
+        stays acyclic."""
+        from loro_tpu.analysis import lockorder
+        from loro_tpu.analysis.lockwitness import witness
+
+        w = witness()
+        w.reset()
+        w.enable(strict=False)
+        try:
+            docs = _mk_docs()
+            cid = _cids(docs)["text"]
+            rounds = _mk_rounds(docs, n_churn=6, seed=33)
+            srv = TieredResidentServer("text", N_DOCS, hot_slots=2,
+                                       **CAPS["text"])
+            ex = srv.pipeline(cid=cid, coalesce=4)
+            for ups in rounds:
+                ex.submit(list(ups))
+            ex.flush()
+            ex.close()
+        finally:
+            w.disable()
+        edges = w.edges()
+        assert ("residency.plan", "fleet.dev") in edges
+        assert w.check_declared() == []
+        w.assert_acyclic()
+        assert lockorder.level("residency.plan") is not None
+        w.reset()

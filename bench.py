@@ -166,6 +166,7 @@ _CORE_KEYS = (
 # always routed to the sidecar line: prose, dict sidecars, series
 _SIDECAR_KEYS = (
     "metrics", "resilience", "pipeline", "rank", "sync", "shard", "tier",
+    "readplane",
     "baseline_note", "latency_note", "roofline_note",
     "roofline_measured_note", "resident_note", "resident_durable_note",
     "resident_pipeline_note", "e2e_note", "e2e_unit", "richtext_unit",
@@ -293,6 +294,13 @@ def assemble_record(ck: dict) -> dict:
         "sync_push_to_visible_ms_p50",
         "sync_push_to_visible_ms_p99",
         "sync",
+        "sync_readers",
+        "sync_pulls_per_sec",
+        "sync_pulls_per_sec_oracle",
+        "sync_read_speedup",
+        "sync_pull_ms_p50",
+        "sync_pull_ms_p99",
+        "readplane",
         "shard_count",
         "shard_rows_per_sec",
         "shard_scaling_x",
@@ -1626,6 +1634,161 @@ def main() -> None:
             )
         except Exception as e:  # tpulint: disable=LT-EXC(sync extra, never the headline)
             note(f"sync phase failed ({type(e).__name__}: {e})")
+
+    # ---- phase: batched read plane (BENCH_SYNC_READERS=N, ISSUE 11) ---
+    # reader-heavy serving A/B: N concurrent reader sessions pull every
+    # epoch from two identically-fed text SyncServers — one with the
+    # batched device read plane (concurrent pulls coalesce into one
+    # export launch per window, identical frames shared), one pinned to
+    # the per-doc host oracle (read_batch=False).  Banks the
+    # sync_pulls_per_sec flagship pair + p50/p99 pull latency + the
+    # `readplane` sidecar, and asserts the count guard: one export
+    # launch per coalesced window.  CPU-mesh numbers in CI; chip
+    # numbers pending pool return (probe-compile the select shapes in
+    # a disposable run first, per CLAUDE.md).
+    if remaining() > 30 and os.environ.get("BENCH_SYNC_READERS"):
+        try:
+            import random as _random
+            from concurrent.futures import ThreadPoolExecutor as _TPE
+
+            from loro_tpu import LoroDoc
+            from loro_tpu.sync import SyncServer
+
+            n_readers = int(os.environ["BENCH_SYNC_READERS"])
+            R_DOCS, R_EPOCHS, R_EDITS = 4, 6, 192
+            note(
+                f"read-plane phase: {n_readers} readers x {R_DOCS} docs x "
+                f"{R_EPOCHS} epochs, batched-device vs host-oracle..."
+            )
+            _rng4 = _random.Random(0x4EADB10C)
+            _wdocs = []
+            for i in range(R_DOCS):
+                b = LoroDoc(peer=4000 + i)
+                b.get_text("t").insert(0, f"read plane base {i}")
+                b.commit()
+                _wdocs.append(b)
+            _rcid = _wdocs[0].get_text("t").id
+            _arms = ("device", "oracle")
+            _rsrv = {
+                "device": SyncServer("text", R_DOCS, cid=_rcid,
+                                     capacity=1 << 14, max_queue=128),
+                "oracle": SyncServer("text", R_DOCS, cid=_rcid,
+                                     capacity=1 << 14, max_queue=128,
+                                     read_batch=False),
+            }
+            _wsess = {a: [_rsrv[a].connect(sid=f"w{i}")
+                          for i in range(R_DOCS)] for a in _arms}
+            _marks = [{} for _ in range(R_DOCS)]
+            _boot = []
+            for i in range(R_DOCS):
+                pl = _wdocs[i].export_updates({})
+                for a in _arms:
+                    _boot.append(_wsess[a][i].push(i, pl))
+                _marks[i] = _wdocs[i].oplog_vv()
+            for _tk in _boot:
+                _tk.epoch(120)
+            _rdrs = {a: [_rsrv[a].connect(sid=f"r{k}")
+                         for k in range(n_readers)] for a in _arms}
+            # persistent reader pools (thread SPAWN cost is common-mode
+            # noise that would swamp the serving difference) + a warm
+            # round excluded from timing: compiles the selection
+            # kernel's bucket shapes and seeds the reader frontiers
+            # (steady-state serving is the thing being measured)
+            _pools = {a: _TPE(max_workers=n_readers) for a in _arms}
+            for a in _arms:
+                for k in range(n_readers):
+                    _rdrs[a][k].pull(k % R_DOCS)
+            _lat = {a: [] for a in _arms}
+            _wall = {a: 0.0 for a in _arms}
+            _pull_n = {a: 0 for a in _arms}
+
+            def _mk_pull(a):
+                sess, lats = _rdrs[a], _lat[a]
+
+                def _pull_one(k):
+                    t0p = time.perf_counter()
+                    sess[k].pull(k % R_DOCS)
+                    lats.append(time.perf_counter() - t0p)
+                return _pull_one
+
+            for _e in range(R_EPOCHS):
+                _tks = []
+                for i in range(R_DOCS):
+                    d = _wdocs[i]
+                    t = d.get_text("t")
+                    for _ in range(R_EDITS):
+                        L = len(t)
+                        t.insert(_rng4.randint(0, L), "abcdef"[:_rng4.randint(1, 6)])
+                    d.commit()
+                    pl = d.export_updates(_marks[i])
+                    for a in _arms:
+                        _tks.append(_wsess[a][i].push(i, pl))
+                    _marks[i] = d.oplog_vv()
+                for _tk in _tks:
+                    _tk.epoch(120)
+                # interleave arm order per epoch (decorrelate ambient load)
+                for a in (_arms if _e % 2 == 0 else _arms[::-1]):
+                    _fn = _mk_pull(a)
+                    _t0a = time.perf_counter()
+                    list(_pools[a].map(_fn, range(n_readers)))
+                    _wall[a] += time.perf_counter() - _t0a
+                    _pull_n[a] += n_readers
+            # convergence + count guard
+            _dt = _rsrv["device"].texts()
+            _ot = _rsrv["oracle"].texts()
+            assert _dt == _ot, "read-plane A/B servers diverged"
+            _rbrep = _rsrv["device"].report()["readbatch"]
+            assert _rbrep["launches"] <= _rbrep["windows"] <= _rbrep["pulls"], \
+                "count guard: at most one export launch per pull window"
+            if n_readers >= 8:
+                # coalescing must actually bite at reader-storm sizes
+                # (a solo reader legitimately gets one window per pull)
+                assert _rbrep["windows"] < _rbrep["pulls"], \
+                    "count guard: windows did not coalesce concurrent pulls"
+            def _pctl(xs, q):
+                xs = sorted(xs)
+                return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+            _dev_ps = _pull_n["device"] / max(_wall["device"], 1e-9)
+            _ora_ps = _pull_n["oracle"] / max(_wall["oracle"], 1e-9)
+            _side = {
+                "readers": n_readers,
+                "docs": R_DOCS,
+                "epochs": R_EPOCHS,
+                "device_pulls_per_sec": round(_dev_ps, 1),
+                "oracle_pulls_per_sec": round(_ora_ps, 1),
+                "oracle_pull_ms_p50": round(_pctl(_lat["oracle"], 0.50) * 1e3, 2),
+                "oracle_pull_ms_p99": round(_pctl(_lat["oracle"], 0.99) * 1e3, 2),
+                "readbatch": _rbrep,
+                "note": (
+                    "N concurrent reader sessions pull per epoch against "
+                    "identically-fed servers; device = batched read plane "
+                    "(window coalescing + shared frames, one selection "
+                    "launch per window), oracle = per-doc host LoroDoc "
+                    "exports under the server lock; pulls/s over the "
+                    "concurrent-pull wall time, arm order interleaved"
+                ),
+            }
+            for a in _arms:
+                _pools[a].shutdown()
+                _rsrv[a].close()
+            bank(
+                "readplane",
+                sync_readers=n_readers,
+                sync_pulls_per_sec=round(_dev_ps, 1),
+                sync_pulls_per_sec_oracle=round(_ora_ps, 1),
+                sync_read_speedup=round(_dev_ps / max(_ora_ps, 1e-9), 2),
+                sync_pull_ms_p50=round(_pctl(_lat["device"], 0.50) * 1e3, 2),
+                sync_pull_ms_p99=round(_pctl(_lat["device"], 0.99) * 1e3, 2),
+                readplane=_side,
+            )
+            note(
+                f"read plane: {n_readers} readers, device {_dev_ps:.0f} "
+                f"pulls/s vs oracle {_ora_ps:.0f} pulls/s "
+                f"({_dev_ps / max(_ora_ps, 1e-9):.2f}x), "
+                f"{_rbrep['windows']} windows / {_rbrep['launches']} launches"
+            )
+        except Exception as e:  # tpulint: disable=LT-EXC(read-plane extra, never the headline)
+            note(f"read-plane phase failed ({type(e).__name__}: {e})")
 
     # ---- phase: sharded resident fleet (BENCH_SHARDS=N, ISSUE 8) ------
     # doc-batch parallelism as the distributed axis: the same serving-

@@ -16,10 +16,18 @@ purpose; add them the day they stop being leaves.
 
 The order, with the paths that establish each edge:
 
-- ``sync.server``      — SyncServer session/oracle lock; strictly a
-  root: _commit_batch submits to the pipeline BEFORE taking it, and
-  epoch subscribers are lock-free by contract, so nothing below ever
-  acquires it.
+- ``sync.server``      — SyncServer session/oracle lock; a root for
+  everything below: _commit_batch submits to the pipeline BEFORE
+  taking it and epoch subscribers are lock-free by contract.  The
+  read batcher's degraded-window fallback acquires it from a bare
+  worker (queue and plane locks RELEASED), so nothing below ever
+  holds while acquiring it.
+- ``sync.readbatch``   — ReadBatcher pull queue/cv (sync/readbatch.
+  py); sessions submit under ``sync.server`` (server→readbatch), the
+  window worker drains it then RELEASES before touching the plane.
+- ``sync.readplane``   — read-plane index + changelog; the commit
+  path feeds it under ``sync.server`` (server→readplane), the window
+  worker holds it across the selection launch (readplane→fleet.dev).
 - ``fanin.queue``      — FanIn intake; the drain worker runs the
   commit callback with it RELEASED, so it orders before everything the
   callback touches.
@@ -49,6 +57,8 @@ from typing import Dict, Iterable, List, Sequence, Set, Tuple
 
 LEVELS: Dict[str, int] = {
     "sync.server": 10,
+    "sync.readbatch": 14,
+    "sync.readplane": 16,
     "fanin.queue": 20,
     "sharded.route": 30,
     "sharded.collect": 40,

@@ -70,6 +70,26 @@ class EncodeMode(Enum):
     StateOnly = 7
 
 
+def frame_columnar_updates(changes) -> bytes:
+    """Frame an export-ordered change list as the columnar-updates wire
+    envelope — the exact bytes ``export(ExportMode.Updates)`` ships.
+    Module-level so the sync read plane (``sync/readbatch.py``) frames
+    device-selected changes through the SAME code path the per-doc
+    oracle uses: byte-identity by construction, not by parallel
+    implementation."""
+    from .codec import binary as bcodec
+
+    payload = bcodec.encode_changes(changes)
+    crc = zlib.crc32(payload)
+    mode = EncodeMode.ColumnarUpdates
+    return (
+        MAGIC
+        + bytes([_min_version_for_mode(mode), mode.value])
+        + crc.to_bytes(4, "little")
+        + payload
+    )
+
+
 class ExportMode:
     """reference: encoding.rs ExportMode."""
 
@@ -466,7 +486,9 @@ class LoroDoc:
     def _encode_changes(
         self, changes: List[Change], mode: EncodeMode, start_vv: Optional[VersionVector] = None
     ) -> bytes:
-        if mode in (EncodeMode.ColumnarUpdates, EncodeMode.ColumnarSnapshot):
+        if mode is EncodeMode.ColumnarUpdates:
+            return frame_columnar_updates(changes)
+        if mode is EncodeMode.ColumnarSnapshot:
             from .codec import binary as bcodec
 
             payload = bcodec.encode_changes(changes)

@@ -347,23 +347,7 @@ class OpLog:
         return self.commit_import(self.plan_import(changes))
 
     def _trim_known_prefix(self, ch: Change, known_end: Counter) -> Change:
-        ops: List[Op] = []
-        for op in ch.ops:
-            if op.ctr_end <= known_end:
-                continue
-            if op.counter < known_end:
-                assert isinstance(op.content, SeqInsert)
-                op = _slice_run(op, known_end)
-            ops.append(op)
-        off = known_end - ch.ctr_start
-        return Change(
-            id=ID(ch.peer, known_end),
-            lamport=ch.lamport + off,
-            deps=Frontiers([ID(ch.peer, known_end - 1)]),
-            ops=ops,
-            timestamp=ch.timestamp,
-            message=ch.message,
-        )
+        return trim_known_prefix(ch, known_end)
 
     def _insert_change(self, ch: Change) -> None:
         self._hydrate_peer(ch.peer)
@@ -486,6 +470,32 @@ class OpLog:
             "dag_nodes": self.dag.total_changes(),
             "pending": len(self.pending),
         }
+
+
+def trim_known_prefix(ch: Change, known_end: Counter) -> Change:
+    """The one known-prefix trim rule: drop ops at/below ``known_end``,
+    slice the straddling run, and rewrite id/lamport/deps to the trim
+    point.  Shared by remote import (``plan_import``), ranged export
+    (``changes_since``/``changes_between``) and the sync read plane
+    (``ops/export_batch.py``) — the byte-identity of batched device
+    pulls rests on all three trimming identically."""
+    ops: List[Op] = []
+    for op in ch.ops:
+        if op.ctr_end <= known_end:
+            continue
+        if op.counter < known_end:
+            assert isinstance(op.content, SeqInsert)
+            op = _slice_run(op, known_end)
+        ops.append(op)
+    off = known_end - ch.ctr_start
+    return Change(
+        id=ID(ch.peer, known_end),
+        lamport=ch.lamport + off,
+        deps=Frontiers([ID(ch.peer, known_end - 1)]),
+        ops=ops,
+        timestamp=ch.timestamp,
+        message=ch.message,
+    )
 
 
 def _slice_change_end(ch: Change, end: Counter) -> Change:

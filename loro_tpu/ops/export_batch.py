@@ -1,0 +1,310 @@
+"""Batched delta-export selection: the device half of the read plane.
+
+The sync pull path serves "updates since the client frontier" — the
+eg-walker retreat/advance reconstruction (PAPERS.md) — and the repo's
+write path already batches, pipelines and tiers ingest across the doc
+axis.  This module gives READS the same shape: one vmapped launch
+answers a whole window of ``(doc, frontier)`` pull requests at once.
+
+The device holds a **change-span index**: one row per stored change,
+in oracle import order, carrying exactly the columns row selection
+needs — the u64 peer halves (the ``SeqColumnsU`` convention: sibling
+and export order never needs a batch-wide peer dictionary), the
+counter span ``[ctr_start, ctr_end)`` and the lamport stamp.  A pull
+request is a frontier table; the kernel computes, per request,
+
+- the beyond-frontier mask: a row is selected iff ``ctr_end >
+  frontier[peer]`` (absent peers read 0 — exactly
+  ``OpLog.changes_since``'s per-peer trim bound);
+- the oracle's export order ``(lamport, peer, ctr_start)`` — with the
+  straddle correction: a change half-known to the client exports
+  TRIMMED, so its sort key uses ``lamport + (frontier_ctr -
+  ctr_start)`` and ``max(ctr_start, frontier_ctr)``, matching the
+  ``trim_known_prefix`` rewrite byte-for-byte;
+- the compact gather: selected row indices first, in export order.
+
+Framing back into the columnar-updates envelope stays on the host
+(``sync/readbatch.py``): the index keeps the stored ``Change`` objects
+per doc, and the wire bytes carry values/deps/timestamps the device
+columns never see.  The host-side contract that makes the bytes
+identical: ``note_changes`` applies the same known-prefix trim the
+oracle's ``plan_import`` applies, so index rows ARE the oracle's
+stored changes.
+
+Shapes bucket-pad (``pad_bucket``) on all three axes — row capacity,
+requests per window, frontier width — so the jit cache stays a handful
+of entries however traffic fluctuates.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.version import VersionVector
+from ..obs import metrics as obs
+from .fugue_batch import pad_bucket
+
+_U32 = np.uint32
+_MASK32 = (1 << 32) - 1
+
+
+def _split_peer(peer: int) -> Tuple[int, int]:
+    return (peer >> 32) & _MASK32, peer & _MASK32
+
+
+def _select_fn():
+    """Build (once) the jitted batched selection kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(doc, f_hi, f_lo, f_ctr, f_n, hi, lo, cs, ce, lam, n_rows):
+        # gather this request's doc rows (the only cross-request axis)
+        dhi, dlo = hi[doc], lo[doc]
+        dcs, dce, dlam = cs[doc], ce[doc], lam[doc]
+        n = n_rows[doc]
+        cap = dhi.shape[0]
+        rows = jnp.arange(cap, dtype=jnp.int32)
+        valid = rows < n
+        # frontier counter per row: match the row peer over the
+        # request's (padded) frontier table; absent peers read 0
+        fi = jnp.arange(f_hi.shape[0], dtype=jnp.int32)
+        m = (
+            (dhi[:, None] == f_hi[None, :])
+            & (dlo[:, None] == f_lo[None, :])
+            & (fi[None, :] < f_n)
+        )
+        fctr = jnp.max(jnp.where(m, f_ctr[None, :], 0), axis=1)
+        sel = valid & (dce > fctr)
+        # straddle-corrected export keys (trim_known_prefix semantics)
+        off = jnp.maximum(0, fctr - dcs)
+        eff_lam = dlam + off
+        eff_cs = jnp.maximum(dcs, fctr)
+        unsel = (~sel).astype(jnp.int32)  # selected rows sort first
+        srt = jax.lax.sort(
+            (unsel, eff_lam, dhi, dlo, eff_cs, rows), num_keys=5
+        )
+        return srt[-1], jnp.sum(sel.astype(jnp.int32))
+
+    batched = jax.vmap(one, in_axes=(0, 0, 0, 0, 0) + (None,) * 6)
+    return jax.jit(batched)
+
+
+_SELECT = None
+
+
+def select_since_batch(doc, f_hi, f_lo, f_ctr, f_n,
+                       hi, lo, cs, ce, lam, n_rows):
+    """One launch: per-request beyond-frontier row selection in export
+    order.  Returns ``(order i32[R, cap], count i32[R])`` — the first
+    ``count[r]`` entries of ``order[r]`` are the selected row indices
+    of doc ``doc[r]`` in oracle export order."""
+    global _SELECT
+    if _SELECT is None:
+        _SELECT = _select_fn()
+    return _SELECT(doc, f_hi, f_lo, f_ctr, f_n, hi, lo, cs, ce, lam, n_rows)
+
+
+class ExportIndex:
+    """Host-fed, device-resident change-span index over one doc fleet.
+
+    Feed (``note_changes``) mirrors the oracle's import rule exactly:
+    fully-known spans drop, straddles trim (``trim_known_prefix``), the
+    per-doc head VV advances — so row ``i`` of doc ``di`` IS the
+    oracle's ``i``-th stored change and the device selection reproduces
+    ``OpLog.changes_since`` row-for-row.  The stored ``Change`` objects
+    ride along per doc for host framing.
+
+    The device copy syncs lazily: appends land in numpy staging arrays
+    and ship right before the next ``select`` launch — the full grid
+    on first sync / capacity grow, a dirty-doc scatter delta otherwise
+    (the grid re-pads through ``pad_bucket`` so capacity growth costs
+    a bounded number of recompiles).  ``floor_vv`` is each doc's index
+    birth frontier: a pull whose client frontier does not dominate it
+    needs history the index never saw and must stay on the oracle
+    path (docs/SYNC.md "Read plane").
+
+    Retention: the index (host ``Change`` lists + device rows) keeps
+    every change since its floor — the same unbounded-history posture
+    as the oracle ``LoroDoc`` mirrors it shadows, because ANY client
+    frontier at/above the floor must stay servable.  Pruning rows
+    under the resident ack floors (advancing ``floor_vvs`` so trimmed
+    frontiers re-route to the oracle) is the documented follow-up for
+    long-lived durable servers (docs/SYNC.md "Read plane").
+
+    Thread contract: the OWNER serializes calls (the read plane takes
+    ``sync.readplane`` around every entry); this class has no lock of
+    its own.
+    """
+
+    def __init__(self, n_docs: int, family: str = "",
+                 floor_vvs: Optional[Sequence[VersionVector]] = None,
+                 capacity: int = 256):
+        self.n_docs = int(n_docs)
+        self.family = family
+        cap = pad_bucket(max(1, int(capacity)))
+        self._cap = cap
+        self._hi = np.zeros((n_docs, cap), _U32)
+        self._lo = np.zeros((n_docs, cap), _U32)
+        self._cs = np.zeros((n_docs, cap), np.int32)
+        self._ce = np.zeros((n_docs, cap), np.int32)
+        self._lam = np.zeros((n_docs, cap), np.int32)
+        self._n = np.zeros((n_docs,), np.int32)
+        self.changes: List[List] = [[] for _ in range(n_docs)]
+        self.head_vvs: List[VersionVector] = [
+            VersionVector() for _ in range(n_docs)
+        ]
+        self.floor_vvs: List[VersionVector] = [
+            (floor_vvs[i].copy() if floor_vvs is not None else VersionVector())
+            for i in range(n_docs)
+        ]
+        for i in range(n_docs):
+            self.head_vvs[i].merge(self.floor_vvs[i])
+        self._dev = None          # device tuple, or None before first sync
+        # docs whose host rows moved past the device copy; None means
+        # the whole grid must re-upload (first sync / capacity grow)
+        self._dirty_docs: Optional[set] = None
+        self.launches = 0         # count guard: one per select() call
+        self.rows_fed = 0
+
+    # -- feed (owner holds the read-plane lock) ------------------------
+    def note_changes(self, di: int, chs: Sequence) -> None:
+        """Append one committed round's changes for doc ``di`` with the
+        oracle's dedup/trim rule.  Known-decodable, gate-passed changes
+        only (the sync commit path hands us exactly those)."""
+        from ..oplog.oplog import trim_known_prefix
+
+        vv = self.head_vvs[di]
+        for ch in chs:
+            known = vv.get(ch.peer)
+            if ch.ctr_end <= known:
+                continue  # fully known: the oracle dropped it too
+            if ch.ctr_start < known:
+                ch = trim_known_prefix(ch, known)
+            self._append_row(di, ch)
+            vv.set_end(ch.peer, max(vv.get(ch.peer), ch.ctr_end))
+
+    def _append_row(self, di: int, ch) -> None:
+        n = int(self._n[di])
+        if n >= self._cap:
+            self._grow()
+        hi, lo = _split_peer(ch.peer)
+        self._hi[di, n] = hi
+        self._lo[di, n] = lo
+        self._cs[di, n] = ch.ctr_start
+        self._ce[di, n] = ch.ctr_end
+        self._lam[di, n] = ch.lamport
+        self._n[di] = n + 1
+        self.changes[di].append(ch)
+        if self._dirty_docs is not None:
+            self._dirty_docs.add(di)
+        self.rows_fed += 1
+
+    def _grow(self) -> None:
+        new_cap = pad_bucket(self._cap * 2)
+        for name in ("_hi", "_lo", "_cs", "_ce", "_lam"):
+            old = getattr(self, name)
+            fresh = np.zeros((self.n_docs, new_cap), old.dtype)
+            fresh[:, : self._cap] = old
+            setattr(self, name, fresh)
+        self._cap = new_cap
+        self._dev = None
+        self._dirty_docs = None  # shape changed: full re-upload
+
+    def head_vv(self, di: int) -> VersionVector:
+        return self.head_vvs[di].copy()
+
+    def covers(self, di: int, from_vv: VersionVector) -> bool:
+        """Whether a pull from ``from_vv`` is servable off the index:
+        the client must already hold everything below the index floor
+        (else the delta needs pre-index history only the oracle has)."""
+        return self.floor_vvs[di] <= from_vv
+
+    # -- device sync + selection ---------------------------------------
+    def _device_cols(self):
+        """Lazy device sync.  First sync (and every capacity grow)
+        uploads the whole grid; steady-state commits re-ship only the
+        DIRTY doc rows as one scatter-update per column — a window
+        after K docs committed pays O(K x cap), not O(n_docs x cap)
+        (the full grid would be a whole-HBM transfer per read window
+        on a real chip)."""
+        import jax.numpy as jnp
+
+        if self._dev is not None and not self._dirty_docs:
+            return self._dev
+        if self._dev is None or self._dirty_docs is None:
+            self._dev = tuple(
+                jnp.asarray(a)
+                for a in (self._hi, self._lo, self._cs, self._ce, self._lam,
+                          self._n)
+            )
+            kind = "full"
+        else:
+            docs = sorted(self._dirty_docs)
+            # pad the dirty-doc list (repeat the first index — the
+            # scatter is idempotent) so the update shapes bucket
+            idx = np.asarray(docs, np.int32)
+            pad = pad_bucket(len(docs), floor=8)
+            idx = np.concatenate([idx, np.full(pad - len(docs), idx[0],
+                                               np.int32)])
+            hosts = (self._hi, self._lo, self._cs, self._ce, self._lam)
+            self._dev = tuple(
+                dev.at[idx].set(host[idx])
+                for dev, host in zip(self._dev[:5], hosts)
+            ) + (jnp.asarray(self._n),)
+            kind = "delta"
+        self._dirty_docs = set()
+        obs.counter(
+            "readbatch.index_uploads_total",
+            "change-span index uploads to device (full grid or "
+            "dirty-doc delta scatter)",
+        ).inc(family=self.family, kind=kind)
+        return self._dev
+
+    def select(self, requests: Sequence[Tuple[int, VersionVector]]
+               ) -> List[np.ndarray]:
+        """ONE launch for the whole window: per request ``(di,
+        from_vv)``, the beyond-frontier row indices of doc ``di`` in
+        oracle export order.  The caller frames them into wire bytes
+        host-side."""
+        import jax.numpy as jnp
+
+        cols = self._device_cols()
+        r_pad = pad_bucket(max(1, len(requests)), floor=8)
+        f_pad = pad_bucket(
+            max(1, max((len(vv) for _di, vv in requests), default=1)),
+            floor=4,
+        )
+        doc = np.zeros((r_pad,), np.int32)
+        f_hi = np.zeros((r_pad, f_pad), _U32)
+        f_lo = np.zeros((r_pad, f_pad), _U32)
+        f_ctr = np.zeros((r_pad, f_pad), np.int32)
+        f_n = np.zeros((r_pad,), np.int32)
+        for r, (di, vv) in enumerate(requests):
+            doc[r] = di
+            for j, (peer, ctr) in enumerate(vv.items()):
+                f_hi[r, j], f_lo[r, j] = _split_peer(peer)
+                f_ctr[r, j] = ctr
+            f_n[r] = len(vv)
+        order, count = select_since_batch(
+            jnp.asarray(doc), jnp.asarray(f_hi), jnp.asarray(f_lo),
+            jnp.asarray(f_ctr), jnp.asarray(f_n), *cols,
+        )
+        self.launches += 1
+        obs.counter(
+            "readbatch.export_launches_total",
+            "batched delta-export selection launches (one per window)",
+        ).inc(family=self.family)
+        order = np.asarray(order)  # fetch drains the launch
+        count = np.asarray(count)
+        return [
+            order[r, : int(count[r])] for r in range(len(requests))
+        ]
+
+    def report(self) -> Dict[str, int]:
+        return {
+            "rows": int(self._n.sum()),
+            "capacity": self._cap,
+            "launches": self.launches,
+            "rows_fed": self.rows_fed,
+        }

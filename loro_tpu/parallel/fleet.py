@@ -97,6 +97,30 @@ def _host_degrade(family: str, docs_changes, cid=None):
     return hostpath.host_merge_changes(family, docs_changes, cid)
 
 
+def _batch_export_select(batch, family: str, index, requests, sup=None):
+    """Shared read-plane selection entry (docs/SYNC.md "Read plane"):
+    ONE supervised launch answers a window of ``(doc, frontier)`` pull
+    requests against the change-span index (ops/export_batch.py).
+    Runs under the batch device lock — selection never mutates batch
+    state, but the supervisor's drain fetch must not interleave with a
+    buffer-donating grow/evict on the same device queue.  The
+    ``export_launch`` fault site fires inside the supervised thunk, so
+    an armed failure classifies exactly like a real device error
+    (DeviceFailure -> the read batcher degrades that window to the
+    oracle)."""
+    from ..resilience import faultinject
+
+    sup = sup if sup is not None else get_supervisor()
+
+    def thunk():
+        faultinject.check("export_launch")
+        return index.select(requests)
+
+    with batch._dev_lock:
+        # selection is a pure read of the index grid: retry-safe
+        return sup.launch(thunk, label=f"fleet.export.{family}")
+
+
 def _empty_seq_np(n: int):
     """All-invalid numpy SeqColumns of n rows (doc-axis padding filler)."""
     import numpy as _np
@@ -1895,6 +1919,11 @@ class DeviceDocBatch:
             return
         self._device_mark_deleted(d_all, r_all)
 
+    def export_select(self, index, requests, sup=None):
+        """Batched read-plane selection for the sync pull path: one
+        launch per request window (see ``_batch_export_select``)."""
+        return _batch_export_select(self, "seq", index, requests, sup)
+
     def resolve_row(self, doc: int, peer: int, counter: int) -> Optional[int]:
         return self.id2row[doc].get((peer, counter))
 
@@ -2555,6 +2584,12 @@ class DeviceMapBatch:
                 self.s, value=put(val),
             )
 
+    def export_select(self, index, requests, sup=None):
+        """Batched read-plane selection for the sync pull path (the
+        LWW fold holds no op history — delta framing rides the
+        change-span index, like every family)."""
+        return _batch_export_select(self, "map", index, requests, sup)
+
     def value_maps(self) -> List[Dict[Tuple[ContainerID, str], object]]:
         """Materialize {(container, key): value} per doc.  Keys carry
         the container id so the same key name in two map containers of
@@ -3185,6 +3220,10 @@ class DeviceTreeBatch:
                 **{f: jax.device_put(v, sh) for f, v in host.items()}
             )
         return reclaimed
+
+    def export_select(self, index, requests, sup=None):
+        """Batched read-plane selection for the sync pull path."""
+        return _batch_export_select(self, "tree", index, requests, sup)
 
     def parent_maps(self) -> List[dict]:
         """{TreeID: parent TreeID | None} of alive nodes per doc (one
@@ -4166,6 +4205,10 @@ class DeviceMovableBatch:
             ) from None
         return batch
 
+    def export_select(self, index, requests, sup=None):
+        """Batched read-plane selection for the sync pull path."""
+        return _batch_export_select(self, "movable", index, requests, sup)
+
     def value_lists(self) -> List[list]:
         """Materialize every doc's ordered element values (one launch;
         same contract as Fleet.merge_movable_changes per doc)."""
@@ -4433,6 +4476,12 @@ class DeviceCounterBatch:
             self.sums = _fold_counter_rows(
                 self.sums, jax.device_put(slot, sh), jax.device_put(delta, sh)
             )
+
+    def export_select(self, index, requests, sup=None):
+        """Batched read-plane selection for the sync pull path (the
+        counter fold keeps no per-op rows — the change-span index is
+        the only delta history, same as map)."""
+        return _batch_export_select(self, "counter", index, requests, sup)
 
     def value_maps(self) -> List[Dict[ContainerID, float]]:
         sums = np.asarray(self.sums)

@@ -797,6 +797,21 @@ class TieredBatch:
             return 0
         return self.inner.compact(floors)
 
+    # -- read plane (docs/SYNC.md) --------------------------------------
+    def export_select(self, index, requests, sup=None):
+        """Batched read-plane selection over the TIERED fleet: the
+        change-span index is tier-blind (fed from the sync commit
+        path, never from device rows), so a batched pull serves warm
+        and cold docs without touching tier state — NO revive, no slot
+        landing, no mirror build.  The launch still routes through the
+        inner hot-set batch's device lock/supervisor (the one device
+        queue)."""
+        from .fleet import _batch_export_select
+
+        return _batch_export_select(
+            self.inner, self.family, index, requests, sup
+        )
+
     # -- reads (hot from device, warm/cold from mirrors) ----------------
     _EMPTY_READS = {
         "texts": "", "richtexts": [], "values": [], "value_lists": [],

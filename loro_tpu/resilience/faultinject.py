@@ -25,6 +25,15 @@ instrumented choke points of the device pipeline:
                      (typed PushRejected / poison-ticket paths)
 - ``sync_pull``    — sync.Session.pull: raise/delay before the delta
                      export (client-visible read-path failures)
+- ``read_batch``   — sync.ReadBatcher window worker: fires before any
+                     device work on a drained pull window — the whole
+                     window degrades to per-doc oracle pulls (typed,
+                     counted, invisible to sessions)
+- ``export_launch``— the batched delta-export selection launch (fleet
+                     export_select thunk, inside the supervisor): a
+                     transient UNAVAILABLE retries like any launch, a
+                     terminal error becomes DeviceFailure and degrades
+                     ONLY that window to the oracle
 - ``session_stall``— sync fan-out delivery: delay one session's
                      notification slot (slow-consumer backpressure and
                      the soak's stalled-session churn)

@@ -7,12 +7,18 @@ ingest rounds with backpressure (``fanin.FanIn``), fan-out of committed
 epochs as delta notifications, and an ephemeral presence plane
 (``presence.PresencePlane`` over ``loro_tpu.awareness``).
 
+Reads ride the batched device read plane by default
+(``readbatch.ReadBatcher`` — concurrent ``Session.pull``s coalesce
+into one vmapped export launch, byte-identical to the oracle export;
+``read_batch=False`` keeps every pull on the per-doc oracle).
+
 Typed errors live in ``loro_tpu.errors``: ``SyncError``,
 ``PushRejected``, ``StaleFrontier``, ``SessionClosed``.
 """
 from ..errors import PushRejected, SessionClosed, StaleFrontier, SyncError
 from .fanin import FanIn, PushTicket
 from .presence import PresencePlane
+from .readbatch import PullTicket, ReadBatcher
 from .server import SyncServer
 from .session import Session
 
@@ -21,6 +27,8 @@ __all__ = [
     "Session",
     "FanIn",
     "PushTicket",
+    "PullTicket",
+    "ReadBatcher",
     "PresencePlane",
     "SyncError",
     "PushRejected",

@@ -43,7 +43,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..errors import DecodeError, PushRejected, SyncError
+from ..errors import DecodeError, PushRejected, StaleFrontier, SyncError
 from ..analysis.lockwitness import named_rlock
 from ..obs import metrics as obs
 from ..resilience import faultinject
@@ -80,7 +80,8 @@ class SyncServer:
                  n_docs: Optional[int] = None, mesh=None, cid=None,
                  resident=None, pipeline: bool = True, coalesce: int = 8,
                  depth: int = 2, max_queue: int = 64,
-                 session_ttl: float = 30.0, **caps):
+                 session_ttl: float = 30.0, read_batch: bool = True,
+                 **caps):
         if resident is None:
             from ..parallel.server import ResidentServer
 
@@ -113,6 +114,22 @@ class SyncServer:
         # newest epoch the ORACLE reflects (pulls/acks key on this; the
         # resident's own clock may run ahead mid-batch)
         self._committed_epoch = resident.epoch
+        # per-doc oracle head VV, cached per committed epoch (rebuilt
+        # lazily, invalidated per dirty doc in _commit_batch) — the
+        # oracle pull path stops rebuilding from_vv/to_vv objects per
+        # pull, so the host-fallback line in the read A/B is honest
+        self._head_vv: Dict[int, object] = {}
+        # batched device read plane (docs/SYNC.md "Read plane"): pulls
+        # coalesce into one vmapped export launch; the oracle above is
+        # demoted to the differential-fuzz oracle + typed degradation
+        # fallback.  read_batch=False keeps every pull on the oracle
+        # (the bench A/B's host line).
+        if read_batch:
+            from .readbatch import ReadBatcher
+
+            self._readbatch = ReadBatcher(self)
+        else:
+            self._readbatch = None
         self._sessions: Dict[str, Session] = {}
         self._next_peer = 1
         self.session_ttl = session_ttl
@@ -398,6 +415,13 @@ class SyncServer:
                             "(client protocol violation)",
                         ).inc(family=self.family)
                         continue
+                    # read plane: feed the device change-span index the
+                    # SAME accepted changes, BEFORE the committed-epoch
+                    # bump below — the window worker's epoch snapshot
+                    # relies on feed-then-bump (readbatch._process_device)
+                    self._head_vv.pop(di, None)
+                    if self._readbatch is not None:
+                        self._readbatch.plane.note_changes(di, chs)
                     # the pusher holds its own ops: advance its pull
                     # frontier past them so pulls don't echo them back
                     if sess is not None and not sess.closed:
@@ -448,8 +472,78 @@ class SyncServer:
     def _ack(self, session: Session, di: int) -> None:
         """Pull-time ack into the resident compaction floors (caller
         holds the lock)."""
+        self._ack_at(session, di, self._committed_epoch)
+
+    def _ack_at(self, session: Session, di: int, epoch: int) -> None:
+        """Ack a specific covered epoch (batched device pulls ack the
+        window's snapshot epoch, which may trail the live committed
+        epoch; resident.ack is monotone either way)."""
         if session._registered:
-            self.resident.ack(di, session.sid, self._committed_epoch)
+            self.resident.ack(di, session.sid, epoch)
+
+    # -- pull serving (oracle path + device routing) --------------------
+    def _oracle_head_vv(self, di: int):
+        """The oracle's head VV for doc ``di`` (cached copy) — caller
+        holds the lock.  Invalidated per dirty doc at commit."""
+        vv = self._head_vv.get(di)
+        if vv is None:
+            vv = self._head_vv[di] = self._oracle.docs[di].oplog_vv()
+        return vv.copy()
+
+    def _oracle_pull(self, di: int, from_vv, to_frontiers):
+        """Serve one pull off the per-doc oracle (caller holds the
+        lock).  Returns ``(data, new_vv, first_sync)``; raises typed
+        ``StaleFrontier`` below a shallow root.  The ONE oracle export
+        rule — Session.pull's host path and the read batcher's
+        degraded-window fallback both route here."""
+        from ..doc import ExportMode
+
+        d = self._oracle.docs[di]
+        first_sync = False
+        if d.is_shallow() and not (d.shallow_since_vv() <= from_vv):
+            if len(from_vv) == 0:
+                # documented first-sync path: full snapshot (the
+                # shallow base rides along; a fresh doc imports it)
+                first_sync = True
+                data = d.export(ExportMode.Snapshot)
+                new_vv = self._oracle_head_vv(di)
+                obs.counter(
+                    "sync.first_sync_snapshots_total",
+                    "pulls served as snapshots (client below the "
+                    "oracle's shallow root)",
+                ).inc(family=self.family)
+            else:
+                raise StaleFrontier(
+                    f"doc {di}: client frontier {from_vv.to_json()} is "
+                    "below the server oracle's shallow root "
+                    f"{d.shallow_since_vv().to_json()} — history there "
+                    "was trimmed; resync from a fresh doc (empty "
+                    "frontier pulls take the first-sync snapshot path)"
+                )
+        elif to_frontiers is not None:
+            to_vv = d.oplog.dag.frontiers_to_vv(to_frontiers)
+            data = d.export(ExportMode.UpdatesInRange(from_vv, to_vv))
+            new_vv = from_vv.copy()
+            for peer, end in to_vv.items():
+                if end > new_vv.get(peer):
+                    new_vv.set_end(peer, end)
+        else:
+            data = d.export(ExportMode.Updates(from_vv))
+            new_vv = self._oracle_head_vv(di)
+        return data, new_vv, first_sync
+
+    def _route_device(self, di: int, from_vv) -> bool:
+        """Whether this pull is batchable onto the device read plane
+        (caller holds the lock).  Oracle-only: bounded pulls (checked
+        by the caller), shallow first-sync / StaleFrontier cases, and
+        frontiers below the index floor — docs/SYNC.md "Read plane"."""
+        rb = self._readbatch
+        if rb is None or rb.closed:
+            return False
+        d = self._oracle.docs[di]
+        if d.is_shallow() and not (d.shallow_since_vv() <= from_vv):
+            return False
+        return rb.plane.covers(di, from_vv)
 
     # -- reads (flush fan-in, then the resident batch) ------------------
     def flush(self) -> None:
@@ -507,6 +601,8 @@ class SyncServer:
             committed_epoch=self._committed_epoch,
             pipeline=self._pipe is not None,
         )
+        if self._readbatch is not None:
+            out["readbatch"] = self._readbatch.report()
         res = getattr(self.resident, "residency", None)
         if res is not None:
             out["residency"] = res.report()
@@ -521,6 +617,12 @@ class SyncServer:
             self._fanin.close()
         except RuntimeError as e:
             err = e
+        if self._readbatch is not None:
+            # after the fan-in drain (late pushes committed) and
+            # WITHOUT the server lock (a degraded window's oracle
+            # fallback needs it): queued pulls serve, then the worker
+            # stops and Session.pull routes oracle-only
+            self._readbatch.close()
         with self._lock:
             self._closed = True
             sessions = list(self._sessions.values())

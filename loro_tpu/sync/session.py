@@ -33,7 +33,7 @@ from collections import deque
 from typing import Dict, Optional
 
 from ..core.version import VersionVector
-from ..errors import SessionClosed, StaleFrontier
+from ..errors import SessionClosed
 from ..obs import metrics as obs
 from ..resilience import faultinject
 
@@ -101,47 +101,69 @@ class Session:
         is empty.  ``to_frontiers`` bounds the delta
         (``ExportMode.UpdatesInRange``) — e.g. replaying up to a known
         stable point; default is everything the server holds.  Advances
-        the client frontier and acks the covered epoch."""
-        from ..doc import ExportMode
+        the client frontier and acks the covered epoch.
 
+        Batchable pulls (unbounded, frontier at/above the read-plane
+        floor, not a shallow first-sync case) coalesce with concurrent
+        pulls into one device export launch through the server's
+        ``ReadBatcher`` — byte-identical to the oracle export, served
+        off the oracle transparently on device failure (docs/SYNC.md
+        "Read plane").  Everything else stays on the per-doc oracle."""
         self._check_open()
         faultinject.check("sync_pull", doc=di)
         srv = self._server
+        tk = hit = None
         with srv._lock:
             self._touch()
-            d = srv._oracle.docs[di]
             from_vv = self._vv.get(di) or VersionVector()
-            first_sync = False
-            if d.is_shallow() and not (d.shallow_since_vv() <= from_vv):
-                if len(from_vv) == 0:
-                    # documented first-sync path: full snapshot (the
-                    # shallow base rides along; a fresh doc imports it)
-                    first_sync = True
-                    data = d.export(ExportMode.Snapshot)
-                    new_vv = d.oplog_vv()
-                    obs.counter(
-                        "sync.first_sync_snapshots_total",
-                        "pulls served as snapshots (client below the "
-                        "oracle's shallow root)",
-                    ).inc(family=srv.family)
-                else:
-                    raise StaleFrontier(
-                        f"doc {di}: client frontier {from_vv.to_json()} is "
-                        "below the server oracle's shallow root "
-                        f"{d.shallow_since_vv().to_json()} — history there "
-                        "was trimmed; resync from a fresh doc (empty "
-                        "frontier pulls take the first-sync snapshot path)"
-                    )
-            elif to_frontiers is not None:
-                to_vv = d.oplog.dag.frontiers_to_vv(to_frontiers)
-                data = d.export(ExportMode.UpdatesInRange(from_vv, to_vv))
-                new_vv = from_vv.copy()
-                for peer, end in to_vv.items():
-                    if end > new_vv.get(peer):
-                        new_vv.set_end(peer, end)
-            else:
-                data = d.export(ExportMode.Updates(from_vv))
-                new_vv = d.oplog_vv()
+            if to_frontiers is None and srv._route_device(di, from_vv):
+                # inline fast path first: a frame already cut at this
+                # (doc, frontier) since the doc's last commit serves
+                # without a window round-trip (the reader fan-out case)
+                hit = srv._readbatch.try_cached(di, from_vv)
+                if hit is None:
+                    from ..errors import SyncError
+
+                    try:
+                        # enqueue under the lock (frontier snapshot is
+                        # atomic with the routing decision); the window
+                        # drive runs OUTSIDE it
+                        tk = srv._readbatch.submit(di, from_vv.copy())
+                    except SyncError:
+                        tk = None  # closed under us: oracle path below
+        if tk is not None or hit is not None:
+            data, new_vv, epoch = (
+                hit if hit is not None else srv._readbatch.drive(tk)
+            )
+            with srv._lock:
+                self._touch()
+                cur = self._vv.get(di)
+                if cur is not None:
+                    # never regress: a push of ours may have committed
+                    # (and advanced the frontier) while the window ran
+                    new_vv.merge(cur)
+                self._vv[di] = new_vv
+                # the window covers `epoch`; a commit landing after its
+                # snapshot re-marked the doc — keep that flag alive
+                if self._dirty.get(di, -1) <= epoch:
+                    self._dirty.pop(di, None)
+                srv._ack_at(self, di, epoch)
+            obs.counter("sync.pulls_total").inc(family=srv.family, kind="delta")
+            obs.counter(
+                "sync.pulls_batched_total",
+                "pulls served by the batched device read plane",
+            ).inc(family=srv.family)
+            obs.histogram(
+                "sync.pull_bytes", "bytes served per pull",
+                buckets=(64, 256, 1024, 4096, 16384, 65536, 262144, 1048576),
+            ).observe(len(data), family=srv.family)
+            return data
+        with srv._lock:
+            self._touch()
+            from_vv = self._vv.get(di) or VersionVector()
+            data, new_vv, first_sync = srv._oracle_pull(
+                di, from_vv, to_frontiers
+            )
             self._vv[di] = new_vv
             if to_frontiers is None:
                 self._dirty.pop(di, None)

@@ -22,7 +22,13 @@ plane) through many epochs of session churn:
   commit), checkpoints mid-run, and after the final epoch reopens
   every family via persist.recover_server + SyncServer.over: a fresh
   session's first pull must take the shallow first-sync snapshot path
-  and still match the host oracle.
+  and still match the host oracle;
+- SOAK_SYNC_DEVPULL=1 gates the batched device read plane per pull:
+  every session pull across all five family servers is compared
+  byte-for-byte against the oracle's own ExportMode.Updates export
+  (the ISSUE 11 differential contract under churn), and the run
+  asserts the device path actually served (readbatch windows > 0,
+  launches == windows).
 """
 import os
 import os.path as _p
@@ -46,6 +52,7 @@ DOCS = int(os.environ.get("SOAK_SYNC_DOCS", "3"))
 EPOCHS = int(os.environ.get("SOAK_SYNC_EPOCHS", "8"))
 SEED = int(os.environ.get("SOAK_SYNC_SEED", "0"))
 DURABLE = os.environ.get("SOAK_SYNC_DURABLE", "0") == "1"
+DEVPULL = os.environ.get("SOAK_SYNC_DEVPULL", "0") == "1"
 
 FAMILIES = ("text", "map", "tree", "counter", "movable")
 CAPS = {
@@ -159,6 +166,23 @@ class Client:
             tickets.append(self.sess[fam].push(self.di, payload))
 
     def pull(self):
+        if DEVPULL:
+            # differential gate per pull: the served bytes must equal
+            # the oracle's own Updates export from this frontier
+            from loro_tpu.doc import ExportMode
+
+            for fam in FAMILIES:
+                sess = self.sess[fam]
+                want = servers[fam].oracle_doc(self.di).export(
+                    ExportMode.Updates(sess.frontier(self.di))
+                )
+                got = sess.pull(self.di)
+                assert got == want, \
+                    f"devpull {fam} doc {self.di}: bytes diverged from oracle"
+                if fam == "text":
+                    self.doc.import_(got)
+            self.mark = self.doc.oplog_vv()
+            return
         self.doc.import_(self.sess["text"].pull(self.di))
         self.mark = self.doc.oplog_vv()
         # ack the other planes too (floors advance on every family)
@@ -246,6 +270,19 @@ for epoch in range(EPOCHS):
 for cl in clients:
     cl.pull()
 _gate("final", clients)
+
+if DEVPULL:
+    # the device read plane must actually have served (not silently
+    # fallen back): windows ran, one launch per window, no degradation
+    for fam, srv in servers.items():
+        rb = srv.report().get("readbatch")
+        assert rb is not None, f"{fam}: read plane not enabled"
+        assert rb["windows"] > 0, f"{fam}: no batched read windows ran"
+        assert 0 < rb["launches"] <= rb["windows"], \
+            f"{fam}: launches {rb['launches']} vs windows {rb['windows']}"
+        assert rb["degraded_windows"] == 0, f"{fam}: degraded windows"
+    print("devpull: all five family servers served byte-identical "
+          "batched device pulls")
 
 if DURABLE:
     import shutil

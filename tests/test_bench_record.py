@@ -108,6 +108,23 @@ def _fat_checkpoint():
               "epochs": 6, "push_to_visible_ms_p50": 47.7,
               "push_to_visible_ms_p99": 952.7, "pull_bytes_mean": 272.1,
               "pulls": 96, "note": "s" * 300},
+        sync_readers=64,
+        sync_pulls_per_sec=5200.0,
+        sync_pulls_per_sec_oracle=1900.0,
+        sync_read_speedup=2.74,
+        sync_pull_ms_p50=3.2,
+        sync_pull_ms_p99=21.5,
+        readplane={"readers": 64, "docs": 4, "epochs": 4,
+                   "device_pulls_per_sec": 5200.0,
+                   "oracle_pulls_per_sec": 1900.0,
+                   "oracle_pull_ms_p50": 8.8, "oracle_pull_ms_p99": 44.1,
+                   "readbatch": {"pulls": 1024, "windows": 18,
+                                 "max_window": 64, "frames": 70,
+                                 "frames_shared": 954,
+                                 "degraded_windows": 0, "degraded_pulls": 0,
+                                 "rows": 800, "capacity": 1024,
+                                 "launches": 18, "rows_fed": 800},
+                   "note": "v" * 300},
         tier_hit_rate=0.91,
         tier_revive_ms_p50=2.1,
         tier_revive_ms_p99=14.7,
@@ -153,6 +170,9 @@ class TestFlagshipLine:
                   "sync_sessions", "sync_pushes_per_sec",
                   "sync_push_to_visible_ms_p50",
                   "sync_push_to_visible_ms_p99",
+                  "sync_readers", "sync_pulls_per_sec",
+                  "sync_pulls_per_sec_oracle", "sync_read_speedup",
+                  "sync_pull_ms_p50", "sync_pull_ms_p99",
                   "shard_count", "shard_scaling_x", "shard_rows_per_sec",
                   "tier_hit_rate", "tier_revive_ms_p50",
                   "tier_revive_ms_p99", "tier_vs_all_hot",
@@ -161,8 +181,8 @@ class TestFlagshipLine:
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
         for k in ("metrics", "resilience", "pipeline", "rank", "sync",
-                  "shard", "tier", "baseline_note", "roofline_note",
-                  "resident_pipeline_note"):
+                  "shard", "tier", "readplane", "baseline_note",
+                  "roofline_note", "resident_pipeline_note"):
             assert k in side, k
             assert k not in back, k
         assert side["sidecars_for"] == back["metric"]

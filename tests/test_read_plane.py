@@ -1,0 +1,454 @@
+"""Batched device read plane (sync/readbatch.py, ops/export_batch.py,
+docs/SYNC.md "Read plane") — the ISSUE 11 differential gate.
+
+The acceptance contract: batched device pulls are BYTE-IDENTICAL to
+host-oracle ``ExportMode.Updates`` exports across all five families —
+including frontiers mid-history (and mid-CHANGE: the trim-straddle
+path), empty deltas, tombstone-heavy docs, and pulls against warm
+tiered docs (which must never force a revive).  Plus the count guard:
+one export launch per coalesced pull window, not one per pull; and the
+fault contract: an injected mid-batch failure degrades ONLY that
+window to per-doc oracle pulls, invisibly to sessions.
+"""
+import random
+import threading
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.core.version import VersionVector
+from loro_tpu.doc import ExportMode
+from loro_tpu.parallel.server import ResidentServer
+from loro_tpu.resilience import faultinject
+from loro_tpu.sync import SyncServer
+
+from test_sync import CAPS, FAMILIES, _cid_of, _edit, _seed_doc
+
+
+def _mk_server(family, n_docs, base, **kw):
+    caps = dict(CAPS[family])
+    caps.update(kw)
+    return SyncServer(family, n_docs, cid=_cid_of(family, base), **caps)
+
+
+def _oracle_updates(srv, di, from_vv):
+    """What the pull MUST return: the oracle's own Updates export."""
+    return srv.oracle_doc(di).export(ExportMode.Updates(from_vv.copy()))
+
+
+class TestDifferentialGate:
+    """Device pulls == oracle ``ExportMode.Updates`` bytes."""
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_family_differential(self, family):
+        rng = random.Random(0xC0FFEE + hash(family) % 1000)
+        n_docs = 2
+        base = [_seed_doc(100 + i, i) for i in range(n_docs)]
+        srv = _mk_server(family, n_docs, base[0])
+        try:
+            # two writers per doc + one pure reader; writer 0 of each
+            # doc boot-pushes the base history (the soak pattern)
+            writers = []
+            boot = []
+            for i in range(n_docs):
+                for w in range(2):
+                    d = LoroDoc(peer=200 + 10 * i + w)
+                    d.import_(base[i].export_snapshot())
+                    s = srv.connect()
+                    s._vv[i] = d.oplog_vv()
+                    if w == 0:
+                        boot.append(s.push(i, d.export_updates({})))
+                    writers.append((i, d, s, {"mark": d.oplog_vv()}))
+            for tk in boot:
+                tk.epoch(60)
+            readers = [srv.connect() for _ in range(n_docs)]
+            for epoch in range(4):
+                tks = []
+                for i, d, s, st in writers:
+                    _edit(d, rng, f"e{epoch}")
+                    tks.append(s.push(i, d.export_updates(st["mark"])))
+                    st["mark"] = d.oplog_vv()
+                for tk in tks:
+                    tk.epoch(60)
+                # mid-history frontiers: every session pulls each epoch,
+                # so frontiers walk the whole history prefix lattice
+                for i, d, s, st in writers:
+                    want = _oracle_updates(srv, i, s.frontier(i))
+                    got = s.pull(i)
+                    assert got == want, (family, epoch, "writer")
+                    d.import_(got)
+                    st["mark"] = d.oplog_vv()
+                for i, r in enumerate(readers):
+                    want = _oracle_updates(srv, i, r.frontier(i))
+                    got = r.pull(i)
+                    assert got == want, (family, epoch, "reader")
+                # empty delta: an immediate re-pull serves the empty
+                # envelope, byte-identical too
+                i, _d, s, _st = writers[0]
+                want = _oracle_updates(srv, i, s.frontier(i))
+                assert s.pull(i) == want
+            rep = srv.report()["readbatch"]
+            assert rep["pulls"] > 0
+            # count guard: at most one selection launch per window
+            # (cache-served windows skip the launch entirely)
+            assert 0 < rep["launches"] <= rep["windows"]
+            assert rep["degraded_windows"] == 0
+        finally:
+            srv.close()
+
+    def test_mid_change_frontier_trims_straddle(self):
+        """A client frontier INSIDE one change's counter span: the
+        device sort key and the host framing must both apply the
+        trim_known_prefix rewrite — bytes equal the oracle's."""
+        d = LoroDoc(peer=7)
+        d.get_text("t").insert(0, "0123456789")  # one 10-counter change
+        d.commit()
+        srv = SyncServer("text", 1, cid=d.get_text("t").id, capacity=1 << 10)
+        try:
+            s = srv.connect()
+            s.push(0, d.export_updates({})).epoch(60)
+            r = srv.connect()
+            r._vv[0] = VersionVector({7: 3})  # mid-span
+            want = _oracle_updates(srv, 0, r.frontier(0))
+            got = r.pull(0)
+            assert got == want
+            c = LoroDoc(peer=9)
+            c.import_(d.export(ExportMode.UpdatesInRange(
+                VersionVector(), VersionVector({7: 3}))))
+            c.import_(got)
+            assert c.get_text("t").to_string() == "0123456789"
+            assert srv.report()["readbatch"]["pulls"] == 1
+        finally:
+            srv.close()
+
+    def test_tombstone_heavy(self):
+        """Docs where most rows are deleted: deletes ship as ops in the
+        delta exactly like the oracle frames them."""
+        rng = random.Random(5)
+        d = LoroDoc(peer=11)
+        t = d.get_text("t")
+        t.insert(0, "x" * 64)
+        d.commit()
+        srv = SyncServer("text", 1, cid=t.id, capacity=1 << 12)
+        try:
+            s = srv.connect()
+            s.push(0, d.export_updates({})).epoch(60)
+            mark = d.oplog_vv()
+            s._vv[0] = d.oplog_vv()
+            r = srv.connect()
+            for _ in range(6):
+                for _ in range(10):
+                    L = len(t)
+                    if L > 2:
+                        t.delete(rng.randrange(L - 1), 1)
+                    else:
+                        t.insert(0, "ab")
+                d.commit()
+                s.push(0, d.export_updates(mark)).epoch(60)
+                mark = d.oplog_vv()
+                want = _oracle_updates(srv, 0, r.frontier(0))
+                assert r.pull(0) == want
+        finally:
+            srv.close()
+
+
+class TestWindowCoalescing:
+    """Count guard: one export launch per coalesced pull window."""
+
+    @pytest.mark.faultinject
+    def test_concurrent_pulls_coalesce_one_launch(self):
+        base = _seed_doc(50, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            w = srv.connect()
+            w.push(0, base.export_updates({})).epoch(60)
+            readers = [srv.connect() for _ in range(16)]
+            # hold the FIRST window open so every concurrent pull lands
+            # in the queue and drains as one coalesced second window
+            faultinject.inject("read_batch", action="delay",
+                               delay_s=0.3, times=1)
+            try:
+                want = _oracle_updates(srv, 0, VersionVector())
+                outs = [None] * len(readers)
+
+                def go(k):
+                    outs[k] = readers[k].pull(0)
+
+                ts = [threading.Thread(target=go, args=(k,))
+                      for k in range(len(readers))]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(60)
+                assert all(o == want for o in outs)
+            finally:
+                faultinject.clear()
+            rep = srv.report()["readbatch"]
+            assert rep["pulls"] == 16
+            # the guard: launches far under pulls — at most one per
+            # window — and the identical (doc, frontier) requests
+            # framed ONCE, shared in-window or off the frame cache
+            assert rep["launches"] <= rep["windows"] < rep["pulls"]
+            assert rep["frames"] == 1
+            assert (rep["frames"] + rep["frames_shared"]
+                    + rep["cache_hits"] == rep["pulls"])
+        finally:
+            srv.close()
+
+    def test_bounded_pull_stays_oracle(self):
+        base = _seed_doc(51, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            s = srv.connect()
+            s.push(0, base.export_updates({})).epoch(60)
+            r = srv.connect()
+            f = srv.oracle_doc(0).oplog_frontiers()
+            r.pull(0, to_frontiers=f)  # UpdatesInRange: oracle-only
+            assert srv.report()["readbatch"]["pulls"] == 0
+            r.pull(0)
+            assert srv.report()["readbatch"]["pulls"] == 1
+        finally:
+            srv.close()
+
+    def test_below_floor_routes_oracle_then_device(self):
+        """History ingested BEFORE the SyncServer existed sits below
+        the index floor: an empty-frontier pull must serve off the
+        oracle; once the client crosses the floor its next pull rides
+        the device."""
+        from loro_tpu.doc import strip_envelope
+
+        base = _seed_doc(52, 0)
+        res = ResidentServer("text", 1, **CAPS["text"])
+        res.ingest([strip_envelope(bytes(base.export_updates({})))],
+                   base.get_text("t").id)
+        srv = SyncServer.over(res, cid=base.get_text("t").id)
+        try:
+            r = srv.connect()
+            want = _oracle_updates(srv, 0, VersionVector())
+            got = r.pull(0)  # below floor -> oracle path
+            assert got == want
+            assert srv.report()["readbatch"]["pulls"] == 0
+            # the client is now AT the floor: push a new edit, and the
+            # catch-up pull rides the device
+            d = LoroDoc(peer=77)
+            d.import_(got)
+            mark = d.oplog_vv()
+            d.get_text("t").insert(0, "more")
+            d.commit()
+            r.push(0, d.export_updates(mark)).epoch(60)
+            want = _oracle_updates(srv, 0, r.frontier(0))
+            assert r.pull(0) == want
+            assert srv.report()["readbatch"]["pulls"] == 1
+        finally:
+            srv.close()
+
+
+class TestTieredReadPlane:
+    def test_warm_docs_pull_without_revive(self):
+        """Pulls against warm (evicted) docs serve off the change-span
+        index: byte-identical AND tier state untouched — a batched
+        pull must never force a revive."""
+        n_docs, hot = 4, 2
+        base = [_seed_doc(60 + i, i) for i in range(n_docs)]
+        srv = SyncServer("text", n_docs, cid=base[0].get_text("t").id,
+                         capacity=1 << 10, hot_slots=hot)
+        try:
+            sessions = []
+            for i in range(n_docs):
+                s = srv.connect()
+                s.push(i, base[i].export_updates({})).epoch(60)
+                sessions.append(s)
+            srv.flush()
+            mgr = srv.resident.residency
+            warm0 = mgr.tiers()["warm"]
+            assert warm0, f"expected evictions at hot_slots={hot}"
+            rep0 = mgr.report()
+            readers = [srv.connect() for _ in range(n_docs)]
+            for di in range(n_docs):
+                want = _oracle_updates(srv, di, VersionVector())
+                assert readers[di].pull(di) == want, di
+            rep1 = mgr.report()
+            # no pull revived/promoted/evicted anything: tier state is
+            # untouched by the read plane
+            assert mgr.tiers()["warm"] == warm0
+            for k in ("promotions", "misses", "evictions", "cold_revives"):
+                assert rep1[k] == rep0[k], k
+            assert srv.report()["readbatch"]["pulls"] == n_docs
+        finally:
+            srv.close()
+
+
+class TestLifecycle:
+    def test_close_drains_abandoned_ticket(self):
+        """Pulls are leader-driven: a ticket whose submitter never
+        drives (killed between submit and drive) has no leader coming.
+        close() must serve it itself instead of hanging SyncServer
+        shutdown."""
+        import time as _time
+
+        base = _seed_doc(95, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            s = srv.connect()
+            s.push(0, base.export_updates({})).epoch(60)
+            tk = srv._readbatch.submit(0, VersionVector())  # never driven
+            t0 = _time.perf_counter()
+        finally:
+            srv.close()
+        assert _time.perf_counter() - t0 < 10.0  # no hang
+        data, _vv, _ep = tk.result(timeout=1.0)  # served at close
+        want = base.export_updates({})
+        got = LoroDoc(peer=96)
+        got.import_(data)
+        assert got.get_text("t").to_string() == \
+            base.get_text("t").to_string()
+        _ = want
+
+
+class TestWitness:
+    def test_read_plane_edges_conform(self):
+        """The read-plane locks nest conformantly under load: the
+        commit path feeds the plane under the server lock
+        (server->readplane), the window leader launches under the
+        plane lock (readplane->fleet.dev), and the witnessed graph
+        stays acyclic."""
+        import threading
+
+        from loro_tpu.analysis import lockorder
+        from loro_tpu.analysis.lockwitness import witness
+
+        w = witness()
+        w.reset()
+        w.enable(strict=False)
+        try:
+            base = _seed_doc(90, 0)
+            srv = _mk_server("text", 2, base)
+            try:
+                s = srv.connect()
+                for di in range(2):
+                    s.push(di, base.export_updates({})).epoch(60)
+                readers = [srv.connect() for _ in range(8)]
+                ths = [
+                    threading.Thread(target=lambda k=k: readers[k].pull(k % 2))
+                    for k in range(8)
+                ]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join(60)
+            finally:
+                srv.close()
+        finally:
+            w.disable()
+        edges = w.edges()
+        assert ("sync.server", "sync.readplane") in edges
+        assert ("sync.readplane", "fleet.dev") in edges
+        assert w.check_declared() == []
+        w.assert_acyclic()
+        assert lockorder.level("sync.readbatch") is not None
+        assert lockorder.level("sync.readplane") is not None
+        w.reset()
+
+
+class TestReadFaults:
+    @pytest.mark.faultinject
+    def test_read_batch_fault_degrades_window_only(self):
+        base = _seed_doc(70, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            w = srv.connect()
+            w.push(0, base.export_updates({})).epoch(60)
+            r = srv.connect()
+            want = _oracle_updates(srv, 0, VersionVector())
+            faultinject.inject(
+                "read_batch",
+                exc_factory=lambda: faultinject.InjectedFault(
+                    "fatal read window"),
+                times=1,
+            )
+            try:
+                got = r.pull(0)  # session never sees the failure
+            finally:
+                faultinject.clear()
+            assert got == want
+            rep = srv.report()["readbatch"]
+            assert rep["degraded_windows"] == 1
+            assert rep["degraded_pulls"] == 1
+            assert rep["launches"] == 0  # the window never launched
+            # the NEXT window rides the device again
+            r2 = srv.connect()
+            assert r2.pull(0) == want
+            rep = srv.report()["readbatch"]
+            assert rep["launches"] == 1
+            assert rep["degraded_windows"] == 1
+        finally:
+            srv.close()
+
+    @pytest.mark.faultinject
+    def test_export_launch_fatal_degrades_window(self):
+        base = _seed_doc(71, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            w = srv.connect()
+            w.push(0, base.export_updates({})).epoch(60)
+            r = srv.connect()
+            want = _oracle_updates(srv, 0, VersionVector())
+            faultinject.inject(
+                "export_launch",
+                exc_factory=lambda: faultinject.InjectedFault(
+                    "fatal export launch"),
+                times=1,
+            )
+            try:
+                assert r.pull(0) == want
+            finally:
+                faultinject.clear()
+            rep = srv.report()["readbatch"]
+            assert rep["degraded_windows"] == 1
+        finally:
+            srv.close()
+
+    @pytest.mark.faultinject
+    def test_export_launch_transient_retries_through(self):
+        """A transient UNAVAILABLE in the selection launch retries
+        inside the supervisor — no degradation, the pull just lands."""
+        base = _seed_doc(72, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            w = srv.connect()
+            w.push(0, base.export_updates({})).epoch(60)
+            r = srv.connect()
+            want = _oracle_updates(srv, 0, VersionVector())
+            faultinject.inject("export_launch", times=1)  # UNAVAILABLE
+            try:
+                assert r.pull(0) == want
+            finally:
+                faultinject.clear()
+            rep = srv.report()["readbatch"]
+            assert rep["degraded_windows"] == 0
+            assert rep["launches"] == 1
+        finally:
+            srv.close()
+
+    @pytest.mark.faultinject
+    def test_sync_pull_fault_still_fires_at_entry(self):
+        """The pre-existing client-visible pull fault site is upstream
+        of the routing decision: it fires whether or not the pull
+        would have batched."""
+        base = _seed_doc(73, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            s = srv.connect()
+            s.push(0, base.export_updates({})).epoch(60)
+            faultinject.inject(
+                "sync_pull",
+                exc=faultinject.InjectedFault("pull down"), times=1,
+            )
+            try:
+                with pytest.raises(faultinject.InjectedFault):
+                    s.pull(0)
+            finally:
+                faultinject.clear()
+            assert s.pull(0)  # healthy again
+        finally:
+            srv.close()

@@ -1691,13 +1691,19 @@ def main() -> None:
                          for k in range(n_readers)] for a in _arms}
             # persistent reader pools (thread SPAWN cost is common-mode
             # noise that would swamp the serving difference) + a warm
-            # round excluded from timing: compiles the selection
-            # kernel's bucket shapes and seeds the reader frontiers
-            # (steady-state serving is the thing being measured)
+            # round excluded from timing that seeds the reader
+            # frontiers (steady-state serving is the thing being
+            # measured).  The SERIAL seeding pulls ride the device but
+            # only ever form size-1 windows — the 16/32/64 request
+            # buckets and the dirty-doc scatter delta stay cold — so
+            # warm_read_plane pre-compiles those shapes, or the first
+            # timed epoch banks a multi-hundred-ms XLA compile as
+            # serving latency
             _pools = {a: _TPE(max_workers=n_readers) for a in _arms}
             for a in _arms:
                 for k in range(n_readers):
                     _rdrs[a][k].pull(k % R_DOCS)
+            _rsrv["device"].warm_read_plane(n_readers)
             _lat = {a: [] for a in _arms}
             _wall = {a: 0.0 for a in _arms}
             _pull_n = {a: 0 for a in _arms}

@@ -53,6 +53,23 @@ def _split_peer(peer: int) -> Tuple[int, int]:
     return (peer >> 32) & _MASK32, peer & _MASK32
 
 
+def _pad_request_shapes(n_req: int, n_peers: int) -> Tuple[int, int]:
+    """Request-table bucketing shared by ``select`` and ``warm`` — one
+    place, so warmed shapes can never drift from the shapes real
+    windows launch."""
+    return (pad_bucket(max(1, int(n_req)), floor=8),
+            pad_bucket(max(1, int(n_peers)), floor=4))
+
+
+def _scatter_rows(dev_cols, host_cols, idx):
+    """The dirty-doc delta upload shared by ``_device_cols`` and
+    ``warm``: one functional scatter per column, returning the new
+    device tuple."""
+    return tuple(
+        dev.at[idx].set(host[idx]) for dev, host in zip(dev_cols, host_cols)
+    )
+
+
 def _select_fn():
     """Build (once) the jitted batched selection kernel."""
     import jax
@@ -165,6 +182,7 @@ class ExportIndex:
         # the whole grid must re-upload (first sync / capacity grow)
         self._dirty_docs: Optional[set] = None
         self.launches = 0         # count guard: one per select() call
+        self.warm_launches = 0    # warm() pre-compiles, never windows
         self.rows_fed = 0
 
     # -- feed (owner holds the read-plane lock) ------------------------
@@ -248,10 +266,9 @@ class ExportIndex:
             idx = np.concatenate([idx, np.full(pad - len(docs), idx[0],
                                                np.int32)])
             hosts = (self._hi, self._lo, self._cs, self._ce, self._lam)
-            self._dev = tuple(
-                dev.at[idx].set(host[idx])
-                for dev, host in zip(self._dev[:5], hosts)
-            ) + (jnp.asarray(self._n),)
+            self._dev = _scatter_rows(self._dev[:5], hosts, idx) + (
+                jnp.asarray(self._n),
+            )
             kind = "delta"
         self._dirty_docs = set()
         obs.counter(
@@ -270,10 +287,9 @@ class ExportIndex:
         import jax.numpy as jnp
 
         cols = self._device_cols()
-        r_pad = pad_bucket(max(1, len(requests)), floor=8)
-        f_pad = pad_bucket(
-            max(1, max((len(vv) for _di, vv in requests), default=1)),
-            floor=4,
+        r_pad, f_pad = _pad_request_shapes(
+            len(requests),
+            max((len(vv) for _di, vv in requests), default=1),
         )
         doc = np.zeros((r_pad,), np.int32)
         f_hi = np.zeros((r_pad, f_pad), _U32)
@@ -301,10 +317,71 @@ class ExportIndex:
             order[r, : int(count[r])] for r in range(len(requests))
         ]
 
+    def warm(self, max_requests: int, max_peers: int = 4) -> int:
+        """Pre-compile the selection kernel over the request-bucket
+        ladder up to ``pad_bucket(max_requests)`` (frontier width
+        bucketed from ``max_peers`` — pass the widest per-doc writer
+        count expected, or wider frontier buckets still compile on
+        first use) at the CURRENT row capacity.  The kernel jit-caches
+        per (requests, frontier-width, capacity) bucket, so without
+        this the first window at each fresh bucket pays the XLA
+        compile INSIDE a session's pull latency — a p99 spike, and on
+        a real chip a remote-compile round-trip.  Also pre-compiles
+        the dirty-doc scatter delta (``_device_cols``) over its own
+        idx-bucket ladder — on the CPU mesh the scatter's first
+        compile dominates the first post-commit window, not the
+        selection kernel.
+
+        Every warm launch runs against throwaway all-zero tables and
+        columns of the LIVE shapes (the jit cache keys on shape +
+        dtype, and ``_pad_request_shapes`` / ``_scatter_rows`` are the
+        same code real windows run): no index or device state is read
+        or written, so the owner may call this WITHOUT holding the
+        read-plane lock across the multi-hundred-ms compiles — serving
+        never stalls behind a warm.  Counted separately
+        (``warm_launches``): warm launches are not windows, so the
+        launches <= windows count guard stays exact.  Capacity is
+        sampled once at entry; a concurrent grow (or a later one)
+        re-pads the row axis and re-compiles once per bucket — re-warm
+        after a known bulk load if first-window latency matters."""
+        import jax.numpy as jnp
+
+        n_docs, cap = self.n_docs, self._cap
+        dtypes = (_U32, _U32, np.int32, np.int32, np.int32)
+        dev = tuple(jnp.zeros((n_docs, cap), d) for d in dtypes)
+        cols = dev + (jnp.zeros((n_docs,), np.int32),)
+        target, f_pad = _pad_request_shapes(max_requests, max_peers)
+        done = 0
+        r = 8
+        while r <= target:
+            doc = jnp.zeros((r,), jnp.int32)
+            f_hi = jnp.zeros((r, f_pad), jnp.uint32)
+            f_lo = jnp.zeros((r, f_pad), jnp.uint32)
+            f_ctr = jnp.zeros((r, f_pad), jnp.int32)
+            f_n = jnp.zeros((r,), jnp.int32)
+            _order, count = select_since_batch(
+                doc, f_hi, f_lo, f_ctr, f_n, *cols
+            )
+            np.asarray(count)  # fetch drains the compile + launch
+            done += 1
+            r *= 2
+        hosts = tuple(np.zeros((n_docs, cap), d) for d in dtypes)
+        k = 8
+        kmax = pad_bucket(n_docs, floor=8)
+        while k <= kmax:
+            idx = np.zeros((k,), np.int32)
+            scat = _scatter_rows(dev, hosts, idx)
+            np.asarray(scat[0])  # fetch drains the compile + launch
+            done += 1
+            k *= 2
+        self.warm_launches += done
+        return done
+
     def report(self) -> Dict[str, int]:
         return {
             "rows": int(self._n.sum()),
             "capacity": self._cap,
             "launches": self.launches,
+            "warm_launches": self.warm_launches,
             "rows_fed": self.rows_fed,
         }

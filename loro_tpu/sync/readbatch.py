@@ -306,6 +306,53 @@ class ReadBatcher:
                 "contract (tickets failed typed)",
             ).inc(family=self._server.family)
 
+    def warmup(self, max_window: Optional[int] = None,
+               max_peers: int = 4) -> int:
+        """Pre-compile the selection launch for every window-size
+        bucket this batcher can form (up to ``max_window``, capped by
+        the batcher's own window cap; ``max_peers`` bounds the
+        frontier-width bucket — pass the widest per-doc writer count
+        expected), so the first reader storm never pays an XLA compile
+        inside a pull (``ExportIndex.warm``).  Warm launches run
+        against throwaway arrays of the live shapes, so the plane lock
+        is NOT held across the compiles (commits and cached pulls
+        never stall behind a warm), but they ride the same device
+        routing real windows use — the batch device lock plus the
+        ``DeviceSupervisor`` — so warm fetches never interleave with a
+        buffer-donating grow/evict on the device queue and a dead
+        device surfaces as typed ``DeviceFailure``.  Returns the
+        number of shapes compiled; no-op once closed."""
+        if self._stop:
+            return 0
+        n = self._max_window if max_window is None else min(
+            int(max_window), self._max_window
+        )
+
+        def thunk():
+            return self.plane.index.warm(max(1, n), max_peers)
+
+        sup = self._supervisor()
+        batch = getattr(self._server.resident, "batch", None)
+        # tiered resident: the hot-set inner batch owns the device
+        # queue (the same resolution TieredBatch.export_select does)
+        batch = getattr(batch, "inner", batch)
+        lock = getattr(batch, "_dev_lock", None)
+        if lock is not None:
+            with lock:
+                done = sup.launch(
+                    thunk, label=f"sync.read_warm.{self._server.family}"
+                )
+        else:
+            done = sup.launch(
+                thunk, label=f"sync.read_warm.{self._server.family}"
+            )
+        if done:
+            obs.counter(
+                "readbatch.warm_launches_total",
+                "selection-kernel shapes pre-compiled by warmup()",
+            ).inc(done, family=self._server.family)
+        return done
+
     def flush(self) -> None:
         """Block until every submitted pull has been served (pulls are
         leader-driven, so an empty idle queue means done)."""

@@ -608,6 +608,17 @@ class SyncServer:
             out["residency"] = res.report()
         return out
 
+    def warm_read_plane(self, max_window: Optional[int] = None,
+                        max_peers: int = 4) -> int:
+        """Pre-compile the read plane's selection shapes (one per
+        window-size bucket up to ``max_window``; ``max_peers`` bounds
+        the frontier-width bucket) so first-pull windows never bank an
+        XLA compile as serving latency; returns the shape count, 0
+        when the read plane is disabled."""
+        if self._readbatch is None:
+            return 0
+        return self._readbatch.warmup(max_window, max_peers)
+
     def close(self) -> None:
         """Drain the fan-in, close every session, detach from the
         resident server (and close it when this SyncServer built it —

@@ -243,6 +243,79 @@ class TestWindowCoalescing:
             srv.close()
 
 
+class TestWarmup:
+    """warm_read_plane pre-compiles the selection shape ladder without
+    perturbing the count guard or the served bytes."""
+
+    def test_warm_compiles_ladder_not_windows(self):
+        base = _seed_doc(53, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            w = srv.connect()
+            w.push(0, base.export_updates({})).epoch(60)
+            # ladder up to the 64-reader bucket: 8/16/32/64 select
+            # shapes + one dirty-scatter bucket for a 1-doc index = 5,
+            # counted as warm launches, NEVER as windows or launches
+            done = srv.warm_read_plane(64)
+            assert done == 5
+            rep = srv.report()["readbatch"]
+            assert rep["warm_launches"] == 5
+            assert rep["launches"] == 0 and rep["windows"] == 0
+            # served bytes unaffected: still the oracle's own export
+            r = srv.connect()
+            want = _oracle_updates(srv, 0, VersionVector())
+            assert r.pull(0) == want
+            rep = srv.report()["readbatch"]
+            assert rep["launches"] <= rep["windows"] == 1
+        finally:
+            srv.close()
+
+    def test_warm_wider_frontier_bucket(self):
+        """max_peers widens the frontier-width bucket: a fleet with
+        many writer peers per doc can pre-compile ITS shapes too."""
+        base = _seed_doc(55, 0)
+        srv = _mk_server("text", 1, base)
+        try:
+            # f_pad=8 ladder: one select bucket (8) + one scatter
+            # bucket for a 1-doc index
+            assert srv.warm_read_plane(8, max_peers=8) == 2
+            assert srv.report()["readbatch"]["warm_launches"] == 2
+        finally:
+            srv.close()
+
+    def test_warm_tiered_server(self):
+        """Warm routes through the tiered resident's INNER hot-set
+        batch device lock (the TieredBatch.export_select resolution)
+        and leaves tier state untouched."""
+        n_docs, hot = 4, 2
+        base = [_seed_doc(56 + i, i) for i in range(n_docs)]
+        srv = SyncServer("text", n_docs, cid=base[0].get_text("t").id,
+                         capacity=1 << 10, hot_slots=hot)
+        try:
+            s = srv.connect()
+            s.push(0, base[0].export_updates({})).epoch(60)
+            srv.flush()
+            mgr = srv.resident.residency
+            rep0 = mgr.report()
+            assert srv.warm_read_plane(16) > 0
+            rep1 = mgr.report()
+            for k in ("promotions", "misses", "evictions", "cold_revives"):
+                assert rep1[k] == rep0[k], k
+        finally:
+            srv.close()
+
+    def test_warm_noop_when_disabled_or_closed(self):
+        base = _seed_doc(54, 0)
+        srv = _mk_server("text", 1, base, read_batch=False)
+        try:
+            assert srv.warm_read_plane(64) == 0
+        finally:
+            srv.close()
+        srv2 = _mk_server("text", 1, base)
+        srv2.close()
+        assert srv2.warm_read_plane(64) == 0
+
+
 class TestTieredReadPlane:
     def test_warm_docs_pull_without_revive(self):
         """Pulls against warm (evicted) docs serve off the change-span

@@ -166,7 +166,7 @@ _CORE_KEYS = (
 # always routed to the sidecar line: prose, dict sidecars, series
 _SIDECAR_KEYS = (
     "metrics", "resilience", "pipeline", "rank", "sync", "shard", "tier",
-    "readplane",
+    "readplane", "repl",
     "baseline_note", "latency_note", "roofline_note",
     "roofline_measured_note", "resident_note", "resident_durable_note",
     "resident_pipeline_note", "e2e_note", "e2e_unit", "richtext_unit",
@@ -301,6 +301,14 @@ def assemble_record(ck: dict) -> dict:
         "sync_pull_ms_p50",
         "sync_pull_ms_p99",
         "readplane",
+        "repl_readers",
+        "repl_pulls_per_sec",
+        "repl_pulls_per_sec_leader_only",
+        "repl_read_scaling_x",
+        "repl_lag_ms_p50",
+        "repl_lag_ms_p99",
+        "repl_promotion_downtime_ms",
+        "repl",
         "shard_count",
         "shard_rows_per_sec",
         "shard_scaling_x",
@@ -1796,6 +1804,296 @@ def main() -> None:
         except Exception as e:  # tpulint: disable=LT-EXC(read-plane extra, never the headline)
             note(f"read-plane phase failed ({type(e).__name__}: {e})")
 
+    # ---- phase: WAL-shipping replication (BENCH_REPL=1|N, ISSUE 12) ---
+    # read scale-OUT, measured in the deployment shape: leader A serves
+    # ALL N readers alone (the single-leader line); leader B ships its
+    # WAL to a follower in a SEPARATE PROCESS (.visible-marker tail
+    # visibility, own GIL/core/read plane) and the same N readers split
+    # N/2 in-process on B + N/2 in the follower child, both halves
+    # serving CONCURRENTLY.  Both leaders are fed identical pushes.
+    # Banks aggregate repl_pulls_per_sec vs the single-leader line, the
+    # cross-process push-to-follower-visible lag, and the promotion
+    # downtime (leader retired -> first durable write on the promoted
+    # follower).  BENCH_REPL=N>1 sets the reader count (default 32).
+    if remaining() > 60 and os.environ.get("BENCH_REPL"):
+        _rctl = None
+        _rproc = None
+        try:
+            import random as _random
+            import subprocess as _subprocess
+            import tempfile as _tempfile
+            from concurrent.futures import ThreadPoolExecutor as _TPE
+
+            from loro_tpu import LoroDoc, replication
+            from loro_tpu.replication import Follower
+            from loro_tpu.sync import SyncServer
+
+            _rn = int(os.environ["BENCH_REPL"])
+            n_readers = _rn if _rn > 1 else 32
+            _half = n_readers // 2
+            P_DOCS, P_EPOCHS, P_EDITS = 4, 6, 128
+            note(
+                f"replication phase: {n_readers} readers x {P_DOCS} docs "
+                f"x {P_EPOCHS} epochs, single leader vs leader + "
+                "cross-process follower..."
+            )
+            _rng5 = _random.Random(0x4EB11CA)
+            _rctl = _tempfile.mkdtemp(prefix="bench_repl_")
+            _pdocs = []
+            for i in range(P_DOCS):
+                b = LoroDoc(peer=5000 + i)
+                b.get_text("t").insert(0, f"repl base {i}")
+                b.commit()
+                _pdocs.append(b)
+            _pcid = _pdocs[0].get_text("t").id
+
+            def _mk_lead(tag):
+                return SyncServer(
+                    "text", P_DOCS, cid=_pcid, capacity=1 << 14,
+                    max_queue=128, durable_dir=os.path.join(_rctl, tag),
+                    durable_fsync="group", fsync_window=8,
+                )
+
+            _leadA, _leadB = _mk_lead("A"), _mk_lead("B")
+            replication.enable(_leadB.resident, "bench-leader")
+            _pwA = [_leadA.connect(sid=f"w{i}") for i in range(P_DOCS)]
+            _pwB = [_leadB.connect(sid=f"w{i}") for i in range(P_DOCS)]
+            _pmarks = [{} for _ in range(P_DOCS)]
+            _boot = []
+            for i in range(P_DOCS):
+                pl = _pdocs[i].export_updates({})
+                _boot += [_pwA[i].push(i, pl), _pwB[i].push(i, pl)]
+                _pmarks[i] = _pdocs[i].oplog_vv()
+            for _tk in _boot:
+                _tk.epoch(120)
+            for _s in (_leadA, _leadB):
+                _s.flush()
+                _s.resident.flush_durable()
+            # spawn the follower child over leader B's directory (its
+            # jax import runs while we warm the parent-side planes)
+            with open(os.path.join(_rctl, "child.cfg"), "w") as f:
+                json.dump({
+                    "leader_dir": os.path.join(_rctl, "B"),
+                    "follower_dir": os.path.join(_rctl, "F"),
+                    "readers": n_readers - _half, "docs": P_DOCS,
+                    "epochs": P_EPOCHS,
+                }, f)
+            _renv = dict(os.environ)
+            _renv["BENCH_REPL_CHILD"] = _rctl
+            _renv.pop("BENCH_CHECKPOINT", None)
+            with open(os.path.join(_rctl, "child.log"), "ab") as _clog:
+                _rproc = _subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=_renv, stdout=_clog, stderr=_clog,
+                    start_new_session=True,
+                )
+            _solo = [_leadA.connect(sid=f"s{k}") for k in range(n_readers)]
+            _aggL = [_leadB.connect(sid=f"bl{k}") for k in range(_half)]
+            for k, s in enumerate(_solo):
+                s.pull(k % P_DOCS)
+            for k, s in enumerate(_aggL):
+                s.pull(k % P_DOCS)
+            _leadA.warm_read_plane(n_readers)
+            _leadB.warm_read_plane(n_readers)
+
+            def _wait_file(path, deadline_s, what):
+                t0w = time.time()
+                while not os.path.exists(path):
+                    err = os.path.join(_rctl, "child.err")
+                    if os.path.exists(err):
+                        with open(err) as f:
+                            raise RuntimeError(
+                                f"repl child failed: {f.read()[:500]}"
+                            )
+                    if _rproc.poll() is not None:
+                        raise RuntimeError(
+                            f"repl child exited rc={_rproc.returncode} "
+                            f"before {what}"
+                        )
+                    if time.time() - t0w > deadline_s:
+                        raise RuntimeError(f"repl child: {what} timed out")
+                    time.sleep(0.005)
+
+            _wait_file(os.path.join(_rctl, "child.ready"), 180,
+                       "bootstrap")
+            _pool = _TPE(max_workers=n_readers)
+            _wall = {"solo": 0.0, "agg": 0.0}
+            _pulls = {"solo": 0, "agg": 0}
+            _lags = []
+
+            def _pull_solo(k):
+                _solo[k].pull(k % P_DOCS)
+
+            def _pull_aggL(k):
+                _aggL[k].pull(k % P_DOCS)
+
+            # epoch 0 is an UNTIMED warm epoch: the child's replay path
+            # jit-compiles its real payload shapes on the first shipped
+            # round, which would otherwise bank one ~300ms compile as
+            # serving lag (the read-plane warm lesson, PR 11)
+            _timed = {"on": False}
+            for _e in range(P_EPOCHS):
+                _tks = []
+                for i in range(P_DOCS):
+                    d = _pdocs[i]
+                    t = d.get_text("t")
+                    for _ in range(P_EDITS):
+                        L = len(t)
+                        t.insert(_rng5.randint(0, L),
+                                 "abcdef"[:_rng5.randint(1, 6)])
+                    d.commit()
+                    pl = d.export_updates(_pmarks[i])
+                    _tks += [_pwA[i].push(i, pl), _pwB[i].push(i, pl)]
+                    _pmarks[i] = d.oplog_vv()
+                for _tk in _tks:
+                    _tk.epoch(120)
+                for _s in (_leadA, _leadB):
+                    _s.flush()
+                    _s.resident.flush_durable()  # publishes .visible
+
+                def _run_agg():
+                    # child goes first (its catch_up overlaps nothing
+                    # timed), then the parent half serves concurrently
+                    # with the child's half
+                    _gop = os.path.join(_rctl, f"e{_e}.go")
+                    with open(_gop + ".tmp", "w") as f:
+                        json.dump({"epoch": _leadB.resident.epoch}, f)
+                    os.replace(_gop + ".tmp", _gop)  # atomic: child polls
+                    _t0a = time.perf_counter()
+                    list(_pool.map(_pull_aggL, range(_half)))
+                    _pwall = time.perf_counter() - _t0a
+                    _wait_file(os.path.join(_rctl, f"e{_e}.done"), 90,
+                               f"epoch {_e}")
+                    with open(os.path.join(_rctl, "child.out")) as f:
+                        rec = json.loads(f.read().splitlines()[_e])
+                    if _timed["on"]:
+                        _lags.append(rec["lag_s"] * 1e3)
+                        _wall["agg"] += max(_pwall, rec["pull_wall_s"])
+                        _pulls["agg"] += n_readers
+
+                def _run_solo():
+                    _t0a = time.perf_counter()
+                    list(_pool.map(_pull_solo, range(n_readers)))
+                    if _timed["on"]:
+                        _wall["solo"] += time.perf_counter() - _t0a
+                        _pulls["solo"] += n_readers
+
+                for _arm in (("solo", "agg") if _e % 2 == 0
+                             else ("agg", "solo")):
+                    (_run_solo if _arm == "solo" else _run_agg)()
+                _timed["on"] = True
+            _wait_file(os.path.join(_rctl, "child.final"), 60,
+                       "final state")
+            with open(os.path.join(_rctl, "child.final")) as f:
+                _cfinal = json.load(f)
+            _rproc.wait(timeout=60)
+            _rproc = None
+            assert _cfinal["texts"] == _leadB.resident.texts() \
+                == _leadA.resident.texts(), \
+                "replication A/B: follower diverged from the leaders"
+            # promotion downtime: a second (in-process) follower takes
+            # over leader B — retire -> first durable write accepted
+            _fol2 = Follower(os.path.join(_rctl, "B"),
+                             os.path.join(_rctl, "F2"),
+                             leader=_leadB.resident)
+            _fol2.catch_up()
+            _t0p = time.perf_counter()
+            _leadB.close()
+            _prom = _fol2.promote("bench-survivor")
+            _wd = _pdocs[0]
+            _wt = _wd.get_text("t")
+            _wt.insert(0, "post-promotion ")
+            _wd.commit()
+            _ws = _fol2.sync.connect()
+            _ws.push(0, _wd.export_updates(_pmarks[0])).epoch(120)
+            _down_ms = (time.perf_counter() - _t0p) * 1e3
+            assert _prom.texts()[0] == _wt.to_string(), \
+                "post-promotion push did not land"
+            _fol2.close()
+            _leadA.close()
+            _pool.shutdown()
+
+            def _pctl5(xs, q):
+                xs = sorted(xs)
+                return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+            _solo_ps = _pulls["solo"] / max(_wall["solo"], 1e-9)
+            _agg_ps = _pulls["agg"] / max(_wall["agg"], 1e-9)
+            _side = {
+                "readers": n_readers,
+                "docs": P_DOCS,
+                "epochs": P_EPOCHS,
+                "warm_epochs": 1,
+                "leader_pulls_per_sec": round(_solo_ps, 1),
+                "aggregate_pulls_per_sec": round(_agg_ps, 1),
+                "lag_ms_p50": round(_pctl5(_lags, 0.50), 2),
+                "lag_ms_p99": round(_pctl5(_lags, 0.99), 2),
+                "promotion_downtime_ms": round(_down_ms, 1),
+                "follower": _cfinal.get("report"),
+                "note": (
+                    "two identically-fed durable group-commit text "
+                    "leaders: A serves all N readers (single-leader "
+                    "line); B ships WAL to a follower in a separate "
+                    "process (.visible marker tail) and N/2 in-process "
+                    "+ N/2 follower-process readers serve concurrently "
+                    "— per-epoch agg wall = max(parent half, follower "
+                    "half); lag = cross-process marker catch_up to the "
+                    "pushed epoch; epoch 0 is an untimed warm epoch "
+                    "(child replay-path compile); downtime = leader "
+                    "close -> promoted follower's first durable write"
+                ),
+            }
+            bank(
+                "repl",
+                repl_readers=n_readers,
+                repl_pulls_per_sec=round(_agg_ps, 1),
+                repl_pulls_per_sec_leader_only=round(_solo_ps, 1),
+                repl_read_scaling_x=round(_agg_ps / max(_solo_ps, 1e-9), 2),
+                repl_lag_ms_p50=round(_pctl5(_lags, 0.50), 2),
+                repl_lag_ms_p99=round(_pctl5(_lags, 0.99), 2),
+                repl_promotion_downtime_ms=round(_down_ms, 1),
+                repl=_side,
+            )
+            note(
+                f"replication: {n_readers} readers, single leader "
+                f"{_solo_ps:.0f} pulls/s vs leader+follower "
+                f"{_agg_ps:.0f} pulls/s "
+                f"({_agg_ps / max(_solo_ps, 1e-9):.2f}x), lag p50 "
+                f"{_pctl5(_lags, 0.50):.1f}ms, promotion {_down_ms:.0f}ms"
+            )
+            import shutil as _shutil
+
+            _shutil.rmtree(_rctl, ignore_errors=True)
+        except Exception as e:  # tpulint: disable=LT-EXC(replication extra, never the headline)
+            note(f"replication phase failed ({type(e).__name__}: {e})")
+            if _rproc is not None and _rctl is not None:
+                try:
+                    # cooperative stop; the child is a CPU process, but
+                    # never signal mid-anything on principle
+                    with open(os.path.join(_rctl, "stop"), "w") as f:
+                        f.write("stop")
+                    _rproc.wait(timeout=30)
+                except Exception:  # tpulint: disable=LT-EXC(best-effort child teardown on an already-failed phase)
+                    pass
+            # best-effort teardown: later phases must never time their
+            # runs against this phase's leaked worker threads, and a
+            # failed run must not strand its control dir in /tmp
+            _rlocals = locals()
+            for _rname in ("_pool", "_fol2", "_leadA", "_leadB"):
+                _robj = _rlocals.get(_rname)
+                if _robj is None:
+                    continue
+                try:
+                    if _rname == "_pool":
+                        _robj.shutdown(wait=False)
+                    else:
+                        _robj.close()
+                except Exception:  # tpulint: disable=LT-EXC(best-effort teardown on an already-failed phase)
+                    pass
+            if _rctl is not None:
+                import shutil as _shutil
+
+                _shutil.rmtree(_rctl, ignore_errors=True)
+
     # ---- phase: sharded resident fleet (BENCH_SHARDS=N, ISSUE 8) ------
     # doc-batch parallelism as the distributed axis: the same serving-
     # granularity rounds through a 1-shard vs an N-shard
@@ -2248,6 +2546,89 @@ def _run_capture_child(
     return _last_json_record(out_path), rc
 
 
+def _repl_child_main() -> None:
+    """BENCH_REPL_CHILD=<ctl_dir>: the replication bench's follower
+    PROCESS — a cross-process hot standby over the leader's durable
+    directory (``.visible``-marker tail visibility, the real deployment
+    shape: its own GIL, its own core, its own read plane).  File
+    protocol under ctl_dir: ``child.cfg`` in, ``child.ready`` out,
+    then per epoch wait ``e<N>.go`` (JSON ``{"epoch": target}``),
+    catch up to the target, serve one reader fan-out, append a line to
+    ``child.out`` and write ``e<N>.done``; ``child.final`` carries the
+    differential texts + follower report.  Always CPU platform — a
+    read replica must never contend for the leader's accelerator (and
+    two processes on one TPU can wedge the tunnel)."""
+    ctl = os.environ["BENCH_REPL_CHILD"]
+
+    def _fail(e: BaseException) -> None:
+        import traceback
+
+        with open(os.path.join(ctl, "child.err"), "w") as f:
+            f.write(f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from concurrent.futures import ThreadPoolExecutor
+
+        from loro_tpu.replication import Follower
+
+        with open(os.path.join(ctl, "child.cfg")) as f:
+            cfg = json.load(f)
+        n, docs = int(cfg["readers"]), int(cfg["docs"])
+        fol = Follower(cfg["leader_dir"], cfg["follower_dir"],
+                       follower_id="bench-child", leader=None)
+        readers = [fol.sync.connect(sid=f"cr{k}") for k in range(n)]
+        for k, s in enumerate(readers):
+            s.pull(k % docs)
+        fol.warm_read_plane(n)
+        pool = ThreadPoolExecutor(max_workers=n)
+        with open(os.path.join(ctl, "child.ready"), "w") as f:
+            f.write("ready")
+        with open(os.path.join(ctl, "child.out"), "a") as out:
+            for e in range(int(cfg["epochs"])):
+                go = os.path.join(ctl, f"e{e}.go")
+                stop = os.path.join(ctl, "stop")
+                t0w = time.time()
+                while not os.path.exists(go):
+                    if os.path.exists(stop) or time.time() - t0w > 300:
+                        return  # parent stopped (or died): exit clean
+                    time.sleep(0.001)
+                with open(go) as f:
+                    target = int(json.load(f)["epoch"])
+                t0 = time.perf_counter()
+                deadline = t0 + 60.0
+                while (fol.applied_epoch < target
+                       and time.perf_counter() < deadline):
+                    fol.catch_up()
+                    if fol.applied_epoch < target:
+                        time.sleep(0.001)
+                lag_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                list(pool.map(lambda k: readers[k].pull(k % docs),
+                              range(n)))
+                wall = time.perf_counter() - t0
+                out.write(json.dumps({
+                    "e": e, "applied": fol.applied_epoch,
+                    "lag_s": round(lag_s, 6),
+                    "pull_wall_s": round(wall, 6), "pulls": n,
+                }) + "\n")
+                out.flush()
+                with open(os.path.join(ctl, f"e{e}.done"), "w") as f:
+                    f.write("done")
+        final = {"texts": fol.resident.texts(), "report": fol.report()}
+        pool.shutdown()
+        fol.close()
+        fpath = os.path.join(ctl, "child.final")
+        with open(fpath + ".tmp", "w") as f:
+            json.dump(final, f)
+        os.replace(fpath + ".tmp", fpath)  # atomic: the parent polls
+    except BaseException as e:  # tpulint: disable=LT-EXC(subprocess boundary: the parent reads child.err, a silent death would hang it)
+        _fail(e)
+        raise
+
+
 def main_guarded() -> None:
     """Run main() in a subprocess with a watchdog.  The child banks an
     incremental checkpoint after every phase; on timeout the parent
@@ -2467,7 +2848,9 @@ def main_guarded() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_INNER") or os.environ.get("BENCH_NO_GUARD"):
+    if os.environ.get("BENCH_REPL_CHILD"):
+        _repl_child_main()
+    elif os.environ.get("BENCH_INNER") or os.environ.get("BENCH_NO_GUARD"):
         main()
     else:
         main_guarded()

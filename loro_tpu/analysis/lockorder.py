@@ -16,6 +16,12 @@ purpose; add them the day they stop being leaves.
 
 The order, with the paths that establish each edge:
 
+- ``repl.follower``    — replication Follower catch_up/promote RLock
+  (loro_tpu/replication/follower.py), the outermost spine of the
+  standby plane: one pass holds it across the shipped-round replay
+  (→ ``fleet.dev``/``supervisor.state`` through the resident) and the
+  read-only sync feed (→ ``sync.server`` → ``sync.readplane``).
+  Nothing acquires it while holding anything below.
 - ``sync.server``      — SyncServer session/oracle lock; a root for
   everything below: _commit_batch submits to the pipeline BEFORE
   taking it and epoch subscribers are lock-free by contract.  The
@@ -56,6 +62,7 @@ from __future__ import annotations
 from typing import Dict, Iterable, List, Sequence, Set, Tuple
 
 LEVELS: Dict[str, int] = {
+    "repl.follower": 5,
     "sync.server": 10,
     "sync.readbatch": 14,
     "sync.readplane": 16,

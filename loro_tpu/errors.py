@@ -89,6 +89,43 @@ class ResidencyError(LoroError):
     degradation."""
 
 
+class ReplicationError(LoroError):
+    """Base for WAL-shipping replication (loro_tpu/replication/,
+    docs/REPLICATION.md): leader-side shipping, follower apply loops,
+    fencing and promotion."""
+
+
+class NotLeader(ReplicationError):
+    """A write (push/ingest) reached a read-only follower.  Carries the
+    current leader's identity so clients can redirect instead of
+    guessing."""
+
+    def __init__(self, msg: str, leader=None):
+        self.leader = leader
+        super().__init__(msg + (f" (leader: {leader})" if leader else ""))
+
+
+class FencedLeader(ReplicationError):
+    """A fenced (deposed) leader attempted a WAL append: a follower was
+    promoted with a newer leader token, so this process must fail-stop
+    — continuing to journal would fork the replicated history.  Raised
+    BEFORE any bytes reach the segment (no partial record)."""
+
+
+class StaleFollower(ReplicationError):
+    """The follower's shipped position fell below the leader's WAL
+    prune floor (its retention pin was dropped by the staleness
+    cutoff, then the history it still needed was deleted).  The
+    follower must re-bootstrap from a fresh directory — resuming would
+    silently fabricate a truncated history."""
+
+
+class ReplicaLag(ReplicationError):
+    """A ``pull(min_epoch=...)`` read-your-writes gate timed out: the
+    replica has not applied the requested epoch yet.  Retry, or pull
+    from the leader."""
+
+
 class AnalysisError(LoroError):
     """Base for the static-analysis / invariant-witness subsystem
     (loro_tpu/analysis/, docs/ANALYSIS.md)."""

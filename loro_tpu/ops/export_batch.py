@@ -142,12 +142,13 @@ class ExportIndex:
     path (docs/SYNC.md "Read plane").
 
     Retention: the index (host ``Change`` lists + device rows) keeps
-    every change since its floor — the same unbounded-history posture
-    as the oracle ``LoroDoc`` mirrors it shadows, because ANY client
-    frontier at/above the floor must stay servable.  Pruning rows
-    under the resident ack floors (advancing ``floor_vvs`` so trimmed
-    frontiers re-route to the oracle) is the documented follow-up for
-    long-lived durable servers (docs/SYNC.md "Read plane").
+    every change since its floor; ``prune_below(di, floor_vv)`` drops
+    rows fully below a frontier every connected session already holds
+    and advances ``floor_vvs`` past them, so frontiers under the new
+    floor re-route to the oracle through the existing ``covers`` path
+    (the SyncServer wires it to ``compact()`` — docs/SYNC.md "Read
+    plane").  Straddling rows stay: a client at the floor may still
+    need their trimmed tails.
 
     Thread contract: the OWNER serializes calls (the read plane takes
     ``sync.readplane`` around every entry); this class has no lock of
@@ -184,6 +185,7 @@ class ExportIndex:
         self.launches = 0         # count guard: one per select() call
         self.warm_launches = 0    # warm() pre-compiles, never windows
         self.rows_fed = 0
+        self.rows_pruned = 0
 
     # -- feed (owner holds the read-plane lock) ------------------------
     def note_changes(self, di: int, chs: Sequence) -> None:
@@ -231,6 +233,48 @@ class ExportIndex:
 
     def head_vv(self, di: int) -> VersionVector:
         return self.head_vvs[di].copy()
+
+    def prune_below(self, di: int, floor_vv: VersionVector) -> int:
+        """Drop rows wholly at/under ``floor_vv`` (every connected
+        session already holds them) and advance the doc's index floor
+        past it: pruned history re-routes to the oracle through
+        ``covers`` — never a silently-short delta.  Straddling rows
+        survive whole (a client at the floor needs their trimmed
+        tails; selection's straddle correction keeps serving them).
+        Device rows rewrite via the ordinary dirty-doc scatter; rows
+        past the new count stay allocated but masked by ``n_rows``.
+        Returns rows pruned."""
+        old = self.changes[di]
+        keep = [ch for ch in old if ch.ctr_end > floor_vv.get(ch.peer)]
+        pruned = len(old) - len(keep)
+        if pruned == 0:
+            return 0
+        self.changes[di] = keep
+        for j, ch in enumerate(keep):
+            hi, lo = _split_peer(ch.peer)
+            self._hi[di, j] = hi
+            self._lo[di, j] = lo
+            self._cs[di, j] = ch.ctr_start
+            self._ce[di, j] = ch.ctr_end
+            self._lam[di, j] = ch.lamport
+        self._n[di] = len(keep)
+        # floor advances by REFERENCE SWAP, never in-place merge:
+        # ``covers`` reads the floor lock-free under the server lock
+        # while pruning holds only the plane lock — a reader must see
+        # a complete old or complete new floor, never a half-merged VV
+        # (and never a dict mutating under its iteration)
+        new_floor = self.floor_vvs[di].copy()
+        new_floor.merge(floor_vv)
+        self.floor_vvs[di] = new_floor
+        if self._dirty_docs is not None:
+            self._dirty_docs.add(di)
+        self.rows_pruned += pruned
+        obs.counter(
+            "readbatch.index_rows_pruned_total",
+            "change-span index rows dropped below the session ack "
+            "floors at compaction",
+        ).inc(pruned, family=self.family)
+        return pruned
 
     def covers(self, di: int, from_vv: VersionVector) -> bool:
         """Whether a pull from ``from_vv`` is servable off the index:
@@ -384,4 +428,5 @@ class ExportIndex:
             "launches": self.launches,
             "warm_launches": self.warm_launches,
             "rows_fed": self.rows_fed,
+            "rows_pruned": self.rows_pruned,
         }

@@ -519,7 +519,7 @@ class ResidentServer:
             try:
                 self._durable.append_round(epoch, cid, frozen)
             except BaseException as e:
-                from ..errors import PersistError
+                from ..errors import FencedLeader, PersistError
 
                 log, self._durable = self._durable, None
                 self._durable_closed = True  # later ingests raise typed
@@ -528,6 +528,13 @@ class ResidentServer:
                 except Exception:  # tpulint: disable=LT-EXC(best-effort WAL close while the typed fail-stop PersistError is already in flight)
                     pass
                 obs.counter("server.errors_total").inc(family=self.family)
+                if isinstance(e, FencedLeader):
+                    # replication fencing (docs/REPLICATION.md): the
+                    # fence fires BEFORE any bytes land, so the WAL is
+                    # intact — surface the deposition itself, not a
+                    # disk-failure wrap; journaling stays detached
+                    # (fail-stop) either way.
+                    raise
                 raise PersistError(
                     f"durable journal append failed at epoch {epoch} — "
                     "the WAL no longer matches served state; journaling "

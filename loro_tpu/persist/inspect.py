@@ -228,6 +228,39 @@ def inspect_dir(durable_dir: str, out=None, _stats: Optional[dict] = None) -> in
                 if not ok:
                     rc = 1
 
+    # -- replication (loro_tpu/replication/, docs/REPLICATION.md) ------
+    rep_path = os.path.join(durable_dir, "replication.json")
+    if os.path.isfile(rep_path):
+        try:
+            with open(rep_path, "r") as f:
+                rep = json.load(f)
+        except (OSError, ValueError) as e:
+            p(f"replication: replication.json UNREADABLE ({e})")
+            rc = 1
+        else:
+            p(f"replication: leader_token={rep.get('leader_token')} "
+              f"held_by={rep.get('leader_id')!r}")
+            newest = _stats.get("newest_round_epoch") if _stats else None
+            if newest is None:
+                newest = max((r.epoch for r in rounds), default=None)
+            floors = []
+            import time as _t
+
+            now = _t.time()  # tpulint: disable=LT-TIME(read-only CLI report of wall-clock last-seen stamps; no fake-clock test drives it)
+            for fid, f in sorted(rep.get("followers", {}).items()):
+                acked = int(f.get("acked_epoch", 0))
+                lag = (newest - acked) if newest is not None else 0
+                age = now - float(f.get("last_seen", now))
+                floors.append(acked)
+                p(f"  follower {fid}: acked e{acked}  "
+                  f"lag {max(0, lag)} round(s)  "
+                  f"last seen {age:.0f}s ago")
+            if floors:
+                p(f"  pinned prune floor: e{min(floors)} "
+                  "(WAL segments above it are retained for followers)")
+            else:
+                p("  no registered followers (nothing pinned)")
+
     # -- recovery preview ----------------------------------------------
     if newest_valid is not None:
         tail = sum(

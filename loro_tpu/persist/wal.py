@@ -375,6 +375,20 @@ class WriteAheadLog:
         os.makedirs(dir, exist_ok=True)
         self._f = None  # active segment file handle
         self._active: Optional[SegmentInfo] = None
+        # replication hooks (loro_tpu/replication/, docs/REPLICATION.md):
+        # ``fence`` fires before EVERY append — a deposed leader raises
+        # typed FencedLeader there, before any bytes reach the segment;
+        # ``retention_floor`` pins prune_below at the registered
+        # followers' acked epochs; ``publish_visibility`` mirrors the
+        # fsync watermark to ``.visible`` so cross-process followers can
+        # honor the durable-tail protocol without this object.
+        self.fence = None
+        self.retention_floor = None
+        self.publish_visibility = False
+        # fsync watermark on the ACTIVE segment: bytes at/under it are
+        # known durable (the ship-visibility bound).  Sealed segments
+        # are fully visible — rotation fsyncs them closed.
+        self._synced_bytes = 0
         self.meta: Optional[WalMeta] = None
         # newest R_PRUNE floor: rounds at/under it were DELETED from
         # the log, so a from-birth cold replay is no longer possible
@@ -436,6 +450,8 @@ class WriteAheadLog:
             ).inc()
         self._f = open(last.path, "ab")
         self._active = last
+        # everything that survived the reopen scan is on disk already
+        self._synced_bytes = last.good_bytes
 
     def _start_segment(self, index: int) -> None:
         path = os.path.join(self.dir, _seg_name(index))
@@ -448,6 +464,7 @@ class WriteAheadLog:
         info = SegmentInfo(path=path, index=index, size=5, good_bytes=5)
         self._segments.append(info)
         self._active = info
+        self._synced_bytes = 5
         obs.counter("persist.wal_segments_total").inc()
         # every segment is self-describing: re-write the meta record
         # (and the prune floor, when history was ever dropped) so
@@ -469,6 +486,11 @@ class WriteAheadLog:
     def _append(self, payload: bytes, rtype: str) -> None:
         if self._f is None:
             raise PersistError("WAL is closed")
+        if self.fence is not None:
+            # leader fencing (docs/REPLICATION.md): a promoted follower
+            # holds a newer leader token, so this append must fail-stop
+            # typed BEFORE any bytes land — never a partial record
+            self.fence()
         faultinject.check("wal_write", rtype=rtype)
         frame = (
             struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
@@ -490,6 +512,18 @@ class WriteAheadLog:
         a = self._active
         a.size = a.good_bytes = a.good_bytes + _FRAME_HDR + len(payload)
         a.n_records += 1
+        if self.fsync_mode == "per_round":
+            # the frame was fsync'd above: the whole segment is visible
+            self._synced_bytes = a.good_bytes
+            self._publish_visibility()
+        elif self.fsync_mode == "off":
+            # tests: no fsync anywhere — durability is disclaimed, so
+            # visibility = appended bytes.  Publish the marker too:
+            # an in-process follower (visible_extent) and a
+            # cross-process one (.visible) must see the SAME tail for
+            # the same log, whichever process they run in
+            self._synced_bytes = a.good_bytes
+            self._publish_visibility()
 
     def _fsync_active(self) -> None:
         """fsync the active segment handle (timed + counted: the
@@ -514,6 +548,8 @@ class WriteAheadLog:
             raise PersistError("WAL is closed")
         n, self._unsynced = self._unsynced, 0
         self._fsync_active()
+        self._synced_bytes = self._active.good_bytes
+        self._publish_visibility()
         obs.histogram(
             "persist.wal_group_commit_rounds", "appends per group fsync",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128),
@@ -578,7 +614,18 @@ class WriteAheadLog:
         marker lands in the active segment first: cold recovery must
         be able to tell "no rounds ever" from "rounds were deleted"
         (silently replaying a truncated history would fabricate
-        state)."""
+        state).  With a ``retention_floor`` installed (replication:
+        registered followers' acked epochs), the prune point is
+        clamped to it — a lagging follower pins the segments it still
+        needs (docs/REPLICATION.md "retention")."""
+        if self.retention_floor is not None:
+            floor = self.retention_floor()
+            if floor is not None and floor < epoch:
+                obs.gauge(
+                    "repl.retention_pinned_floor",
+                    "WAL prune epoch pinned by follower acks",
+                ).set(floor)
+                epoch = floor
         doomed = [
             info for info in self._segments
             if info is not self._active
@@ -640,6 +687,41 @@ class WriteAheadLog:
 
     def segments(self) -> List[SegmentInfo]:
         return list(self._segments)
+
+    # -- ship visibility (loro_tpu/replication/) -----------------------
+    def visible_extent(self) -> List[Tuple[int, str, int]]:
+        """``(index, path, visible_bytes)`` per segment — the bytes a
+        WAL shipper may stream to a follower.  Sealed segments are
+        fully visible (rotation fsyncs them closed); the ACTIVE segment
+        is visible only up to the fsync watermark, so a follower can
+        never apply a round the leader has not made durable (the
+        group-commit tail protocol, docs/REPLICATION.md)."""
+        out: List[Tuple[int, str, int]] = []
+        for info in self._segments:
+            vis = self._synced_bytes if info is self._active else info.good_bytes
+            out.append((info.index, info.path, vis))
+        return out
+
+    def _publish_visibility(self) -> None:
+        """Mirror the fsync watermark to ``<dir>/.visible`` (atomic
+        replace, deliberately un-fsynced: it only ever UNDERSTATES what
+        is durable, which is the safe direction) so a follower in
+        another process can honor the tail protocol.  Off by default —
+        ``replication.enable()`` turns it on; non-replicated servers
+        never pay the extra write."""
+        if not self.publish_visibility or self._active is None:
+            return
+        import json
+
+        path = os.path.join(self.dir, ".visible")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"seg": self._active.index,
+                           "off": self._synced_bytes}, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # advisory only; the in-process extent stays exact
 
     def close(self) -> None:
         if self._f is not None:

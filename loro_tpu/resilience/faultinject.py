@@ -47,6 +47,16 @@ instrumented choke points of the device pipeline:
                      — a failure fails only the triggering round or
                      ticket (typed ResidencyError), the doc stays
                      warm/cold and the server stays healthy
+- ``repl_ship``    — replication.WalShipper.read: every shipped byte
+                     crosses it — raise/delay = a mid-ship crash (the
+                     follower resumes from its acked offset);
+                     truncate/bitflip = a genuinely torn shipped tail
+                     the follower truncates like a WAL reopen
+- ``repl_apply``   — replication.Follower apply loop: fires before
+                     each shipped round applies to the follower batch
+- ``repl_promote`` — replication.Follower.promote entry: fires before
+                     the fencing token bump (promotion races / crash-
+                     before-fence; a retried promote starts clean)
 
 Arm programmatically::
 
@@ -166,7 +176,15 @@ def fired(site: str) -> int:
         return sum(f.fired for f in _faults.get(site, ()))
 
 
-def _take(site: str, doc: Optional[int] = None) -> Optional[Fault]:
+# actions that only have an effect where bytes flow (mangle); check()
+# must leave them armed so a site instrumented with BOTH calls — e.g.
+# replication's ``repl_ship`` (check before the read, mangle on the
+# streamed bytes) — delivers them to the mangle that can apply them
+_MANGLE_ACTIONS = ("truncate", "bitflip", "poison")
+
+
+def _take(site: str, doc: Optional[int] = None,
+          skip_mangle: bool = False) -> Optional[Fault]:
     """First armed fault at `site` that matches `doc`; ticks counters.
 
     Disarmed fast path: with the env parsed and no faults in the
@@ -183,6 +201,8 @@ def _take(site: str, doc: Optional[int] = None) -> Optional[Fault]:
                 continue
             if f.docs is not None and (doc is None or doc not in f.docs):
                 continue
+            if skip_mangle and f.action in _MANGLE_ACTIONS:
+                continue
             f.fired += 1
             _obs.counter("faultinject.fired_total").inc(site=site, action=f.action)
             return f
@@ -198,8 +218,10 @@ def _hang_delay(f: Fault) -> float:
 
 def check(site: str, doc: Optional[int] = None, **ctx) -> bool:
     """Called at instrumented sites.  Raises / sleeps per the armed
-    fault; returns True iff a fault fired (False = clean pass)."""
-    f = _take(site, doc)
+    fault; returns True iff a fault fired (False = clean pass).
+    Mangle-class faults (truncate/bitflip/poison) are left armed for
+    the site's ``mangle()`` call — check can't apply them."""
+    f = _take(site, doc, skip_mangle=True)
     if f is None:
         return False
     if f.action in ("delay", "hang"):

@@ -143,7 +143,11 @@ class ReadPlane:
             self._frames[di].clear()
 
     def covers(self, di: int, from_vv: VersionVector) -> bool:
-        # floor VVs are immutable after construction: lock-free read
+        # floor VVs only ever advance, by whole-object swap
+        # (prune_below), so this read is safe lock-free — but a pull
+        # that passed here may still see its rows pruned before its
+        # window processes; _process_device re-checks under the plane
+        # lock and re-routes casualties to the oracle
         return self.index.covers(di, from_vv)
 
     # -- frame cache (caller holds sync.readplane) ---------------------
@@ -425,6 +429,7 @@ class ReadBatcher:
                 order.append(g)
             g[2].append(tk)
         out: List[tuple] = []
+        stale: List[list] = []
         win_hits = win_shared = 0
         with self.plane._lock:
             # epoch snapshot: reads BEFORE any ticket resolves, while
@@ -441,6 +446,16 @@ class ReadBatcher:
                 key = ReadPlane.frame_key(from_vv)
                 hit = self.plane.cached_frame(di, key)
                 if hit is None:
+                    # covers re-check under the plane lock: a compact()
+                    # may have pruned index rows this frontier needs
+                    # AFTER the routing check passed (the submit ran
+                    # under the server lock with the old floor) — a
+                    # below-floor selection would silently drop the
+                    # pruned changes, so these pulls re-route to the
+                    # oracle outside the plane lock instead
+                    if not self.plane.index.covers(di, from_vv):
+                        stale.append(g)
+                        continue
                     g.append(key)
                     misses.append(g)
                 else:
@@ -470,6 +485,20 @@ class ReadBatcher:
                     # per-ticket VV copy: sessions mutate their
                     # frontier in place on later pushes
                     out.append((tk, data, head.copy(), epoch))
+        # pruned-from-under-us pulls: serve off the oracle, outside the
+        # plane lock (the server lock must never nest under readplane)
+        for g in stale:
+            di, from_vv, tks = g[0], g[1], g[2]
+            with srv._lock:
+                data, new_vv, _first = srv._oracle_pull(di, from_vv, None)
+                ep1 = srv._committed_epoch
+            obs.counter(
+                "readbatch.floor_reroutes_total",
+                "window pulls re-routed to the oracle because "
+                "compaction pruned their index rows mid-flight",
+            ).inc(len(tks), family=srv.family)
+            for tk in tks:
+                out.append((tk, data, new_vv.copy(), ep1))
         # counter updates AFTER the plane lock (readbatch < readplane
         # in the declared order, so never nest the queue lock under it)
         if win_hits:

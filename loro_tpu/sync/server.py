@@ -584,6 +584,52 @@ class SyncServer:
         """Newest oracle-visible epoch (what pulls/acks cover)."""
         return self._committed_epoch
 
+    # -- compaction (resident rows + read-plane index retention) --------
+    def compact(self) -> int:
+        """Housekeeping pass: flush the fan-in, reclaim resident rows
+        under the replica ack floors (``ResidentServer.compact``), and
+        prune the device change-span index below the connected
+        sessions' pull frontiers (the ISSUE 11 retention follow-up).
+        Returns resident rows reclaimed."""
+        self.flush()
+        n = self.resident.compact()
+        self._compact_read_plane()
+        return n
+
+    def _compact_read_plane(self) -> int:
+        """Advance the read-plane index floors to the pointwise MEET of
+        every registered session's pull frontier per doc and drop the
+        rows below it: every connected client already holds them, so
+        only a NEW (or unregistered) client could need them — and its
+        below-floor frontier re-routes to the oracle through the
+        existing ``covers`` path.  Docs some session never pulled keep
+        their floor (an empty frontier meets everything to zero)."""
+        rb = self._readbatch
+        if rb is None or rb.closed:
+            return 0
+        with self._lock:
+            sessions = [
+                s for s in self._sessions.values()
+                if s._registered and not s.closed
+            ]
+            if not sessions:
+                return 0
+            floors: Dict[int, object] = {}
+            for di in range(self.n_docs):
+                vvs = [s._vv.get(di) for s in sessions]
+                if any(v is None or not len(v) for v in vvs):
+                    continue
+                floor = vvs[0].copy()
+                for v in vvs[1:]:
+                    floor = floor.meet(v)
+                if len(floor):
+                    floors[di] = floor
+        pruned = 0
+        with rb.plane._lock:
+            for di, floor in floors.items():
+                pruned += rb.plane.index.prune_below(di, floor)
+        return pruned
+
     # -- lifecycle -----------------------------------------------------
     def report(self) -> dict:
         """Compact outcome dict (the bench ``sync`` sidecar core).

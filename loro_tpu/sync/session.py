@@ -94,7 +94,8 @@ class Session:
         self._check_open()
         return self._server._push(self, di, data)
 
-    def pull(self, di: int, to_frontiers=None) -> bytes:
+    def pull(self, di: int, to_frontiers=None, min_epoch=None,
+             wait_s: float = 5.0) -> bytes:
         """Delta since this client's frontier for doc ``di`` as
         columnar-updates bytes (``client_doc.import_()`` them), or the
         first-sync snapshot when the oracle is shallow and the client
@@ -102,6 +103,13 @@ class Session:
         (``ExportMode.UpdatesInRange``) — e.g. replaying up to a known
         stable point; default is everything the server holds.  Advances
         the client frontier and acks the covered epoch.
+
+        ``min_epoch=`` is the read-your-writes gate (docs/REPLICATION.md):
+        block up to ``wait_s`` until the server's committed epoch
+        reaches it — pass a push ticket's epoch to read your own write
+        from a replication follower; typed ``errors.ReplicaLag`` on
+        timeout.  Trivial on a leader (tickets resolve at/after the
+        committed epoch).
 
         Batchable pulls (unbounded, frontier at/above the read-plane
         floor, not a shallow first-sync case) coalesce with concurrent
@@ -112,6 +120,8 @@ class Session:
         self._check_open()
         faultinject.check("sync_pull", doc=di)
         srv = self._server
+        if min_epoch is not None:
+            self._wait_min_epoch(di, int(min_epoch), wait_s)
         tk = hit = None
         with srv._lock:
             self._touch()
@@ -182,6 +192,33 @@ class Session:
             buckets=(64, 256, 1024, 4096, 16384, 65536, 262144, 1048576),
         ).observe(len(data), family=srv.family)
         return data
+
+    def _wait_min_epoch(self, di: int, min_epoch: int,
+                        wait_s: float) -> None:
+        """Block until the server's committed epoch reaches
+        ``min_epoch`` (replicated applies and local commits both
+        notify the wakeup condition); typed ``ReplicaLag`` on
+        timeout."""
+        srv = self._server
+        deadline = time.monotonic() + max(0.0, wait_s)
+        with srv._lock:
+            while srv._committed_epoch < min_epoch:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    from ..errors import ReplicaLag
+
+                    obs.counter(
+                        "repl.min_epoch_timeouts_total",
+                        "pull(min_epoch=) gates that timed out lagging",
+                    ).inc(family=srv.family)
+                    raise ReplicaLag(
+                        f"doc {di}: committed epoch "
+                        f"{srv._committed_epoch} < min_epoch "
+                        f"{min_epoch} after {wait_s}s — the replica is "
+                        "lagging; retry, or pull from the leader"
+                    )
+                srv._wakeup.wait(left)
+                self._check_open()
 
     def frontier(self, di: int) -> VersionVector:
         """The client's known frontier for doc ``di`` (copy)."""

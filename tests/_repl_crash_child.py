@@ -1,0 +1,91 @@
+"""Replication crash-child (NOT collected — no test_ prefix).
+
+Runs a durable, replication-enabled text leader::
+
+    python tests/_repl_crash_child.py <leader_dir> <rounds> [fsync_window]
+
+Group-commit WAL, ``replication.enable`` (so the fsync-visibility
+marker publishes for the cross-process follower), one deterministic
+insert per round (``round == epoch`` — no tombstone double-ticks), one
+flushed progress line per round (``round epoch durable_epoch``), then
+``<leader_dir>/../READY`` and a long sleep where the parent SIGKILLs
+it — a CPU-mesh process, between launches, per docs/RESILIENCE.md
+rule 1.
+
+As a module: ``oracle_text(n)`` regenerates the text after ``n``
+rounds for the parent's post-promotion gate.
+"""
+import os
+import os.path as _p
+import sys
+
+sys.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))  # repo root
+
+BASE = "repl base"
+
+
+def make_doc():
+    from loro_tpu import LoroDoc
+
+    d = LoroDoc(peer=4242)
+    d.get_text("t").insert(0, BASE)
+    d.commit()
+    return d
+
+
+def edit(d, r):
+    d.get_text("t").insert(0, f"r{r} ")
+    d.commit()
+
+
+def oracle_text(rounds: int) -> str:
+    """The doc text after ``rounds`` ingest rounds (round 1 pushes the
+    base history; rounds 2.. prepend their tag)."""
+    out = BASE
+    for r in range(2, rounds + 1):
+        out = f"r{r} " + out
+    return out
+
+
+def main(leader_dir: str, rounds: int, fsync_window: int = 4) -> None:
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from loro_tpu import replication
+    from loro_tpu.parallel.server import ResidentServer
+
+    d = make_doc()
+    srv = ResidentServer(
+        "text", 1, durable_dir=leader_dir, capacity=1 << 12,
+        durable_fsync="group", fsync_window=fsync_window,
+    )
+    replication.enable(srv, "leader")
+    cid = d.get_text("t").id
+    mark = {}
+    progress = os.path.join(_p.dirname(leader_dir), "progress")
+    for r in range(1, rounds + 1):
+        if r > 1:
+            edit(d, r)
+        payload = bytes(d.export_updates(mark))
+        mark = d.oplog_vv()
+        from loro_tpu.doc import strip_envelope
+
+        srv.ingest([strip_envelope(payload)], cid)
+        if r == rounds // 2:
+            srv.checkpoint()
+        with open(progress, "a") as f:
+            f.write(f"{r} {srv.epoch} {srv.durable_epoch}\n")
+            f.flush()
+    with open(os.path.join(_p.dirname(leader_dir), "READY"), "w") as f:
+        f.write("ready")
+    import time
+
+    time.sleep(300.0)  # the parent SIGKILLs us here, between launches
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]),
+         int(sys.argv[3]) if len(sys.argv) > 3 else 4)

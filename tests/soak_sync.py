@@ -28,7 +28,14 @@ plane) through many epochs of session churn:
   byte-for-byte against the oracle's own ExportMode.Updates export
   (the ISSUE 11 differential contract under churn), and the run
   asserts the device path actually served (readbatch windows > 0,
-  launches == windows).
+  launches == windows);
+- SOAK_SYNC_REPL=1 (implies DURABLE) rides a live WAL-shipping
+  follower per family server (docs/REPLICATION.md): every epoch the
+  leaders group-flush, the followers catch_up, lag must return to 0,
+  all five follower residents must match the host oracle and a
+  follower read-only session's pull must converge; after the final
+  epoch the text follower is PROMOTED (leader closed first) and the
+  now-writable server takes one more pushed round.
 """
 import os
 import os.path as _p
@@ -51,7 +58,8 @@ SESSIONS = int(os.environ.get("SOAK_SYNC_SESSIONS", "6"))
 DOCS = int(os.environ.get("SOAK_SYNC_DOCS", "3"))
 EPOCHS = int(os.environ.get("SOAK_SYNC_EPOCHS", "8"))
 SEED = int(os.environ.get("SOAK_SYNC_SEED", "0"))
-DURABLE = os.environ.get("SOAK_SYNC_DURABLE", "0") == "1"
+REPL = os.environ.get("SOAK_SYNC_REPL", "0") == "1"
+DURABLE = os.environ.get("SOAK_SYNC_DURABLE", "0") == "1" or REPL
 DEVPULL = os.environ.get("SOAK_SYNC_DEVPULL", "0") == "1"
 
 FAMILIES = ("text", "map", "tree", "counter", "movable")
@@ -235,6 +243,60 @@ for i in range(DOCS):
 for tk in boot:
     tk.epoch(120)
 
+followers = {}
+fol_reader = None
+fol_client = None
+if REPL:
+    from loro_tpu import replication
+    from loro_tpu.replication import Follower
+
+    for fam in FAMILIES:
+        replication.enable(servers[fam].resident, f"leader-{fam}")
+        servers[fam].resident.flush_durable()
+        followers[fam] = Follower(
+            os.path.join(_soak_dir, fam),
+            os.path.join(_soak_dir, fam + "-follower"),
+            follower_id=f"soak-{fam}", leader=servers[fam].resident,
+        )
+    fol_reader = followers["text"].sync.connect()
+    fol_client = LoroDoc(peer=7777)
+    fol_client.import_(fol_reader.pull(0))
+    print("replication: all five family followers bootstrapped")
+
+
+def _gate_followers(epoch):
+    for fam in FAMILIES:
+        servers[fam].resident.flush_durable()
+        followers[fam].catch_up()
+        lead = servers[fam].resident
+        assert followers[fam].applied_epoch == lead.durable_epoch, \
+            f"repl {fam} epoch {epoch}: follower behind the durable mark"
+        assert followers[fam].lag_epochs == 0, f"repl {fam} epoch {epoch}"
+    texts = followers["text"].resident.texts()
+    mvals = followers["map"].resident.root_value_maps("m")
+    parents = followers["tree"].resident.parent_maps()
+    cvals = followers["counter"].resident.value_maps()
+    mls = followers["movable"].resident.value_lists()
+    for i in range(DOCS):
+        o = oracle[i]
+        assert texts[i] == o.get_text("t").to_string(), \
+            f"repl text epoch {epoch} doc {i}"
+        assert mvals[i] == o.get_map("m").get_value(), \
+            f"repl map epoch {epoch} doc {i}"
+        tr = o.get_tree("tr")
+        assert parents[i] == {x: tr.parent(x) for x in tr.nodes()}, \
+            f"repl tree epoch {epoch} doc {i}"
+        c = o.get_counter("c")
+        assert cvals[i].get(c.id, 0.0) == c.get_value(), \
+            f"repl counter epoch {epoch} doc {i}"
+        assert mls[i] == o.get_movable_list("ml").get_value(), \
+            f"repl movable epoch {epoch} doc {i}"
+    # a follower read-only session converges like any leader session
+    fol_client.import_(fol_reader.pull(0))
+    assert fol_client.get_deep_value() == oracle[0].get_deep_value(), \
+        f"repl follower client epoch {epoch} diverged"
+
+
 stalled: set = set()
 for epoch in range(EPOCHS):
     tickets = []
@@ -263,6 +325,10 @@ for epoch in range(EPOCHS):
             srv.flush()
             srv.resident.checkpoint()
         print(f"  epoch {epoch}: checkpointed all five families")
+    if REPL:
+        _gate_followers(epoch)
+        lag = max(f.report()["lag_epochs"] for f in followers.values())
+        print(f"  epoch {epoch}: followers caught up (lag {lag})")
     print(f"epoch {epoch}: {len(clients)} sessions, all 5 family servers "
           f"match the host oracle ({time.time()-t0:.0f}s)")
 
@@ -270,6 +336,8 @@ for epoch in range(EPOCHS):
 for cl in clients:
     cl.pull()
 _gate("final", clients)
+if REPL:
+    _gate_followers("final")
 
 if DEVPULL:
     # the device read plane must actually have served (not silently
@@ -283,6 +351,29 @@ if DEVPULL:
         assert rb["degraded_windows"] == 0, f"{fam}: degraded windows"
     print("devpull: all five family servers served byte-identical "
           "batched device pulls")
+
+if REPL:
+    # failover: retire the text leader, promote its follower, and push
+    # one more round through the now-writable front
+    servers["text"].close()
+    promoted = followers["text"].promote("soak-survivor")
+    assert promoted.texts() == [
+        oracle[i].get_text("t").to_string() for i in range(DOCS)
+    ], "promoted follower diverged from the oracle"
+    wdoc = LoroDoc(peer=8888)
+    wsess = followers["text"].sync.connect()
+    wdoc.import_(wsess.pull(0))
+    wmark = wdoc.oplog_vv()
+    wdoc.get_text("t").insert(0, "post-promotion ")
+    wdoc.commit()
+    wsess.push(0, wdoc.export_updates(wmark)).epoch(120)
+    assert promoted.texts()[0] == wdoc.get_text("t").to_string(), \
+        "post-promotion push did not land"
+    assert promoted.durable_epoch == promoted.epoch
+    for fol in followers.values():
+        fol.close()
+    print("replication: promotion flipped the text follower writable "
+          "and served a pushed round")
 
 if DURABLE:
     import shutil

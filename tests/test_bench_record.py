@@ -139,6 +139,22 @@ def _fat_checkpoint():
               "rows_per_round": 96, "skew": "85/15 over 4-doc core",
               "rows_per_sec_all_hot": 940_000,
               "rows_per_sec_tiered": 850_000, "note": "t" * 300},
+        repl_readers=32,
+        repl_pulls_per_sec=1495.2,
+        repl_pulls_per_sec_leader_only=749.5,
+        repl_read_scaling_x=1.99,
+        repl_lag_ms_p50=34.7,
+        repl_lag_ms_p99=51.4,
+        repl_promotion_downtime_ms=22.9,
+        repl={"readers": 32, "docs": 4, "epochs": 6, "warm_epochs": 1,
+              "leader_pulls_per_sec": 749.5,
+              "aggregate_pulls_per_sec": 1495.2,
+              "lag_ms_p50": 34.7, "lag_ms_p99": 51.4,
+              "promotion_downtime_ms": 22.9,
+              "follower": {"follower_id": "bench-child",
+                           "applied_epoch": 14, "lag_epochs": 0,
+                           "rounds_applied": 12, "torn_tails": 0},
+              "note": "f" * 300},
         shard_count=8,
         shard_rows_per_sec=900_000,
         shard_scaling_x=2.4,
@@ -176,12 +192,16 @@ class TestFlagshipLine:
                   "shard_count", "shard_scaling_x", "shard_rows_per_sec",
                   "tier_hit_rate", "tier_revive_ms_p50",
                   "tier_revive_ms_p99", "tier_vs_all_hot",
-                  "tier_hot_path_ratio"):
+                  "tier_hot_path_ratio",
+                  "repl_readers", "repl_pulls_per_sec",
+                  "repl_pulls_per_sec_leader_only", "repl_read_scaling_x",
+                  "repl_lag_ms_p50", "repl_lag_ms_p99",
+                  "repl_promotion_downtime_ms"):
             assert k in back, k
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
         for k in ("metrics", "resilience", "pipeline", "rank", "sync",
-                  "shard", "tier", "readplane", "baseline_note",
+                  "shard", "tier", "readplane", "repl", "baseline_note",
                   "roofline_note", "resident_pipeline_note"):
             assert k in side, k
             assert k not in back, k

@@ -1,0 +1,914 @@
+"""WAL-shipping replication (loro_tpu/replication/, docs/REPLICATION.md):
+leader fencing, visibility-gated shipping, follower apply loops,
+read-only serving, retention pins and fault-injected failover.
+
+The acceptance contract (ISSUE 12): follower batch state AND follower
+``Session.pull()`` bytes identical to the leader's at the same epoch
+for all five container families — serial and pipelined/group-commit
+leaders, sharded with a mid-stream migration — with a SIGKILLed-leader
+promotion that loses zero rounds at/under the acked durable watermark.
+"""
+import io
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import _persist_crash_child as crash
+import _repl_crash_child as rcrash
+from loro_tpu import LoroDoc, replication
+from loro_tpu.errors import (
+    FencedLeader,
+    NotLeader,
+    PersistError,
+    ReplicaLag,
+    ReplicationError,
+    StaleFollower,
+)
+from loro_tpu.obs import metrics as obs
+from loro_tpu.parallel.server import ResidentServer
+from loro_tpu.parallel.sharded import ShardedResidentServer
+from loro_tpu.persist.inspect import inspect_dir
+from loro_tpu.replication import Follower, ReplicationManifest, ShardedFollower
+from loro_tpu.resilience import faultinject
+from loro_tpu.sync import SyncServer
+
+FAMILIES = crash.FAMILIES
+CAPS = crash.CAPS
+
+
+def _drive(srv, d, fam, rounds, start=1, mark=None, ckpt_at=None):
+    """Deterministic ingest rounds (the persist crash-child stream)."""
+    for r in range(start, start + rounds):
+        if mark is None:
+            chs = d.oplog.changes_in_causal_order()
+        else:
+            crash.apply_edit(d, fam, r)
+            chs = d.oplog.changes_between(mark, d.oplog_vv())
+        mark = d.oplog_vv()
+        srv.ingest([chs], crash.container_id(fam, d))
+        if ckpt_at is not None and r == ckpt_at:
+            srv.checkpoint()
+    return mark
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# manifest: leader token + follower ack table
+# ---------------------------------------------------------------------------
+
+
+class TestManifest:
+    def test_claim_bump_and_steal_refused(self, tmp_path):
+        man = ReplicationManifest(str(tmp_path))
+        assert man.claim_leader("a") == 1
+        assert man.claim_leader("a") == 1  # idempotent re-claim
+        with pytest.raises(NotLeader) as ei:
+            man.claim_leader("b")  # silent steal refused typed
+        assert ei.value.leader == "a"
+        assert man.bump_token("b") == 2  # promotion-granted takeover
+        assert man.leader() == (2, "b")
+        # an explicitly granted token claims over the old holder
+        assert man.claim_leader("c", token=3) == 3
+
+    def test_bump_token_race_mints_distinct_tokens(self, tmp_path):
+        """Two promoters racing from separate processes must never
+        mint EQUAL tokens (equal tokens fence nobody — split brain):
+        the token grant is an O_EXCL claim-file CAS, so a token a
+        racing promoter already claimed is skipped and the manifest
+        converges to the highest granted token."""
+        man = ReplicationManifest(str(tmp_path))
+        assert man.claim_leader("a") == 1
+        # a racing promoter claimed token 2 but has not written the
+        # manifest yet (crashed, or mid-promotion in another process)
+        open(tmp_path / ".token-2.claim", "w").close()
+        assert man.bump_token("b") == 3  # never the contested 2
+        assert man.leader() == (3, "b")
+        # the fence semantic holds: the racer's token 2 is fenced
+        # (cur 3 > 2) the moment it checks, and a FURTHER promotion
+        # starts above everything ever claimed
+        assert man.bump_token("c") == 4
+        assert not (tmp_path / ".token-3.claim").exists()  # retired
+
+    def test_ack_floor_and_staleness_cutoff(self, tmp_path):
+        clk = FakeClock()
+        man = ReplicationManifest(str(tmp_path), clock=clk, stale_after=60)
+        man.ack_follower("f1", 5)
+        man.ack_follower("f2", 9)
+        assert man.pinned_floor() == 5
+        man.ack_follower("f1", 3)  # acks are monotone
+        assert man.followers()["f1"]["acked_epoch"] == 5
+        clk.t += 30
+        man.ack_follower("f2", 11)
+        clk.t += 45  # f1 last seen 75s ago > 60s cutoff; f2 fresh
+        assert man.pinned_floor() == 11
+        man.drop_follower("f2")
+        assert man.pinned_floor() is None  # only stale f1 left
+
+
+# ---------------------------------------------------------------------------
+# ship visibility: the durable-tail protocol
+# ---------------------------------------------------------------------------
+
+
+class TestShipVisibility:
+    def test_follower_never_applies_past_durable_watermark(self, tmp_path):
+        """Group-commit leader: unsynced tail bytes are invisible to
+        the shipper, so the follower's applied epoch can never pass the
+        leader's ``durable_epoch``."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(
+            fam, 1, durable_dir=str(tmp_path / "L"), **CAPS[fam],
+            durable_fsync="group", fsync_window=64,
+        )
+        try:
+            replication.enable(srv, "leader")
+            mark = _drive(srv, d, fam, rounds=1)
+            srv.flush_durable()  # meta + round 1 durable: bootstrapable
+            fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                           leader=srv)
+            try:
+                mark = _drive(srv, d, fam, rounds=3, start=2, mark=mark)
+                assert srv.durable_epoch < srv.epoch  # tail unsynced
+                fol.catch_up()
+                assert fol.applied_epoch == srv.durable_epoch
+                assert fol.lag_epochs == 0  # lag is vs the DURABLE mark
+                srv.flush_durable()
+                fol.catch_up()
+                assert fol.applied_epoch == srv.epoch == srv.durable_epoch
+                assert fol.lag_epochs == 0
+                assert crash.read_server(fol.resident, fam) == \
+                    crash.read_oracle(d, fam)
+            finally:
+                fol.close()
+        finally:
+            srv.close()
+
+    def test_cross_process_marker_visibility(self, tmp_path):
+        """A follower WITHOUT a live leader object (another process)
+        ships only what the published ``.visible`` marker covers."""
+        fam = "map"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(
+            fam, 1, durable_dir=str(tmp_path / "L"), **CAPS[fam],
+            durable_fsync="group", fsync_window=64,
+        )
+        try:
+            replication.enable(srv, "leader")
+            mark = _drive(srv, d, fam, rounds=2)
+            srv.flush_durable()  # publishes the marker
+            fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                           leader=None)  # marker-gated, like a remote
+            try:
+                assert fol.applied_epoch == 2
+                _drive(srv, d, fam, rounds=2, start=3, mark=mark)
+                fol.catch_up()  # marker still at epoch 2
+                assert fol.applied_epoch == 2
+                srv.flush_durable()
+                fol.catch_up()
+                assert fol.applied_epoch == 4
+                assert crash.read_server(fol.resident, fam) == \
+                    crash.read_oracle(d, fam)
+            finally:
+                fol.close()
+        finally:
+            srv.close()
+
+    def test_off_mode_publishes_marker_like_in_process_extent(self, tmp_path):
+        """``fsync="off"`` disclaims durability, so its visibility rule
+        is appended-bytes — and BOTH follower paths must see the same
+        tail: the in-process ``visible_extent`` and the cross-process
+        ``.visible`` marker may never disagree for one log."""
+        import json as _json
+
+        from loro_tpu.persist.wal import WriteAheadLog
+
+        wal = WriteAheadLog(str(tmp_path), fsync=False)
+        wal.publish_visibility = True
+        wal._append(b"round-payload", rtype="round")
+        ext = wal.visible_extent()
+        assert ext[-1][2] == wal._active.good_bytes > 0
+        with open(tmp_path / ".visible") as f:
+            marker = _json.load(f)
+        assert marker["seg"] == wal._active.index
+        assert marker["off"] == ext[-1][2]  # marker == in-process tail
+        wal.close()
+
+
+# ---------------------------------------------------------------------------
+# THE differential gate: five families, serial + pipelined leaders
+# ---------------------------------------------------------------------------
+
+
+def _pull_all(sess, client):
+    data = sess.pull(0)
+    client.import_(data)
+    return data
+
+
+@pytest.mark.parametrize("fam", FAMILIES)
+class TestFollowerDifferential:
+    def test_batch_state_and_pull_bytes_identical(self, fam, tmp_path):
+        """Serial durable leader fronted by a SyncServer; the follower
+        must match batch state AND serve byte-identical pulls at equal
+        epochs (same client frontier both sides)."""
+        d = crash.make_doc(fam)
+        ldir = str(tmp_path / "L")
+        lead = SyncServer(
+            fam, 1, cid=crash.container_id(fam, d), pipeline=False,
+            durable_dir=ldir, **CAPS[fam],
+        )
+        fol = None
+        try:
+            replication.enable(lead.resident, "leader")
+            ls = lead.connect()
+            mark = {}
+            payload = bytes(d.export_updates(mark))
+            mark = d.oplog_vv()
+            ls.push(0, payload).epoch(30)
+            fol = Follower(ldir, str(tmp_path / "F"), leader=lead.resident)
+            fs = fol.sync.connect()
+            lc, fc = LoroDoc(peer=71), LoroDoc(peer=72)
+            lb, fb = _pull_all(ls2 := lead.connect(), lc), _pull_all(fs, fc)
+            assert lb == fb  # first full pull, same empty frontier
+            for r in range(2, 8):
+                crash.apply_edit(d, fam, r)
+                payload = bytes(d.export_updates(mark))
+                mark = d.oplog_vv()
+                ls.push(0, payload).epoch(30)
+                if r == 4:
+                    lead.resident.checkpoint()
+                fol.catch_up()
+                assert fol.applied_epoch == lead.resident.epoch
+                # batch state identical
+                assert crash.read_server(fol.resident, fam) == \
+                    crash.read_server(lead.resident, fam) == \
+                    crash.read_oracle(d, fam)
+                # pull bytes identical at the same frontier
+                lb, fb = _pull_all(ls2, lc), _pull_all(fs, fc)
+                assert lb == fb
+            assert crash.read_oracle(lc, fam) == crash.read_oracle(d, fam)
+            assert fol.ckpts_applied >= 1  # the boundary replicated
+        finally:
+            if fol is not None:
+                fol.close()
+            lead.close()
+
+    def test_pipelined_group_commit_leader(self, fam, tmp_path):
+        """Pipelined fan-in + WAL group commit on the leader: the
+        follower still converges byte-identically once the window
+        flushes."""
+        d = crash.make_doc(fam)
+        ldir = str(tmp_path / "L")
+        lead = SyncServer(
+            fam, 1, cid=crash.container_id(fam, d), pipeline=True,
+            durable_dir=ldir, durable_fsync="group", fsync_window=4,
+            **CAPS[fam],
+        )
+        fol = None
+        try:
+            replication.enable(lead.resident, "leader")
+            ls = lead.connect()
+            mark = {}
+            payload = bytes(d.export_updates(mark))
+            mark = d.oplog_vv()
+            ls.push(0, payload).epoch(30)
+            lead.flush()
+            lead.resident.flush_durable()
+            fol = Follower(ldir, str(tmp_path / "F"), leader=lead.resident)
+            fs = fol.sync.connect()
+            lc, fc = LoroDoc(peer=81), LoroDoc(peer=82)
+            ls2 = lead.connect()
+            _pull_all(ls2, lc), _pull_all(fs, fc)
+            for r in range(2, 10):
+                crash.apply_edit(d, fam, r)
+                payload = bytes(d.export_updates(mark))
+                mark = d.oplog_vv()
+                ls.push(0, payload).epoch(30)
+            lead.flush()
+            lead.resident.flush_durable()
+            fol.catch_up()
+            assert fol.applied_epoch == lead.resident.durable_epoch
+            assert crash.read_server(fol.resident, fam) == \
+                crash.read_server(lead.resident, fam) == \
+                crash.read_oracle(d, fam)
+            lb, fb = _pull_all(ls2, lc), _pull_all(fs, fc)
+            assert lb == fb
+            assert crash.read_oracle(fc, fam) == crash.read_oracle(d, fam)
+        finally:
+            if fol is not None:
+                fol.close()
+            lead.close()
+
+
+class TestShardedFollower:
+    def test_sharded_differential_with_migration(self, tmp_path):
+        """Sharded leader (per-shard WAL streams) with a mid-stream
+        live migration: the follower tracks ``sharding.json`` and
+        merges reads identical to the leader's."""
+        fam, n_docs = "text", 4
+        docs = [crash.make_doc(fam, i) for i in range(n_docs)]
+        lead = ShardedResidentServer(
+            fam, n_docs, shards=2, durable_dir=str(tmp_path / "L"),
+            **CAPS[fam],
+        )
+        fol = None
+        try:
+            replication.enable(lead, "leader")
+            marks = [None] * n_docs
+            cid = crash.container_id(fam, docs[0])
+
+            def round_(r):
+                di = r % n_docs
+                d = docs[di]
+                if marks[di] is None:
+                    chs = d.oplog.changes_in_causal_order()
+                else:
+                    crash.apply_edit(d, fam, r)
+                    chs = d.oplog.changes_between(marks[di], d.oplog_vv())
+                marks[di] = d.oplog_vv()
+                ups = [None] * n_docs
+                ups[di] = chs
+                lead.ingest(ups, cid)
+
+            for r in range(8):
+                round_(r)
+            fol = ShardedFollower(str(tmp_path / "L"), str(tmp_path / "F"),
+                                  leader=lead)
+            fol.catch_up()
+            assert fol.texts() == lead.texts()
+            # live migration mid-stream, then more rounds
+            src, _l = lead.placement.place(0)
+            lead.migrate(0, 1 - src)
+            for r in range(8, 14):
+                round_(r)
+            lead.checkpoint()
+            for r in range(14, 17):
+                round_(r)
+            fol.catch_up()
+            assert fol.applied_epoch == lead.durable_epoch
+            assert fol.lag_epochs == 0
+            assert fol.texts() == lead.texts() == [
+                crash.read_oracle(d, fam)[0] for d in docs
+            ]
+            got_shard, _ = fol.placement.place(0)
+            assert got_shard == 1 - src  # placement tracked the move
+        finally:
+            if fol is not None:
+                fol.close()
+            lead.close()
+
+
+# ---------------------------------------------------------------------------
+# read-only serving: NotLeader, read-your-writes, promotion flip
+# ---------------------------------------------------------------------------
+
+
+class TestReadOnlyServing:
+    def _leader_and_follower(self, tmp_path, fam="text"):
+        d = crash.make_doc(fam)
+        lead = SyncServer(
+            fam, 1, cid=crash.container_id(fam, d), pipeline=False,
+            durable_dir=str(tmp_path / "L"), **CAPS[fam],
+        )
+        replication.enable(lead.resident, "leader")
+        ls = lead.connect()
+        mark = {}
+        ls.push(0, bytes(d.export_updates(mark))).epoch(30)
+        mark = d.oplog_vv()
+        fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                       leader=lead.resident)
+        return d, lead, ls, mark, fol
+
+    def test_push_raises_not_leader_with_identity(self, tmp_path):
+        d, lead, ls, mark, fol = self._leader_and_follower(tmp_path)
+        try:
+            fs = fol.sync.connect()
+            crash.apply_edit(d, "text", 2)
+            with pytest.raises(NotLeader) as ei:
+                fs.push(0, bytes(d.export_updates(mark)))
+            assert ei.value.leader == "leader"
+            # the session survives the typed refusal and keeps reading
+            c = LoroDoc(peer=91)
+            c.import_(fs.pull(0))
+            assert c.get_text("t").to_string() == "crash base text"
+        finally:
+            fol.close()
+            lead.close()
+
+    def test_min_epoch_read_your_writes(self, tmp_path):
+        d, lead, ls, mark, fol = self._leader_and_follower(tmp_path)
+        try:
+            fs = fol.sync.connect()
+            c = LoroDoc(peer=92)
+            c.import_(fs.pull(0))
+            crash.apply_edit(d, "text", 2)
+            ep = ls.push(0, bytes(d.export_updates(mark))).epoch(30)
+            # the follower has not applied ep yet: a gated pull times
+            # out typed instead of serving a stale read
+            with pytest.raises(ReplicaLag):
+                fs.pull(0, min_epoch=ep, wait_s=0.05)
+            fol.catch_up()
+            c.import_(fs.pull(0, min_epoch=ep))
+            assert c.get_text("t").to_string() == \
+                d.get_text("t").to_string()
+        finally:
+            fol.close()
+            lead.close()
+
+    def test_poll_wakes_on_replicated_commit(self, tmp_path):
+        import threading
+
+        d, lead, ls, mark, fol = self._leader_and_follower(tmp_path)
+        try:
+            fs = fol.sync.connect()
+            fs.pull(0)
+            crash.apply_edit(d, "text", 2)
+            ep = ls.push(0, bytes(d.export_updates(mark))).epoch(30)
+            got = {}
+
+            def poller():
+                got["ev"] = fs.poll(timeout=10)
+
+            t = threading.Thread(target=poller)
+            t.start()
+            time.sleep(0.1)
+            fol.catch_up()
+            t.join(10)
+            assert not t.is_alive()
+            assert got["ev"]["docs"].get(0) == ep
+        finally:
+            fol.close()
+            lead.close()
+
+    def test_promotion_flips_sessions_writable(self, tmp_path):
+        d, lead, ls, mark, fol = self._leader_and_follower(tmp_path)
+        try:
+            fs = fol.sync.connect()
+            c = LoroDoc(peer=93)
+            c.import_(fs.pull(0))
+            lead.close()  # leader retires cleanly
+            srv = fol.promote("f1")
+            assert srv is fol.resident and fol.promoted
+            crash.apply_edit(d, "text", 2)
+            ep = fs.push(0, bytes(d.export_updates(mark))).epoch(30)
+            assert ep > 0
+            reader = fol.sync.connect()
+            c.import_(reader.pull(0))
+            assert c.get_text("t").to_string() == \
+                d.get_text("t").to_string()
+            # the new WAL journals the promoted round durably
+            assert srv.durable_epoch == srv.epoch
+        finally:
+            fol.close()
+
+
+# ---------------------------------------------------------------------------
+# fencing + fault sites
+# ---------------------------------------------------------------------------
+
+
+class TestFencingAndFaults:
+    def setup_method(self):
+        faultinject.clear()
+
+    def teardown_method(self):
+        faultinject.clear()
+
+    def _leader(self, tmp_path, fam="text"):
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path / "L"),
+                             **CAPS[fam])
+        replication.enable(srv, "leader")
+        mark = _drive(srv, d, fam, rounds=3)
+        return d, srv, mark
+
+    def test_fenced_zombie_append_fail_stops_typed(self, tmp_path):
+        """Satellite: a fenced zombie leader's next append fail-stops
+        typed FencedLeader with NO partial record in its WAL."""
+        d, srv, mark = self._leader(tmp_path)
+        fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                       leader=srv)
+        try:
+            fol.catch_up()
+            fol.promote("f1")
+            wal_dir = str(tmp_path / "L" / "wal")
+            sizes = {
+                n: os.path.getsize(os.path.join(wal_dir, n))
+                for n in os.listdir(wal_dir) if n.endswith(".log")
+            }
+            n0 = obs.counter("repl.fenced_appends_total").get()
+            with pytest.raises(FencedLeader):
+                _drive(srv, d, "text", rounds=1, start=4, mark=mark)
+            assert obs.counter("repl.fenced_appends_total").get() == n0 + 1
+            # no partial record: every zombie segment byte-unchanged
+            for n, sz in sizes.items():
+                assert os.path.getsize(os.path.join(wal_dir, n)) == sz
+            # fail-stop: journaling detached, later ingests raise typed
+            with pytest.raises(PersistError):
+                _drive(srv, d, "text", rounds=1, start=4, mark=mark)
+        finally:
+            fol.close()
+            srv.close()
+
+    def test_mid_ship_crash_resumes_from_acked_offset(self, tmp_path):
+        d, srv, mark = self._leader(tmp_path)
+        fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                       leader=srv)
+        try:
+            mark = _drive(srv, d, "text", rounds=2, start=4, mark=mark)
+            faultinject.inject("repl_ship", times=1)
+            with pytest.raises(faultinject.InjectedFault):
+                fol.catch_up()
+            # the crash applied nothing; a clean pass resumes and lands
+            fol.catch_up()
+            assert fol.applied_epoch == srv.epoch
+            assert crash.read_server(fol.resident, "text") == \
+                crash.read_oracle(d, "text")
+        finally:
+            fol.close()
+            srv.close()
+
+    def test_torn_shipped_tail_truncates_like_reopen(self, tmp_path):
+        """Satellite: a mangled shipped tail truncates at the follower
+        exactly like WAL reopen, and the next clean pass converges."""
+        d, srv, mark = self._leader(tmp_path)
+        fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                       leader=srv)
+        try:
+            mark = _drive(srv, d, "text", rounds=2, start=4, mark=mark)
+            faultinject.inject("repl_ship", action="bitflip", times=1)
+            n0 = obs.counter("repl.torn_shipped_tails_total").get()
+            fol.catch_up()  # corrupt bytes land, scan truncates them
+            assert obs.counter(
+                "repl.torn_shipped_tails_total").get() > n0
+            assert fol.torn_tails >= 1
+            fol.catch_up()  # re-ships clean bytes from the source
+            assert fol.applied_epoch == srv.epoch
+            assert crash.read_server(fol.resident, "text") == \
+                crash.read_oracle(d, "text")
+        finally:
+            fol.close()
+            srv.close()
+
+    def test_repl_apply_fault_fails_pass_then_resumes(self, tmp_path):
+        d, srv, mark = self._leader(tmp_path)
+        fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                       leader=srv)
+        try:
+            mark = _drive(srv, d, "text", rounds=3, start=4, mark=mark)
+            faultinject.inject("repl_apply", times=1)
+            with pytest.raises(faultinject.InjectedFault):
+                fol.catch_up()
+            fol.catch_up()
+            assert fol.applied_epoch == srv.epoch
+            assert crash.read_server(fol.resident, "text") == \
+                crash.read_oracle(d, "text")
+        finally:
+            fol.close()
+            srv.close()
+
+    def test_repl_promote_fault_leaves_promotion_retryable(self, tmp_path):
+        d, srv, mark = self._leader(tmp_path)
+        fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                       leader=srv)
+        try:
+            faultinject.inject("repl_promote", times=1)
+            with pytest.raises(faultinject.InjectedFault):
+                fol.promote("f1")
+            assert not fol.promoted
+            # the crash fired BEFORE the token bump: the old leader is
+            # not fenced yet and a retried promote starts clean
+            assert ReplicationManifest(
+                str(tmp_path / "L")).leader() == (1, "leader")
+            new = fol.promote("f1")
+            assert fol.promoted
+            assert crash.read_server(new, "text") == \
+                crash.read_oracle(d, "text")
+        finally:
+            fol.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# retention: follower acks pin WAL pruning; staleness cutoff
+# ---------------------------------------------------------------------------
+
+
+class TestRetention:
+    def test_follower_ack_pins_wal_pruning(self, tmp_path):
+        """Satellite: a registered fresh follower's acked epoch clamps
+        ``prune_below`` at checkpoint time, so the segments it still
+        needs survive — and it then catches up through them."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path / "L"),
+                             **CAPS[fam])
+        fol = None
+        try:
+            clk = FakeClock()
+            replication.enable(srv, "leader", clock=clk)
+            mark = _drive(srv, d, fam, rounds=2)
+            fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                           leader=srv, clock=clk)
+            fol.catch_up()  # acked at epoch 2
+            # enough checkpoints that the ladder would prune early
+            # segments — the fresh follower's ack must clamp it
+            r = 3
+            for _ in range(4):
+                mark = _drive(srv, d, fam, rounds=3, start=r, mark=mark)
+                r += 3
+                srv.checkpoint()
+            log = srv._durable
+            assert log.wal.pruned_below <= 2  # clamped at the ack
+            kept = {e for s in log.wal.segments()
+                    for e in ([s.min_epoch] if s.min_epoch else [])}
+            assert min(kept, default=99) <= 3  # rounds 3.. retained
+            fol.catch_up()
+            assert fol.applied_epoch == srv.epoch
+            assert crash.read_server(fol.resident, fam) == \
+                crash.read_oracle(d, fam)
+        finally:
+            if fol is not None:
+                fol.close()
+            srv.close()
+
+    def test_stale_follower_stops_pinning_then_fails_typed(self, tmp_path):
+        """Satellite: past the staleness cutoff the dead follower's pin
+        drops, the WAL prunes, and the resumed follower fails typed
+        StaleFollower instead of fabricating a truncated history."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path / "L"),
+                             **CAPS[fam])
+        fol = None
+        try:
+            clk = FakeClock()
+            replication.enable(srv, "leader", clock=clk, stale_after=60)
+            mark = _drive(srv, d, fam, rounds=2)
+            fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                           leader=srv, clock=clk, stale_after=60)
+            fol.catch_up()
+            clk.t += 120  # the follower goes silent past the cutoff
+            r = 3
+            for _ in range(4):  # ladder retires the early rungs
+                mark = _drive(srv, d, fam, rounds=3, start=r, mark=mark)
+                r += 3
+                srv.checkpoint()  # prunes: the stale pin no longer holds
+            assert srv._durable.wal.pruned_below > 2
+            _drive(srv, d, fam, rounds=1, start=r, mark=mark)
+            with pytest.raises(StaleFollower):
+                fol.catch_up()
+        finally:
+            if fol is not None:
+                fol.close()
+            srv.close()
+
+    def test_bootstrap_survives_stray_empty_segment(self, tmp_path):
+        """A ship pass that crashed between creating a local segment
+        file and its first write leaves a 0-byte ``seg-NN.log``; if the
+        leader prunes that segment, follower re-construction must not
+        crash (the prune sweep runs before ``_applied_off`` exists at
+        bootstrap) — the first post-init pass settles the stray."""
+        from loro_tpu.persist.wal import _seg_name
+
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path / "L"),
+                             **CAPS[fam])
+        fol = None
+        try:
+            replication.enable(srv, "leader")
+            mark = _drive(srv, d, fam, rounds=2)
+            r = 3
+            for _ in range(4):  # ladder retires + prunes early segments
+                mark = _drive(srv, d, fam, rounds=3, start=r, mark=mark)
+                r += 3
+                srv.checkpoint()
+            wal = srv._durable.wal
+            live = {s.index for s in wal.segments()}
+            pruned_idx = 0
+            assert pruned_idx not in live and max(live) > pruned_idx
+            # fabricate the crashed pass: a 0-byte local copy of the
+            # pruned segment, created before the follower ever ran
+            fdir = tmp_path / "F"
+            (fdir / "wal").mkdir(parents=True)
+            (fdir / "wal" / _seg_name(pruned_idx)).touch()
+            fol = Follower(str(tmp_path / "L"), str(fdir), leader=srv)
+            fol.catch_up()  # settles: stray unlinked, stream applies
+            assert not (fdir / "wal" / _seg_name(pruned_idx)).exists()
+            assert fol.applied_epoch == srv.epoch
+            assert crash.read_server(fol.resident, fam) == \
+                crash.read_oracle(d, fam)
+        finally:
+            if fol is not None:
+                fol.close()
+            srv.close()
+
+    def test_inspect_reports_followers_and_pinned_floor(self, tmp_path):
+        """Satellite: ``persist.inspect`` prints per-follower lag and
+        the pinned prune floor from ``replication.json``."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = ResidentServer(fam, 1, durable_dir=str(tmp_path / "L"),
+                             **CAPS[fam])
+        fol = None
+        try:
+            replication.enable(srv, "leader")
+            mark = _drive(srv, d, fam, rounds=2)
+            fol = Follower(str(tmp_path / "L"), str(tmp_path / "F"),
+                           leader=srv)
+            fol.catch_up()
+            _drive(srv, d, fam, rounds=2, start=3, mark=mark)
+            out = io.StringIO()
+            rc = inspect_dir(str(tmp_path / "L"), out=out)
+            text = out.getvalue()
+            assert rc == 0
+            assert "leader_token=1" in text and "'leader'" in text
+            assert "follower follower: acked e2" in text
+            assert f"lag {srv.epoch - 2} round(s)" in text
+            assert "pinned prune floor: e2" in text
+        finally:
+            if fol is not None:
+                fol.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# read-plane index retention (the ISSUE 11 follow-up satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestExportIndexRetention:
+    def test_compact_prunes_index_below_ack_floors(self):
+        """``SyncServer.compact()`` drops device index rows every
+        connected session already holds; pruned history re-routes to
+        the oracle (count guard: no new launch serves it) and a fresh
+        client still pulls byte-correct state."""
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = SyncServer(fam, 1, cid=crash.container_id(fam, d),
+                         pipeline=False, **CAPS[fam])
+        try:
+            s1 = srv.connect()
+            mark = {}
+            s1.push(0, bytes(d.export_updates(mark))).epoch(30)
+            mark = d.oplog_vv()
+            for r in range(2, 6):
+                crash.apply_edit(d, fam, r)
+                s1.push(0, bytes(d.export_updates(mark))).epoch(30)
+                mark = d.oplog_vv()
+            r1 = srv.connect()
+            c1 = LoroDoc(peer=61)
+            c1.import_(r1.pull(0))
+            idx = srv._readbatch.plane.index
+            rows_before = int(idx._n[0])
+            assert rows_before > 0
+            srv.compact()
+            assert idx.rows_pruned > 0
+            assert int(idx._n[0]) < rows_before
+            # a NEW client's empty frontier is now below the floor:
+            # covers() routes it to the oracle, no index launch
+            s2 = srv.connect()
+            launches0 = idx.launches
+            c2 = LoroDoc(peer=62)
+            c2.import_(s2.pull(0))
+            assert idx.launches == launches0  # count guard: oracle path
+            assert crash.read_oracle(c2, fam) == crash.read_oracle(d, fam)
+            # the caught-up client keeps riding the device plane
+            crash.apply_edit(d, fam, 9)
+            s1.push(0, bytes(d.export_updates(mark))).epoch(30)
+            c1.import_(r1.pull(0))
+            assert crash.read_oracle(c1, fam) == crash.read_oracle(d, fam)
+        finally:
+            srv.close()
+
+    def test_pull_routed_before_compact_reroutes_not_short(self):
+        """The prune race: a pull that passed the ``covers`` routing
+        check and then had its index rows pruned by ``compact()``
+        before its window processed must serve the FULL delta off the
+        oracle (window-time covers re-check), never a silently-short
+        device selection — and pruning must swap the floor object, not
+        mutate the one concurrent ``covers`` readers hold."""
+        from loro_tpu.core.version import VersionVector
+        from loro_tpu.sync.readbatch import PullTicket
+
+        fam = "text"
+        d = crash.make_doc(fam)
+        srv = SyncServer(fam, 1, cid=crash.container_id(fam, d),
+                         pipeline=False, **CAPS[fam])
+        try:
+            s1 = srv.connect()
+            mark = {}
+            s1.push(0, bytes(d.export_updates(mark))).epoch(30)
+            mark = d.oplog_vv()
+            for r in range(2, 6):
+                crash.apply_edit(d, fam, r)
+                s1.push(0, bytes(d.export_updates(mark))).epoch(30)
+                mark = d.oplog_vv()
+            r1 = srv.connect()
+            r1.pull(0)  # ack the head: compaction floor = full history
+            idx = srv._readbatch.plane.index
+            floor_before = idx.floor_vvs[0]
+            snapshot = floor_before.copy()
+            # the racing pull: routed (covers passed, window queued)
+            # BEFORE the prune — modeled by processing its window after
+            tk = PullTicket()
+            empty = VersionVector()
+            assert srv._readbatch.plane.covers(0, empty)
+            srv.compact()
+            assert idx.rows_pruned > 0
+            assert not srv._readbatch.plane.covers(0, empty)
+            # floor advanced by reference swap: the object the routed
+            # pull's covers check read is untouched
+            assert idx.floor_vvs[0] is not floor_before
+            assert floor_before == snapshot
+            launches0 = idx.launches
+            out = srv._readbatch._process_device([(0, empty, tk)])
+            assert idx.launches == launches0  # no below-floor selection
+            ((tk2, data, _vv, _ep),) = out
+            assert tk2 is tk
+            c = LoroDoc(peer=63)
+            c.import_(data)
+            assert crash.read_oracle(c, fam) == crash.read_oracle(d, fam)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL-the-leader failover (the acceptance crash gate)
+# ---------------------------------------------------------------------------
+
+
+class TestSigkillFailover:
+    def test_promotion_loses_zero_acked_rounds(self, tmp_path):
+        """SIGKILL a group-commit leader process mid-run (between
+        launches, CPU mesh), then promote a cold follower off its
+        directory: every round at/under the last acked durable
+        watermark survives."""
+        ROUNDS = 12
+        child = os.path.join(os.path.dirname(__file__),
+                             "_repl_crash_child.py")
+        ldir = str(tmp_path / "leader")
+        proc = subprocess.Popen(
+            [sys.executable, child, ldir, str(ROUNDS), "4"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        progress = str(tmp_path / "progress")
+        deadline = time.time() + 300
+        lines = []
+        try:
+            # SIGKILL as soon as a mid-run durable watermark exists
+            while True:
+                if os.path.exists(progress):
+                    with open(progress) as f:
+                        lines = f.read().splitlines()
+                    if lines and int(lines[-1].split()[2]) >= 6:
+                        break
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        "crash child exited early: "
+                        + proc.stderr.read().decode()[-2000:]
+                    )
+                if time.time() > deadline:
+                    raise AssertionError("crash child never progressed")
+                time.sleep(0.1)
+        finally:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+        acked = int(lines[-1].split()[2])  # last flushed durable_epoch
+        assert acked >= 6
+        fol = Follower(ldir, str(tmp_path / "F"), leader=None)
+        try:
+            srv = fol.promote("survivor")
+            # zero acked rounds lost (round == epoch in the child)
+            assert srv.epoch >= acked
+            got = srv.texts()[0]
+            assert got == rcrash.oracle_text(srv.epoch)
+            # the promoted server serves and journals new rounds
+            d = rcrash.make_doc()
+            for r in range(2, srv.epoch + 1):
+                rcrash.edit(d, r)
+            mark = d.oplog_vv()
+            rcrash.edit(d, srv.epoch + 1)
+            from loro_tpu.doc import strip_envelope
+
+            cid = d.get_text("t").id
+            srv.ingest(
+                [strip_envelope(bytes(d.export_updates(mark)))], cid
+            )
+            assert srv.texts()[0] == d.get_text("t").to_string()
+            assert srv.durable_epoch == srv.epoch
+        finally:
+            fol.close()

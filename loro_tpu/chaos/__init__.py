@@ -1,0 +1,57 @@
+"""loro_tpu.chaos: deterministic fault-schedule orchestration with a
+fleet-wide invariant checker and replayable shrinking
+(docs/RESILIENCE.md "Chaos plane").
+
+The stack's ~20 typed fault sites (``resilience.faultinject.sites()``)
+are exercised one-at-a-time by targeted tests; this package drives
+them *composed* — against the fully-stacked serving regime (sharded +
+tiered + durable group-commit + PipelinedIngest + SyncServer sessions
++ a live WAL-shipping follower) interleaved with nemesis actions
+(crash/recover, failover promotion, live migration, tier churn,
+checkpoint/compact, session churn).  Five pieces:
+
+- ``plan``       — seeded ``ChaosConfig``/``generate_plan``: the whole
+  schedule is a pure function of its seed (one PRNG, byte-identical
+  step traces across runs)
+- ``stack``      — ``ChaosStack``: the composed stack, its writer
+  clients, and the runner-owned reference oracle
+- ``invariants`` — ``InvariantChecker``: convergence, pull
+  byte-identity, no-lost-acked-writes, follower lag-0 identity,
+  ``persist.inspect`` rc==0, lock-witness acyclicity, obs sanity
+- ``runner``     — ``ChaosRunner``: execute, journal, barrier, dump a
+  replayable violation artifact; ``hold_at``/``resume_from`` are the
+  SIGKILL orchestration hooks (tests/soak_chaos.py)
+- ``replay`` / ``shrink`` — ``python -m loro_tpu.chaos.replay
+  <artifact>`` re-executes deterministically; ``...chaos.shrink``
+  ddmin-minimizes the schedule to the smallest violating subset
+
+CLI: ``python -m loro_tpu.chaos.run --seed N --steps K`` (rc != 0 on
+violation, artifact path on stderr).  Soak:
+``tests/soak_chaos.py`` (SOAK_CHAOS_SEEDS/STEPS/DOCS), which
+orchestrates real subprocess SIGKILLs around the runner's hold points.
+Metrics: ``chaos.*`` (docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+from .invariants import InvariantChecker, Violation
+from .plan import ChaosConfig, Step, generate_plan, trace_json
+from .runner import ChaosReport, ChaosRunner, load_artifact
+from .replay import replay_artifact
+from .shrink import ddmin, shrink_artifact
+from .stack import ChaosStack
+
+__all__ = [
+    "ChaosConfig",
+    "ChaosReport",
+    "ChaosRunner",
+    "ChaosStack",
+    "InvariantChecker",
+    "Step",
+    "Violation",
+    "ddmin",
+    "generate_plan",
+    "load_artifact",
+    "replay_artifact",
+    "shrink_artifact",
+    "trace_json",
+]

@@ -1,0 +1,272 @@
+"""Fleet-wide invariant checker: what must hold at every barrier.
+
+Run against a SETTLED stack (``ChaosStack.settle()`` first — faults
+cleared, fan-ins drained, degraded shards healed, followers caught
+up).  Violations are DATA, not exceptions: one barrier reports every
+broken invariant so the artifact shows the full blast radius, and the
+shrinker can key on a stable ``Violation.key()``.
+
+The invariants (the ISSUE 13 list):
+
+- ``convergence``   — every family server's reads match the runner's
+  reference oracle (host LoroDocs that imported every acked push —
+  regenerated from the journal across a crash), the "Version
+  Reconciliation" convergence contract end-to-end
+- ``client_convergence`` — every live, non-stalled client doc equals
+  the reference oracle after its pulls
+- ``pull_identity`` — ``Session.pull()`` bytes equal the serving
+  oracle's own ``ExportMode.Updates`` export (collected by the pull
+  path in ``stack.pull_client``)
+- ``durability``    — no lost acked writes: every resolved PushTicket
+  epoch <= the family's durable watermark once flushed (the crash-side
+  half — recovered_epoch >= acked — is checked by the kill/recover
+  orchestration in tests/soak_chaos.py)
+- ``follower``      — catch-up returned lag to 0 and the follower's
+  merged reads are byte-identical to the reference oracle
+- ``inspect``       — ``persist.inspect`` rc==0 on every surviving
+  durable directory (leader and follower copies)
+- ``lock_witness``  — the witnessed lock graph stays acyclic and
+  conformant to the declared order (when the witness is enabled)
+- ``obs_sanity``    — no raw (untyped) error ever reached a session,
+  every client operation eventually landed, and the serving oracle
+  never failed an apply (``sync.oracle_apply_errors_total``)
+"""
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..obs import metrics as obs
+from .stack import ChaosStack
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    family: str
+    detail: str
+    step: int = -1
+
+    def key(self) -> Tuple[str, str]:
+        """Stable identity for replay comparison and shrink
+        predicates: the step index and free-form detail vary across
+        schedule subsets, the broken invariant does not."""
+        return (self.invariant, self.family)
+
+    def to_json(self) -> dict:
+        return {"invariant": self.invariant, "family": self.family,
+                "detail": self.detail, "step": self.step}
+
+
+def _oracle_views(doc) -> dict:
+    t = doc.get_text("t")
+    tr = doc.get_tree("tr")
+    c = doc.get_counter("c")
+    return {
+        "text": t.to_string(),
+        "richtext": t.get_richtext_value(),
+        "map": doc.get_map("m").get_value(),
+        "tree": {x: tr.parent(x) for x in tr.nodes()},
+        "counter": float(c.get_value()),
+        "counter_id": c.id,
+        "movable": doc.get_movable_list("ml").get_value(),
+    }
+
+
+class InvariantChecker:
+    """Stateless apart from the stack handle; ``check()`` returns the
+    violations found at one barrier (and ticks ``chaos.*`` metrics)."""
+
+    def __init__(self, stack: ChaosStack, oracle_docs: List):
+        self.stack = stack
+        self.oracle = oracle_docs
+
+    # -- individual invariants -----------------------------------------
+    def _convergence(self, step: int) -> List[Violation]:
+        out: List[Violation] = []
+        views = [_oracle_views(d) for d in self.oracle]
+        for fam, p in self.stack.planes.items():
+            reads = self._family_reads(p)
+            for i, v in enumerate(views):
+                got = reads[i]
+                if not self._matches(fam, got, v):
+                    out.append(Violation(
+                        "convergence", fam,
+                        f"doc {i}: server read diverged from the "
+                        f"reference oracle (got {got!r:.120}, want "
+                        f"{self._want(fam, v)!r:.120})", step))
+        return out
+
+    def _family_reads(self, p) -> list:
+        fam = p.family
+        if fam == "text":
+            texts, riches = p.sync.texts(), p.sync.richtexts()
+            return list(zip(texts, riches))
+        if fam == "map":
+            return p.sync.root_value_maps("m")
+        if fam == "tree":
+            return p.sync.parent_maps()
+        if fam == "counter":
+            return p.sync.value_maps()
+        return p.sync.value_lists()
+
+    @staticmethod
+    def _want(fam: str, v: dict):
+        if fam == "text":
+            return (v["text"], v["richtext"])
+        if fam == "map":
+            return v["map"]
+        if fam == "tree":
+            return v["tree"]
+        if fam == "counter":
+            return {v["counter_id"]: v["counter"]}
+        return v["movable"]
+
+    @classmethod
+    def _matches(cls, fam: str, got, v: dict) -> bool:
+        if fam == "counter":
+            # soak idiom: compare through .get — a counter the doc
+            # never touched reads as an absent key, not 0.0
+            return got.get(v["counter_id"], 0.0) == v["counter"]
+        return got == cls._want(fam, v)
+
+    def _clients(self, step: int) -> List[Violation]:
+        out: List[Violation] = []
+        for c in list(self.stack.clients):
+            if c.stalled:
+                continue
+            for d in self.stack.pull_client(c):
+                out.append(Violation("pull_identity",
+                                     d.split()[1].split("/")[0], d, step))
+            if c.doc.get_deep_value() != self.oracle[c.di].get_deep_value():
+                out.append(Violation(
+                    "client_convergence", "*",
+                    f"client {c.n} (doc {c.di}) diverged from the "
+                    "reference oracle after pulls", step))
+        return out
+
+    def _durability(self, step: int) -> List[Violation]:
+        out: List[Violation] = []
+        for fam, p in self.stack.planes.items():
+            p.resident.flush_durable()
+            mark = p.resident.durable_epoch
+            if mark < p.max_acked:
+                out.append(Violation(
+                    "durability", fam,
+                    f"durable watermark {mark} < max acked push epoch "
+                    f"{p.max_acked} after flush — an acked write would "
+                    "not survive a crash", step))
+        return out
+
+    def _follower(self, step: int) -> List[Violation]:
+        out: List[Violation] = []
+        views = [_oracle_views(d) for d in self.oracle]
+        for fam, p in self.stack.planes.items():
+            if p.follower is None:
+                continue
+            lag = self.stack.catch_up(p)
+            if lag != 0:
+                out.append(Violation(
+                    "follower", fam,
+                    f"catch_up left lag {lag} (applied "
+                    f"{p.follower.applied_epoch})", step))
+                continue
+            reads = self._follower_reads(p)
+            for i, v in enumerate(views):
+                if not self._matches(fam, reads[i], v):
+                    out.append(Violation(
+                        "follower", fam,
+                        f"doc {i}: follower read diverged at lag 0 "
+                        f"(got {reads[i]!r:.120}, want "
+                        f"{self._want(fam, v)!r:.120})", step))
+        return out
+
+    def _follower_reads(self, p) -> list:
+        fam = p.family
+        f = p.follower
+        if fam == "text":
+            return list(zip(f.texts(), f.richtexts()))
+        if fam == "map":
+            return f.root_value_maps("m")
+        if fam == "tree":
+            return f.parent_maps()
+        if fam == "counter":
+            return f.value_maps()
+        return f.value_lists()
+
+    def _inspect(self, step: int) -> List[Violation]:
+        from ..persist.inspect import inspect_dir
+
+        out: List[Violation] = []
+        for fam, p in self.stack.planes.items():
+            dirs = [("leader", p.dir)]
+            if p.follower is not None:
+                dirs.append(("follower", p.follower.follower_dir))
+            for role, d in dirs:
+                buf = io.StringIO()
+                rc = inspect_dir(d, out=buf)
+                if rc != 0:
+                    tail = buf.getvalue().strip().splitlines()[-3:]
+                    out.append(Violation(
+                        "inspect", fam,
+                        f"{role} dir {d}: persist.inspect rc={rc}: "
+                        + " | ".join(tail), step))
+        return out
+
+    def _lock_witness(self, step: int) -> List[Violation]:
+        from ..analysis.lockwitness import witness
+        from ..errors import LockOrderViolation
+
+        w = witness()
+        if not getattr(w, "enabled", False):
+            return []
+        out: List[Violation] = []
+        try:
+            w.assert_acyclic()
+        except LockOrderViolation as e:
+            out.append(Violation("lock_witness", "*", str(e), step))
+        for v in w.check_declared():
+            out.append(Violation("lock_witness", "*", v, step))
+        return out
+
+    def _obs_sanity(self, step: int) -> List[Violation]:
+        out: List[Violation] = []
+        for msg in self.stack.raw_errors:
+            out.append(Violation(
+                "obs_sanity", "*",
+                f"raw (untyped) error reached a session: {msg}", step))
+        for msg in self.stack.unresolved:
+            out.append(Violation(
+                "obs_sanity", "*",
+                f"client operation never landed through retries: {msg}",
+                step))
+        self.stack.raw_errors = []
+        self.stack.unresolved = []
+        napply = obs.counter("sync.oracle_apply_errors_total").total()
+        if napply:
+            out.append(Violation(
+                "obs_sanity", "*",
+                f"serving oracle failed {int(napply)} committed "
+                "applies (planes can diverge)", step))
+        return out
+
+    # -- the barrier ----------------------------------------------------
+    def check(self, step: int = -1) -> List[Violation]:
+        """One barrier: settle, then run every invariant.  Returns all
+        violations (empty = clean)."""
+        self.stack.settle()
+        obs.counter("chaos.checks_total", "invariant barriers run").inc()
+        out: List[Violation] = []
+        out += self._durability(step)
+        out += self._convergence(step)
+        out += self._clients(step)
+        out += self._follower(step)
+        out += self._inspect(step)
+        out += self._lock_witness(step)
+        out += self._obs_sanity(step)
+        for v in out:
+            obs.counter("chaos.violations_total",
+                        "invariant violations detected at barriers").inc(
+                invariant=v.invariant)
+        return out

@@ -1,0 +1,74 @@
+"""Deterministic re-execution of a chaos artifact.
+
+``python -m loro_tpu.chaos.replay <artifact.json> [work_dir]``
+
+Reloads the artifact's config + step trace and runs it against a fresh
+durable root.  The plan is taken from the artifact VERBATIM (never
+regenerated from the seed), so shrunk artifacts — whose step subset no
+PRNG would produce — replay exactly the same way full ones do.
+
+Exit status matches ``chaos.run``: rc 1 when the replay reproduces a
+violation (the expected outcome for a violation artifact — the
+one-screen report says whether the SAME invariants broke), rc 0 on a
+clean replay.
+"""
+from __future__ import annotations
+
+import sys
+import tempfile
+from typing import List, Optional, Tuple
+
+from .plan import ChaosConfig, Step, steps_from_json
+from .runner import ChaosReport, ChaosRunner, load_artifact
+
+
+def replay_artifact(path: str, work_dir: Optional[str] = None,
+                    ) -> Tuple[ChaosReport, List[Tuple[str, str]]]:
+    """Re-execute the artifact; returns ``(report, expected_keys)``
+    where ``expected_keys`` are the original violations' stable keys
+    (``(invariant, family)``) — compare with the report's to decide
+    whether the replay reproduced the original failure."""
+    art = load_artifact(path)
+    cfg = ChaosConfig.from_json(art["config"])
+    plan = steps_from_json(art["trace"])
+    expected = sorted({(v["invariant"], v["family"])
+                       for v in art.get("violations", [])})
+    if work_dir is None:
+        work_dir = tempfile.mkdtemp(prefix="chaos_replay_")
+    report = ChaosRunner(cfg, work_dir).run(plan)
+    return report, expected
+
+
+def reproduces(report: ChaosReport, expected: List[Tuple[str, str]]) -> bool:
+    got = {v.key() for v in report.violations}
+    return bool(expected) and set(expected) <= got
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    report, expected = replay_artifact(argv[0],
+                                       argv[1] if len(argv) > 1 else None)
+    got = sorted({v.key() for v in report.violations})
+    print(f"replay: {report.steps_run} steps, {report.checks} barriers, "
+          f"{len(report.violations)} violation(s)")
+    for v in report.violations[:8]:
+        print(f"  [{v.invariant}/{v.family}] step {v.step}: {v.detail[:110]}")
+    if expected:
+        print("reproduced original violation: "
+              + ("YES" if reproduces(report, expected) else
+                 f"NO (wanted {expected}, got {got})"))
+    return 1 if report.violations else 0
+
+
+if __name__ == "__main__":
+    import os
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.exit(main())

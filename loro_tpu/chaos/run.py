@@ -1,0 +1,107 @@
+"""Chaos CLI: seeded fault/nemesis schedules against the composed stack.
+
+    python -m loro_tpu.chaos.run --seed N --steps K [options]
+
+One-screen verdict on stdout; rc != 0 on any invariant violation, with
+the replayable artifact's path on stderr.  Options:
+
+  --seed N           plan seed (default 0)
+  --steps K          schedule length before barriers (default 40)
+  --families a,b     family subset (default all five)
+  --docs/--shards/--sessions/--hot-slots/--fsync-window/--barrier-every
+                     stack shape knobs (plan.ChaosConfig defaults)
+  --no-follower      drop the replication follower (and repl_* arms)
+  --no-tiering       hot_slots=None (all-hot residency)
+  --allow-kill       generate SIGKILL steps (in-process they downgrade
+                     to reopen; tests/soak_chaos.py orchestrates real
+                     kills around --hold-at)
+  --plant-at I       test-only synthetic violation at step I (the
+                     replay/shrink demo hook)
+  --dir D            durable root (default: a fresh temp dir)
+  --resume-from I    continue a crashed run from step I (needs the
+                     journal in --dir)
+  --hold-at I        execute steps < I, write CHAOS_READY, sleep for
+                     the orchestrating parent's SIGKILL
+  --artifact PATH    violation artifact path override
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+from .plan import ALL_FAMILIES, ChaosConfig
+from .runner import ChaosRunner
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m loro_tpu.chaos.run",
+        description="deterministic chaos schedule against the composed "
+        "sharded+tiered+durable+sync+follower stack",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--families", default=",".join(ALL_FAMILIES))
+    p.add_argument("--docs", type=int, default=4)
+    p.add_argument("--shards", type=int, default=2)
+    p.add_argument("--sessions", type=int, default=3)
+    p.add_argument("--hot-slots", type=int, default=2)
+    p.add_argument("--fsync-window", type=int, default=4)
+    p.add_argument("--barrier-every", type=int, default=10)
+    p.add_argument("--no-follower", action="store_true")
+    p.add_argument("--no-tiering", action="store_true")
+    p.add_argument("--allow-kill", action="store_true")
+    p.add_argument("--plant-at", type=int, default=None)
+    p.add_argument("--dir", default=None)
+    p.add_argument("--resume-from", type=int, default=0)
+    p.add_argument("--hold-at", type=int, default=None)
+    p.add_argument("--artifact", default=None)
+    return p
+
+
+def config_from_args(args) -> ChaosConfig:
+    return ChaosConfig(
+        seed=args.seed, steps=args.steps,
+        families=tuple(f for f in args.families.split(",") if f),
+        docs=args.docs, shards=args.shards, sessions=args.sessions,
+        hot_slots=None if args.no_tiering else args.hot_slots,
+        fsync_window=args.fsync_window,
+        barrier_every=args.barrier_every,
+        follower=not args.no_follower, allow_kill=args.allow_kill,
+        plant_at=args.plant_at,
+    )
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    cfg = config_from_args(args)
+    root = args.dir or tempfile.mkdtemp(prefix="chaos_run_")
+    runner = ChaosRunner(cfg, root, artifact_path=args.artifact)
+    report = runner.run(resume_from=args.resume_from, hold_at=args.hold_at)
+    fams = ",".join(cfg.families)
+    print(f"chaos seed={cfg.seed} steps={report.steps_run} "
+          f"barriers={report.checks} families={fams} "
+          f"shards={cfg.shards} hot_slots={cfg.hot_slots} "
+          f"follower={cfg.follower}")
+    if report.fired:
+        fired = " ".join(f"{k}:{v}" for k, v in sorted(report.fired.items()))
+        print(f"faults fired: {fired}")
+    if report.clean:
+        print("verdict: CLEAN — zero invariant violations")
+        return 0
+    print(f"verdict: {len(report.violations)} VIOLATION(S)")
+    for v in report.violations[:10]:
+        print(f"  [{v.invariant}/{v.family}] step {v.step}: {v.detail[:110]}")
+    print(runner.artifact_path, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.exit(main())

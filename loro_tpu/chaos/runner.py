@@ -1,0 +1,340 @@
+"""Execute a chaos plan against the composed stack.
+
+``ChaosRunner.run(plan)`` walks the step list on one thread (the stack
+underneath stays genuinely concurrent — fan-in workers, pipeline
+executors, read-plane windows, follower shipping), journals per-step
+outcomes, runs the invariant barriers, and on the first violating
+barrier dumps a replayable JSON **artifact** (config + full step trace
++ violations) and stops.
+
+**Journal.**  One JSONL line per executed step (flushed — the OS page
+cache survives a SIGKILL).  Edit steps record the ACKED payload bytes
+(base64), which is what makes the reference oracle *regenerable*: a
+resuming process (``resume_from=``) rebuilds the oracle docs by
+importing the journaled payloads in order — no dependence on the
+recovering servers it is about to judge.  Topology steps (``reopen``
+/ ``promote`` / ``kill``) record the surviving directory layout so a
+resume fronts the right dirs.
+
+**Hold points.**  ``hold_at=K`` executes steps ``i < K``, flushes
+every plane (all accepted pushes committed + journaled), writes the
+``CHAOS_READY`` marker and sleeps — the orchestrating parent
+(tests/soak_chaos.py) SIGKILLs there, recovers in a fresh process with
+``resume_from=K+1`` and verifies nothing acked was lost.  Executed
+WITHOUT an orchestrator, a ``kill`` step downgrades to ``reopen`` on
+every family (counted as ``chaos.kill_downgraded_total``) so plans
+stay replayable and shrinkable in-process.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from random import Random
+from typing import Dict, List, Optional
+
+from ..errors import ChaosError
+from ..obs import metrics as obs
+from ..resilience import faultinject
+from .invariants import InvariantChecker, Violation
+from .plan import ChaosConfig, Step, generate_plan, steps_from_json, trace_json
+from .stack import ChaosStack
+
+ARTIFACT_VERSION = 1
+
+
+@dataclass
+class ChaosReport:
+    """One run's outcome: the verdict, every violation, and the trace
+    (the full input plan — what the artifact replays)."""
+
+    config: ChaosConfig
+    steps_run: int = 0
+    checks: int = 0
+    violations: List[Violation] = field(default_factory=list)
+    trace: List[Step] = field(default_factory=list)
+    fired: Dict[str, int] = field(default_factory=dict)
+    held: bool = False
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+    def to_artifact(self) -> dict:
+        return {
+            "version": ARTIFACT_VERSION,
+            "config": self.config.to_json(),
+            "trace": [s.to_json() for s in self.trace],
+            "violations": [v.to_json() for v in self.violations],
+            "steps_run": self.steps_run,
+            "checks": self.checks,
+            "fired": dict(self.fired),
+            "verdict": "clean" if self.clean else "violation",
+        }
+
+    def trace_json(self) -> str:
+        return trace_json(self.trace)
+
+
+def load_artifact(path: str) -> dict:
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ChaosError(f"unreadable chaos artifact {path}: {e}") from e
+    if not isinstance(art, dict) or art.get("version") != ARTIFACT_VERSION:
+        raise ChaosError(
+            f"{path}: not a v{ARTIFACT_VERSION} chaos artifact "
+            f"(got version {art.get('version') if isinstance(art, dict) else '?'})"
+        )
+    return art
+
+
+class ChaosRunner:
+    """One run = one plan executed against one durable root.
+
+    ``journal_path`` defaults to ``<root>/chaos-journal.jsonl``;
+    ``artifact_path`` to ``<root>/chaos-artifact.json``.  Pass
+    ``resume_from=K`` to continue a crashed run: the stack recovers
+    from the durable dirs, the reference oracle regenerates from the
+    journal, and execution starts at step K.
+    """
+
+    def __init__(self, cfg: ChaosConfig, root: str,
+                 journal_path: Optional[str] = None,
+                 artifact_path: Optional[str] = None):
+        self.cfg = cfg
+        self.root = root
+        self.journal_path = journal_path or os.path.join(
+            root, "chaos-journal.jsonl")
+        self.artifact_path = artifact_path or os.path.join(
+            root, "chaos-artifact.json")
+        self.stack: Optional[ChaosStack] = None
+        self.oracle: List = []
+        self._journal = None
+
+    # -- journal --------------------------------------------------------
+    def _open_journal(self, append: bool) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        self._journal = open(self.journal_path, "a" if append else "w")
+
+    def _log(self, step: Step, **extra) -> None:
+        rec = {"i": step.i, "kind": step.kind}
+        rec.update(extra)
+        self._journal.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._journal.flush()
+
+    def _replay_journal(self, upto: int) -> dict:
+        """Rebuild resume state from journal lines with ``i < upto``:
+        oracle payload imports, per-family acked watermarks, surviving
+        directory topology.  Returns the topology overrides."""
+        from .. import LoroDoc
+
+        self.oracle = [LoroDoc(peer=1) for _ in range(self.cfg.docs)]
+        acked: Dict[str, int] = {}
+        topo: dict = {}
+        if not os.path.exists(self.journal_path):
+            raise ChaosError(
+                f"resume_from set but no journal at {self.journal_path}")
+        with open(self.journal_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    raise ChaosError(
+                        f"corrupt chaos journal line: {line[:80]}") from e
+                if int(rec.get("i", -1)) >= upto:
+                    continue
+                if rec.get("payload"):
+                    di = int(rec["di"])
+                    self.oracle[di].import_(
+                        base64.b64decode(rec["payload"]))
+                for fam, ep in (rec.get("acked") or {}).items():
+                    acked[fam] = max(acked.get(fam, 0), int(ep))
+                if rec.get("topo"):
+                    topo.update(rec["topo"])
+        topo["acked"] = acked
+        return topo
+
+    def _topo_snapshot(self) -> dict:
+        return {
+            p.family: {"dir": p.dir, "fol_gen": p.fol_gen}
+            for p in self.stack.planes.values()
+        }
+
+    # -- lifecycle ------------------------------------------------------
+    def _boot(self, resume_from: int) -> None:
+        from .. import LoroDoc
+
+        if resume_from:
+            topo = self._replay_journal(resume_from)
+            acked = topo.pop("acked")
+            # disjoint peer range per resume segment: abandoned
+            # pre-crash client peers must never be reused
+            stack = ChaosStack(self.cfg, self.root, recover=True,
+                              peer_base=1000 * (resume_from + 1))
+            for fam, t in topo.items():
+                if fam in stack.planes:
+                    stack.planes[fam].fol_gen = t.get(
+                        "fol_gen", stack.planes[fam].fol_gen)
+            for fam, ep in acked.items():
+                if fam in stack.planes:
+                    stack.planes[fam].max_acked = ep
+            self.stack = stack
+            self._open_journal(append=True)
+        else:
+            self.oracle = [LoroDoc(peer=1) for _ in range(self.cfg.docs)]
+            self.stack = ChaosStack(self.cfg, self.root)
+            self._open_journal(append=False)
+
+    def _hold(self) -> None:
+        """Flush everything (accepted pushes committed + journaled —
+        the WAL bytes are in the OS page cache, which a SIGKILL cannot
+        touch), publish the READY marker, and sleep until the parent
+        kills us."""
+        for p in self.stack.planes.values():
+            p.sync.flush()
+        marker = self.stack.hold_marker()
+        with open(marker + ".tmp", "w") as f:
+            f.write("ready")
+        os.replace(marker + ".tmp", marker)
+        time.sleep(600.0)
+        raise ChaosError(
+            "hold point expired: the orchestrating parent never killed "
+            "this process (it owns the SIGKILL; 600s is its deadline)")
+
+    # -- the run --------------------------------------------------------
+    def run(self, plan: Optional[List[Step]] = None, resume_from: int = 0,
+            hold_at: Optional[int] = None) -> ChaosReport:
+        plan = generate_plan(self.cfg) if plan is None else plan
+        report = ChaosReport(config=self.cfg, trace=list(plan))
+        self._boot(resume_from)
+        checker = InvariantChecker(self.stack, self.oracle)
+        try:
+            for step in plan:
+                if step.i < resume_from:
+                    continue
+                if hold_at is not None and step.i >= hold_at:
+                    report.held = True
+                    self._hold()  # never returns
+                self._execute(step, report, checker)
+                report.steps_run += 1
+                if report.violations:
+                    break
+            if not report.violations and (
+                    not plan or plan[-1].kind != "check"
+                    or report.steps_run == 0):
+                # shrunk subsets may have dropped the trailing barrier;
+                # a run must never end unjudged
+                self._barrier(Step(i=len(plan), kind="check"),
+                              report, checker)
+        finally:
+            self._finish(report)
+        return report
+
+    def _finish(self, report: ChaosReport) -> None:
+        fired: Dict[str, int] = {}
+        for row in obs.counter("faultinject.fired_total").snapshot()["values"]:
+            site = row["labels"].get("site", "?")
+            fired[site] = fired.get(site, 0) + int(row["value"])
+        report.fired = fired
+        faultinject.clear()
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+        if self.stack is not None:
+            self.stack.close()
+            self.stack = None
+        if report.violations:
+            with open(self.artifact_path + ".tmp", "w") as f:
+                json.dump(report.to_artifact(), f, indent=1)
+            os.replace(self.artifact_path + ".tmp", self.artifact_path)
+
+    # -- step executors -------------------------------------------------
+    def _execute(self, step: Step, report: ChaosReport,
+                 checker: InvariantChecker) -> None:
+        stack = self.stack
+        kind, pr = step.kind, step.params
+        obs.counter("chaos.steps_total",
+                    "chaos plan steps executed").inc(kind=kind)
+        if kind == "edit":
+            c = stack.pick_client(int(pr["client"]))
+            c.edit(Random(int(pr["seed"])))
+            payload = c.export_delta()
+            acked = stack.push_payload(c, payload, self.oracle)
+            full = len(acked) == len(self.cfg.families)
+            self._log(step, di=c.di, acked=acked,
+                      payload=base64.b64encode(payload).decode()
+                      if full else None)
+        elif kind == "pull":
+            c = stack.pick_client(int(pr["client"]))
+            if not c.stalled:
+                for detail in stack.pull_client(c):
+                    report.violations.append(
+                        Violation("pull_identity", "*", detail, step.i))
+            self._log(step, stalled=c.stalled)
+        elif kind == "fault":
+            stack.arm_fault(pr)
+            self._log(step, site=pr["site"])
+        elif kind == "join":
+            stack.new_client(int(pr["doc"]) % self.cfg.docs)
+            self._log(step)
+        elif kind == "leave":
+            gone = stack.drop_client(int(pr["client"]))
+            self._log(step, left=None if gone is None else gone.n)
+        elif kind == "stall":
+            stack.pick_client(int(pr["client"])).stalled = True
+            self._log(step)
+        elif kind == "checkpoint":
+            stack.checkpoint(pr["family"])
+            self._log(step)
+        elif kind == "compact":
+            stack.compact(pr["family"])
+            self._log(step)
+        elif kind == "demote":
+            ok = stack.demote(pr["family"], int(pr["pick"]))
+            self._log(step, demoted=ok)
+        elif kind == "migrate":
+            ok = stack.migrate(pr["family"], int(pr["doc"]))
+            self._log(step, migrated=ok)
+        elif kind == "reopen":
+            stack.reopen(pr["family"])
+            self._log(step, topo=self._topo_snapshot())
+        elif kind == "promote":
+            stack.promote(pr["family"])
+            self._log(step, topo=self._topo_snapshot())
+        elif kind == "kill":
+            # no orchestrator reached this step in-process: downgrade
+            # to the graceful-recovery nemesis on every family so the
+            # plan stays executable (soak_chaos delivers the real
+            # SIGKILL at these indexes via hold_at)
+            obs.counter(
+                "chaos.kill_downgraded_total",
+                "kill steps executed in-process as reopen-all").inc()
+            for fam in self.cfg.families:
+                stack.reopen(fam)
+            self._log(step, topo=self._topo_snapshot(), downgraded=True)
+        elif kind == "plant":
+            # test-only synthetic violation: corrupt the REFERENCE
+            # oracle (never the stack) — the next barrier's
+            # convergence/client checks must catch it
+            d = self.oracle[0]
+            d.get_map("m").set("__chaos_planted__", int(pr["seed"]))
+            d.commit()
+            self._log(step)
+        elif kind == "check":
+            self._barrier(step, report, checker)
+        else:
+            raise ChaosError(f"unknown chaos step kind {step.kind!r}")
+
+    def _barrier(self, step: Step, report: ChaosReport,
+                 checker: InvariantChecker) -> None:
+        report.checks += 1
+        found = checker.check(step.i)
+        report.violations.extend(found)
+        self._log(step, violations=[v.to_json() for v in found])

@@ -138,6 +138,15 @@ class LockOrderViolation(AnalysisError):
     witness mode (tests) — production code never enables it."""
 
 
+class ChaosError(LoroError):
+    """Chaos-plane lifecycle misuse (loro_tpu/chaos/, docs/RESILIENCE.md
+    "Chaos plane"): a malformed replay artifact, a plan step the runner
+    does not understand, or orchestration misuse (resuming a run whose
+    journal is missing).  Invalid chaos *knob* values raise ConfigError
+    instead; invariant VIOLATIONS are never exceptions — they are data
+    (``chaos.invariants.Violation``) so a run can report all of them."""
+
+
 class ResilienceError(LoroError):
     """Base for the resilience subsystem (loro_tpu/resilience/)."""
 

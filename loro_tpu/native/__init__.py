@@ -20,6 +20,11 @@ from ..errors import CodecDecodeError
 from ..obs import metrics as _obs
 from ..resilience import faultinject as _fi
 
+_fi.register_site(
+    "decode", "native explode entries: truncate/bit-flip the wire bytes "
+    "before the C++ parser sees them (typed CodecDecodeError -> the "
+    "caller's Python-decoder fallback)")
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "codec.cpp")
 _SO = os.path.join(_DIR, "codec.so")

@@ -31,6 +31,12 @@ from ..errors import DeviceFailure
 from ..obs import metrics as obs
 from ..analysis.lockwitness import named_rlock
 from ..resilience import get_supervisor
+from ..resilience.faultinject import register_site
+
+register_site(
+    "export_launch", "batched delta-export selection launch (fleet "
+    "export_select thunk, inside the supervisor): transient retries, "
+    "terminal -> DeviceFailure degrades ONLY that window")
 from ..utils import tracing
 from ..ops.columnar import MapExtract, SeqExtract, extract_seq_container
 from ..ops.fugue_batch import SeqColumns, materialize_content_batch, pad_bucket
@@ -3089,6 +3095,14 @@ class DeviceTreeBatch:
                     f"DeviceTreeBatch node capacity exceeded: a doc needs "
                     f"{req_nodes} nodes > {self.node_cap}"
                 )
+        # the clock ticks for EVERY appended round — including rounds
+        # that stage no move rows (a tree server fed a map-only edit).
+        # Every family batch shares this contract (journal epochs are
+        # strictly monotone per round): a lazy bump here stamped those
+        # rounds' journal records with epoch 0 / duplicate epochs,
+        # which recovery replay skips and which un-pin WAL retention
+        # under a live follower (chaos seed 4).
+        self.epoch += 1
         if not max_new:
             return
         # commit staged node registrations
@@ -3107,7 +3121,6 @@ class DeviceTreeBatch:
             "valid": np.zeros(blk_shape, bool),
         }
         offsets = np.zeros(self.d, np.int32)
-        self.epoch += 1  # post-validation: dates this append's rows
         for di, rows in enumerate(rows_per_doc):
             if not rows:
                 continue

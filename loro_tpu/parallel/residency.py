@@ -70,6 +70,15 @@ from ..obs import metrics as obs
 from ..resilience import faultinject
 from .server import _FAMILIES, ResidentServer
 
+faultinject.register_site(
+    "evict_flush", "TieredBatch eviction: fires after the warm mirror "
+    "is built, before any tier state mutates (failure leaves the doc "
+    "HOT, typed ResidencyError)")
+faultinject.register_site(
+    "revive_replay", "TieredBatch revive: fires after the history "
+    "export, before the slot landing (fails only the triggering "
+    "round/ticket, typed ResidencyError)")
+
 TIER_HOT = "hot"
 TIER_WARM = "warm"
 TIER_COLD = "cold"
@@ -719,6 +728,32 @@ class TieredBatch:
         mgr.demotions += 1
         obs.counter("residency.demotions_total").inc(family=self.family)
         mgr._set_gauges()
+
+    def flatten_cold(self) -> int:
+        """Lift every cold doc back to the warm tier, rehydrating its
+        anchor blob from the backing rung + WAL tail first.  The
+        follower bootstrap runs this while the recovered server still
+        holds its durable log: a following replica detaches
+        ``_durable`` (the ship path owns the WAL files), which makes
+        every cold-tier exit — reads, oracle seeding, the shipped-
+        checkpoint rehydrate — unreachable.  Nothing re-demotes while
+        following (``checkpoint()`` without a durable log skips the
+        demotion policy), so the flatten holds until promotion
+        re-attaches the log.  Returns the number of docs lifted."""
+        with self._plan_lock:
+            cold = sorted(self.mgr.cold)
+            for di in cold:
+                self._rehydrate_doc_locked(di)
+                del self.mgr.cold[di]
+            if cold:
+                self._rung_cache = None
+                self.mgr._set_gauges()
+                obs.counter(
+                    "residency.cold_flattens_total",
+                    "cold docs lifted warm with their rung state folded "
+                    "into the anchor (follower bootstrap)",
+                ).inc(len(cold), family=self.family)
+            return len(cold)
 
     def note_restored_rung(self, rung_name: str) -> None:
         """Recovery restored this batch from ``rung_name``: re-demote

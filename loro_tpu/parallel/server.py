@@ -37,6 +37,10 @@ from typing import Dict, List, Optional, Sequence
 from ..errors import DeviceFailure, ResilienceError
 from ..obs import metrics as obs
 from ..resilience import faultinject, get_supervisor
+
+faultinject.register_site(
+    "poison_doc", "ResidentServer.ingest: corrupt one doc's payload in "
+    "a round (per-doc poison isolation)")
 from .fleet import (
     DeviceCounterBatch,
     DeviceDocBatch,

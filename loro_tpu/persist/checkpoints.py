@@ -42,6 +42,10 @@ from ..errors import CodecDecodeError, DecodeError
 from ..obs import metrics as obs
 from ..resilience import faultinject
 
+faultinject.register_site(
+    "ckpt_corrupt", "persist.checkpoints save: mangle the framed rung "
+    "blob (recovery must fall back down the ladder)")
+
 CKPT_MAGIC = b"LTCK"
 CKPT_VERSION = 1
 

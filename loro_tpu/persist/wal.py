@@ -68,6 +68,14 @@ from ..errors import CodecDecodeError, PersistError
 from ..obs import metrics as obs
 from ..resilience import faultinject
 
+faultinject.register_site(
+    "wal_write", "persist.wal append: raise/delay before the frame "
+    "reaches disk (durability-path failures)")
+faultinject.register_site(
+    "wal_torn_tail", "persist.wal append: mangle the frame bytes on "
+    "their way to disk (a genuinely torn write for the reopen-"
+    "tolerance tests)")
+
 SEG_MAGIC = b"LTWL"
 SEG_VERSION = 1
 META_VERSION = 1
@@ -618,6 +626,7 @@ class WriteAheadLog:
         registered followers' acked epochs), the prune point is
         clamped to it — a lagging follower pins the segments it still
         needs (docs/REPLICATION.md "retention")."""
+        floor = None
         if self.retention_floor is not None:
             floor = self.retention_floor()
             if floor is not None and floor < epoch:
@@ -626,11 +635,35 @@ class WriteAheadLog:
                     "WAL prune epoch pinned by follower acks",
                 ).set(floor)
                 epoch = floor
-        doomed = [
-            info for info in self._segments
-            if info is not self._active
-            and (info.max_epoch is None or info.max_epoch <= epoch)
-        ]
+        # With a live follower pin, pruning must only ever remove a
+        # contiguous PREFIX of the stream, and marker-only segments
+        # (max_epoch None: ckpt/prune markers, or freshly rotated and
+        # empty) go only when a round-bearing segment that is itself
+        # under the clamped floor follows them — an acked epoch maps to
+        # round positions, never to marker positions, so a floating
+        # marker-only segment may still be ahead of the follower's
+        # shipped copy.  Pruning one would punch a hole in the shipped
+        # stream and orphan the follower typed (StaleFollower) even
+        # though it was fresh and pinned — the epoch-0 auto-checkpoint
+        # right after a follower attaches hits exactly this (chaos
+        # seed 4, docs/RESILIENCE.md "Chaos plane").
+        pinned = floor is not None
+        doomed: List[SegmentInfo] = []
+        pending: List[SegmentInfo] = []
+        for info in self._segments:
+            if info is self._active:
+                break
+            if info.max_epoch is None:
+                if pinned:
+                    pending.append(info)
+                else:
+                    doomed.append(info)
+            elif info.max_epoch <= epoch:
+                doomed.extend(pending)
+                pending = []
+                doomed.append(info)
+            else:
+                break
         if any(info.max_epoch is not None for info in doomed):
             floor = max(info.max_epoch for info in doomed
                         if info.max_epoch is not None)

@@ -63,6 +63,13 @@ from ..persist.wal import (
     _seg_name,
 )
 from ..resilience import faultinject
+
+faultinject.register_site(
+    "repl_apply", "Follower apply loop: fires before each shipped "
+    "round applies to the follower batch")
+faultinject.register_site(
+    "repl_promote", "Follower.promote entry: fires before the fencing "
+    "token bump (a retried promote starts clean)")
 from .manifest import DEFAULT_STALE_AFTER_S, ReplicationManifest
 from .shipper import WalShipper
 
@@ -165,6 +172,15 @@ class Follower:
         from ..persist import recover_server
 
         self.resident = recover_server(follower_dir, mesh=mesh, fsync=False)
+        # a tiered leader's tier map rides its rungs, so the recovered
+        # copy can hold cold docs — whose every exit (read, oracle
+        # seeding, the shipped-checkpoint rehydrate) needs the durable
+        # log this follower is about to detach.  Flatten them warm
+        # while the log is still attached; nothing re-demotes until
+        # promotion re-attaches it.
+        batch = getattr(self.resident, "batch", None)
+        if hasattr(batch, "flatten_cold"):
+            batch.flatten_cold()
         log = self.resident._durable
         self.resident._durable = None
         # while following, the ship path owns the WAL files and writes

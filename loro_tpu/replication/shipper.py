@@ -34,6 +34,11 @@ from ..obs import metrics as obs
 from ..persist.wal import _seg_index
 from ..resilience import faultinject
 
+faultinject.register_site(
+    "repl_ship", "WalShipper.read: every shipped byte crosses it — "
+    "raise/delay = a mid-ship crash; truncate/bitflip = a torn shipped "
+    "tail the follower truncates like a WAL reopen")
+
 
 class WalShipper:
     """Byte-stream source over one durable directory.

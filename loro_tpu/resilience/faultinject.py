@@ -80,6 +80,18 @@ registry.  Tier-1 hygiene: tests arming faults carry the
 ``faultinject`` marker and the conftest guard asserts ``active()`` is
 empty after every test — a leaked fault fails the leaking test's
 teardown, not some unrelated test three files later.
+
+**Site registry.**  Every instrumented module declares its sites at
+import time (``register_site(name, help)`` next to the ``check()``/
+``mangle()`` call sites); ``sites()`` returns the full catalogue
+(importing the known instrumented modules first, so the answer does
+not depend on what the caller happened to import).  ``inject()`` and
+``LORO_FAULT`` entries naming an unknown site raise a typed
+``errors.ConfigError`` at first use — a typo'd
+``LORO_FAULT="wal_wirte:raise"`` used to be a silent no-op, which is
+the worst possible failure mode for a fault you believed you were
+testing under.  Malformed entries (unknown action, bad ``k=v``) raise
+typed the same way instead of being skipped.
 """
 from __future__ import annotations
 
@@ -88,7 +100,87 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..errors import ConfigError
 from ..obs import metrics as _obs
+
+# -- fault-site registry ----------------------------------------------
+# modules that own check()/mangle() call sites; sites() imports them so
+# the catalogue is complete even before the stack is built.  A module
+# added here registers its sites at import; the docs/registry
+# cross-check test (tests/test_chaos.py) catches drift in BOTH
+# directions (a site documented but never registered, or registered
+# but undocumented).
+_SITE_MODULES = (
+    "loro_tpu.resilience.supervisor",
+    "loro_tpu.resilience.probe",
+    "loro_tpu.native",
+    "loro_tpu.parallel.fleet",
+    "loro_tpu.parallel.server",
+    "loro_tpu.parallel.residency",
+    "loro_tpu.persist.wal",
+    "loro_tpu.persist.checkpoints",
+    "loro_tpu.sync.server",
+    "loro_tpu.sync.session",
+    "loro_tpu.sync.presence",
+    "loro_tpu.sync.readbatch",
+    "loro_tpu.replication.shipper",
+    "loro_tpu.replication.follower",
+)
+
+_ACTIONS = ("raise", "delay", "hang", "truncate", "bitflip", "poison")
+
+_registry: Dict[str, dict] = {}
+
+
+def register_site(name: str, help: str = "") -> str:
+    """Declare a fault site (call at module import, next to the
+    ``check()``/``mangle()`` call sites it covers).  Idempotent — a
+    site instrumented at several choke points (``session_stall``,
+    ``export_launch``) registers once per module, first help text
+    wins.  Returns the name so call sites can bind it."""
+    import sys
+
+    mod = sys._getframe(1).f_globals.get("__name__", "?")
+    with _lock:
+        info = _registry.get(name)
+        if info is None:
+            _registry[name] = {"help": help, "modules": [mod]}
+        elif mod not in info["modules"]:
+            info["modules"].append(mod)
+    return name
+
+
+def _load_site_modules() -> None:
+    """Complete the registry by importing every instrumented module
+    (idempotent; already-imported modules are sys.modules hits)."""
+    import importlib
+
+    for m in _SITE_MODULES:
+        importlib.import_module(m)
+
+
+def sites() -> Dict[str, dict]:
+    """The full site catalogue: ``{name: {"help": ..., "modules":
+    [...]}}``.  Imports the instrumented modules first so the answer
+    is complete regardless of what the caller loaded."""
+    _load_site_modules()
+    with _lock:
+        return {k: dict(v) for k, v in sorted(_registry.items())}
+
+
+def _require_site(site: str, knob: str) -> None:
+    """Typed rejection of unknown site names.  Cheap when the site is
+    already registered (no imports); the full module sweep runs only
+    to prove a name genuinely unknown (and name the accepted set)."""
+    with _lock:
+        if site in _registry:
+            return
+    _load_site_modules()
+    with _lock:
+        if site in _registry:
+            return
+        known = ", ".join(sorted(_registry))
+    raise ConfigError(knob, site, f"registered fault sites: {known}")
 
 
 class InjectedFault(Exception):
@@ -140,7 +232,16 @@ def inject(site: str, *, action: str = "raise", exc: Optional[BaseException] = N
            delay_s: float = 0.0, keep_bytes: Optional[int] = None,
            flip_at: Optional[int] = None, docs=None,
            times: Optional[int] = None) -> Fault:
-    """Arm one fault.  Returns the Fault (its ``fired`` counter is live)."""
+    """Arm one fault.  Returns the Fault (its ``fired`` counter is
+    live).  Unknown site names and actions raise typed ConfigError —
+    an armed-but-misspelled fault that can never fire is worse than a
+    crash (the test it was guarding passes vacuously)."""
+    _require_site(site, "faultinject.inject site")
+    if action not in _ACTIONS:
+        raise ConfigError(
+            "faultinject.inject action", action,
+            "one of: " + ", ".join(_ACTIONS),
+        )
     f = Fault(
         site=site, action=action, exc=exc, exc_factory=exc_factory,
         delay_s=delay_s, keep_bytes=keep_bytes, flip_at=flip_at,
@@ -279,10 +380,11 @@ def _load_env() -> None:
         for entry in spec.replace(",", ";").split(";"):
             entry = entry.strip()
             if entry:
-                try:
-                    _install_env_entry(entry)
-                except Exception:  # tpulint: disable=LT-EXC(a typo'd LORO_FAULT spec must not take the process down)
-                    pass
+                # a typo'd site/action/k=v raises typed ConfigError at
+                # the FIRST instrumented call — the old behavior
+                # (silently skip the entry) meant the fault you thought
+                # you were testing under never existed
+                _install_env_entry(entry)
 
 
 def _install_env_entry(entry: str) -> None:
@@ -291,20 +393,34 @@ def _install_env_entry(entry: str) -> None:
     action = parts[1] if len(parts) > 1 else "raise"
     kw: dict = {}
     base, _, val = action.partition("=")
-    if base == "truncate":
-        kw["keep_bytes"] = int(val) if val else None
-    elif base == "bitflip":
-        kw["flip_at"] = int(val) if val else None
-    for p in parts[2:]:
-        k, _, v = p.partition("=")
-        if k == "times":
-            kw["times"] = int(v)
-        elif k in ("s", "delay"):
-            kw["delay_s"] = float(v)
-        elif k == "msg":
-            kw["exc"] = InjectedFault(v)
-        elif k == "docs":
-            kw["docs"] = frozenset(int(x) for x in v.split("+") if x)
+    try:
+        if base == "truncate":
+            kw["keep_bytes"] = int(val) if val else None
+        elif base == "bitflip":
+            kw["flip_at"] = int(val) if val else None
+        elif val:
+            raise ValueError(f"action {base!r} takes no =value")
+        for p in parts[2:]:
+            k, _, v = p.partition("=")
+            if k == "times":
+                kw["times"] = int(v)
+            elif k in ("s", "delay"):
+                kw["delay_s"] = float(v)
+            elif k == "msg":
+                kw["exc"] = InjectedFault(v)
+            elif k == "docs":
+                kw["docs"] = frozenset(int(x) for x in v.split("+") if x)
+            else:
+                raise ValueError(f"unknown key {k!r}")
+    except ValueError as e:
+        if isinstance(e, ConfigError):
+            raise
+        raise ConfigError(
+            "LORO_FAULT", entry,
+            "site:action[:k=v]* with action in "
+            f"{'/'.join(_ACTIONS)} and keys times=/s=/delay=/msg=/docs= "
+            f"({e})",
+        ) from e
     inject(site, action=base, **kw)
 
 

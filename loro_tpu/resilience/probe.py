@@ -30,6 +30,11 @@ from typing import Callable, Optional
 
 from ..errors import BackendUnavailable
 from ..obs import metrics as obs
+from .faultinject import register_site
+
+register_site(
+    "backend_init", "resilience.probe subprocess: hang or raise during "
+    "backend init (the TPU-pool lottery)")
 
 DEFAULT_STATUS = ".probe_device.json"
 DEFAULT_STAGGER_S = 120.0

@@ -40,6 +40,12 @@ from ..errors import DeadlineExceeded, DeviceFailure, LoroError
 from ..obs import metrics as obs
 from . import faultinject
 
+faultinject.register_site(
+    "launch", "DeviceSupervisor.launch: raise before the device call "
+    "(transient UNAVAILABLE retries; anything else -> DeviceFailure)")
+faultinject.register_site(
+    "fetch", "DeviceSupervisor.fetch/drain: slow or failing host fetch")
+
 # substrings that mark an error transient (retry-worthy): the backend
 # init / RPC errors the TPU pool throws when it is flaky but alive
 _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",

@@ -31,6 +31,10 @@ from ..awareness import Awareness, EphemeralStore
 from ..obs import metrics as obs
 from ..resilience import faultinject
 
+faultinject.register_site(
+    "session_stall", "presence fan-out delivery: delay one session's "
+    "presence slot (shared with the delta fan-out site)")
+
 
 class PresencePlane:
     """Owned by a SyncServer; all methods take the server lock.

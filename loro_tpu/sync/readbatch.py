@@ -63,6 +63,14 @@ from ..errors import DeviceFailure, SyncError
 from ..obs import metrics as obs
 from ..resilience import faultinject
 
+faultinject.register_site(
+    "read_batch", "ReadBatcher window worker: fires before any device "
+    "work on a drained pull window — the whole window degrades to "
+    "per-doc oracle pulls (typed, counted, invisible to sessions)")
+faultinject.register_site(
+    "export_launch", "batched delta-export selection launch (shared "
+    "with parallel.fleet's export_select site)")
+
 
 class PullTicket:
     """Handle for one batched pull: ``result()`` blocks until the

@@ -48,6 +48,13 @@ from ..analysis.lockwitness import named_rlock
 from ..obs import metrics as obs
 from ..resilience import faultinject
 from .fanin import FanIn, PushTicket
+
+faultinject.register_site(
+    "sync_push", "SyncServer push entry: raise/delay before the fan-in "
+    "queue, or mangle the client's update bytes (typed PushRejected)")
+faultinject.register_site(
+    "session_stall", "sync fan-out delivery: delay one session's "
+    "notification slot (slow-consumer backpressure)")
 from .presence import PresencePlane
 from .session import Session
 

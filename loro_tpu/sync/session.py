@@ -37,6 +37,10 @@ from ..errors import SessionClosed
 from ..obs import metrics as obs
 from ..resilience import faultinject
 
+faultinject.register_site(
+    "sync_pull", "Session.pull: raise/delay before the delta export "
+    "(client-visible read-path failures)")
+
 # presence inbox bound: a session that never polls drops its OLDEST
 # presence blobs (counted) — presence is last-writer-wins ephemeral
 # state, so the newest blobs are the ones that matter

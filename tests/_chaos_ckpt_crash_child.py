@@ -1,0 +1,137 @@
+"""Crash child for the sharded+tiered+durable checkpoint-crash test
+(NOT collected — no test_ prefix; see tests/test_chaos.py::
+TestShardedTieredCheckpointCrash).
+
+As a script::
+
+    python tests/_chaos_ckpt_crash_child.py <base_dir>
+
+builds ONE sharded (2 shards) + tiered (hot_slots=1) + durable
+(group-commit) text server under ``<base_dir>/text``, drives
+``ROUNDS`` deterministic ingest rounds over ``DOCS`` docs, checkpoints
+and demotes one warm doc per shard to the COLD tier (rung-backed),
+flushes, writes a progress manifest, then arms a ``ckpt_corrupt:hang``
+fault and calls ``checkpoint()`` — the hang fires inside the first
+shard's rung rewrite, AFTER the cold docs were rehydrated into the
+anchor for the new rung, so the parent's SIGKILL lands with the
+cold-doc rung rewrite mid-flight (the surviving ladder + WAL tail
+must carry recovery).  ``READY`` is written immediately before the
+hanging checkpoint.
+
+As a module: ``build_oracle``/``read_progress`` give the parent the
+byte-identical expected state and the pre-crash watermarks.
+"""
+import json
+import os
+import os.path as _p
+import sys
+
+sys.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))  # repo root
+
+DOCS = 4
+SHARDS = 2
+ROUNDS = 8
+
+
+def _edit(doc, r: int) -> None:
+    t = doc.get_text("t")
+    t.insert(min(r, len(t)), f"r{r} ")
+    if r % 3 == 0 and len(t) > 4:
+        t.delete(1, 2)
+    doc.commit()
+
+
+def round_doc(r: int) -> int:
+    """Which doc round ``r`` (1-based) touches — rotating, so every
+    doc gets history and hot_slots=1 churns evict/revive."""
+    return (r - 1) % DOCS
+
+
+def build_oracle(rounds: int):
+    """Expected text per doc after ``rounds`` rounds (the child and
+    this oracle generate byte-identical edit streams)."""
+    from loro_tpu import LoroDoc
+
+    docs = [LoroDoc(peer=7000 + i) for i in range(DOCS)]
+    for i, d in enumerate(docs):
+        d.get_text("t").insert(0, f"base{i} ")
+        d.commit()
+    for r in range(1, rounds + 1):
+        _edit(docs[round_doc(r)], r)
+    return [d.get_text("t").to_string() for d in docs]
+
+
+def read_progress(base_dir: str) -> dict:
+    with open(os.path.join(base_dir, "progress.json")) as f:
+        return json.load(f)
+
+
+def main(base_dir: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from loro_tpu import LoroDoc
+    from loro_tpu.parallel.sharded import ShardedResidentServer
+    from loro_tpu.resilience import faultinject
+
+    docs = [LoroDoc(peer=7000 + i) for i in range(DOCS)]
+    marks = [None] * DOCS
+    srv = ShardedResidentServer(
+        "text", DOCS, shards=SHARDS,
+        durable_dir=os.path.join(base_dir, "text"),
+        durable_fsync="group", fsync_window=2, hot_slots=1,
+        capacity=1 << 12,
+    )
+    cid = docs[0].get_text("t").id
+
+    def push(di: int) -> None:
+        d = docs[di]
+        if marks[di] is None:
+            chs = d.oplog.changes_in_causal_order()
+        else:
+            chs = d.oplog.changes_between(marks[di], d.oplog_vv())
+        marks[di] = d.oplog_vv()
+        ups = [None] * DOCS
+        ups[di] = chs
+        srv.ingest(ups, cid)
+
+    for i, d in enumerate(docs):
+        d.get_text("t").insert(0, f"base{i} ")
+        d.commit()
+        push(i)
+    for r in range(1, ROUNDS + 1):
+        di = round_doc(r)
+        _edit(docs[di], r)
+        push(di)
+    # rung A backs the demotes; rung B is the last COMPLETED
+    # checkpoint and carries the cold tier map (recovery restores the
+    # tier state from the newest readable rung's blob)
+    srv.checkpoint()
+    cold_docs = []
+    for s, shard in enumerate(srv.shards):
+        warm = shard.residency.tiers().get("warm", [])
+        if warm:
+            shard.batch.demote(warm[0])
+            cold_docs.extend(srv._globals_of(s, [warm[0]]))
+    srv.checkpoint()
+    srv.flush_durable()
+    with open(os.path.join(base_dir, "progress.json"), "w") as f:
+        json.dump({
+            "rounds": ROUNDS,
+            "epoch": srv.epoch,
+            "durable_epoch": srv.durable_epoch,
+            "cold_docs": sorted(cold_docs),
+        }, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # the crash window: hang inside the rung rewrite of the next
+    # checkpoint — cold docs were just rehydrated into the anchor, the
+    # new rung has NOT landed, and the parent's SIGKILL arrives here
+    faultinject.inject("ckpt_corrupt", action="hang", delay_s=300)
+    with open(os.path.join(base_dir, "READY"), "w") as f:
+        f.write("ready")
+    srv.checkpoint()  # hangs; never returns before the SIGKILL
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,124 @@
+"""Chaos soak: seeded fault/nemesis schedules with REAL subprocess
+SIGKILLs (standalone, not collected — run directly).
+
+    SOAK_CHAOS_SEEDS=0,1 SOAK_CHAOS_STEPS=60 SOAK_CHAOS_DOCS=4 \\
+        python tests/soak_chaos.py
+
+For each seed: generate the plan with ``allow_kill=True``, find its
+``kill`` step indexes, and orchestrate one ``python -m
+loro_tpu.chaos.run`` child per crash segment — the child executes
+steps up to the kill index (``--hold-at``), flushes every plane,
+publishes ``CHAOS_READY`` and sleeps; this parent SIGKILLs it there
+(a CPU-mesh process — per docs/RESILIENCE.md rule 1 the parent never
+signals TPU work), then resumes a fresh child from the durable dirs
+with ``--resume-from``.  The resumed run recovers every family with
+``recover_sharded_server``, resumes the follower streams, rebuilds
+its reference oracle PURELY from the journal, and its first barrier
+is the no-lost-acked-writes gate: every acked push epoch <= the
+durable watermark must have survived the kill, every plane must
+converge to the regenerated oracle.  The final segment runs to the
+end of the plan; rc != 0 (violation artifact on stderr) fails the
+soak.
+
+Knobs: SOAK_CHAOS_SEEDS (default "0,1"), SOAK_CHAOS_STEPS (60),
+SOAK_CHAOS_DOCS (4).
+"""
+import os
+import os.path as _p
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))  # repo root
+
+SEEDS = [int(x) for x in
+         os.environ.get("SOAK_CHAOS_SEEDS", "0,1").replace(",", " ").split()]
+STEPS = int(os.environ.get("SOAK_CHAOS_STEPS", "60"))
+DOCS = int(os.environ.get("SOAK_CHAOS_DOCS", "4"))
+
+SEGMENT_TIMEOUT_S = 1200.0
+
+
+def _spawn(seed: int, root: str, resume_from: int, hold_at=None):
+    argv = [
+        sys.executable, "-m", "loro_tpu.chaos.run",
+        "--seed", str(seed), "--steps", str(STEPS), "--docs", str(DOCS),
+        "--allow-kill", "--dir", root,
+    ]
+    if resume_from:
+        argv += ["--resume-from", str(resume_from)]
+    if hold_at is not None:
+        argv += ["--hold-at", str(hold_at)]
+    return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+
+
+def _wait_ready(proc, marker: str) -> None:
+    deadline = time.time() + SEGMENT_TIMEOUT_S
+    while not os.path.exists(marker):
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace")
+            err = proc.stderr.read().decode(errors="replace")
+            raise AssertionError(
+                f"chaos child exited (rc={proc.returncode}) before its "
+                f"hold point:\n{out[-2000:]}\n{err[-2000:]}")
+        if time.time() > deadline:
+            proc.kill()
+            raise AssertionError("chaos child never reached its hold point")
+        time.sleep(0.2)
+
+
+def run_seed(seed: int) -> None:
+    from loro_tpu.chaos.plan import ChaosConfig, generate_plan
+
+    cfg = ChaosConfig(seed=seed, steps=STEPS, docs=DOCS, allow_kill=True)
+    plan = generate_plan(cfg)
+    kills = sorted(s.i for s in plan if s.kind == "kill")
+    root = tempfile.mkdtemp(prefix=f"soak_chaos_s{seed}_")
+    marker = os.path.join(root, "CHAOS_READY")
+    print(f"seed {seed}: {len(plan)} steps, SIGKILL at {kills}", flush=True)
+    try:
+        resume = 0
+        for k in kills:
+            t0 = time.time()
+            proc = _spawn(seed, root, resume, hold_at=k)
+            _wait_ready(proc, marker)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+            os.unlink(marker)
+            print(f"  killed at step {k} ({time.time() - t0:.1f}s); "
+                  f"resuming from {k + 1}", flush=True)
+            resume = k + 1
+        proc = _spawn(seed, root, resume)
+        try:
+            out, err = proc.communicate(timeout=SEGMENT_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise AssertionError("final chaos segment timed out")
+        out = out.decode(errors="replace")
+        for line in out.strip().splitlines():
+            print(f"  {line}", flush=True)
+        if proc.returncode != 0:
+            raise AssertionError(
+                f"seed {seed} VIOLATION (rc={proc.returncode}): "
+                f"{err.decode(errors='replace').strip()}")
+        shutil.rmtree(root, ignore_errors=True)
+    except BaseException:
+        print(f"  durable root preserved for inspection: {root}",
+              flush=True)
+        raise
+
+
+def main() -> None:
+    t0 = time.time()
+    for seed in SEEDS:
+        run_seed(seed)
+    print(f"soak_chaos OK: seeds {SEEDS}, {STEPS} steps each, "
+          f"{time.time() - t0:.0f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -370,6 +370,77 @@ class TestShardedFollower:
             lead.close()
 
 
+class TestTieredFollower:
+    """Chaos-plane regression (soak_chaos seed 0): a follower over a
+    TIERED leader recovers with cold docs — their tier map rides the
+    shipped rungs — but then detaches the durable log, making every
+    cold-tier exit (reads, oracle seeding, the shipped-checkpoint
+    rehydrate) raise ``ResidencyError: ... no durable log``.  The
+    bootstrap must flatten the cold tier (rung + WAL-tail state folded
+    into the anchor, docs lifted warm) while the log is still
+    attached."""
+
+    def test_cold_docs_flatten_at_bootstrap(self, tmp_path):
+        fam, n_docs = "text", 3
+        docs = [crash.make_doc(fam, i) for i in range(n_docs)]
+        cid = crash.container_id(fam, docs[0])
+        ldir = str(tmp_path / "L")
+        lead = ResidentServer(fam, n_docs, hot_slots=1, durable_dir=ldir,
+                              **CAPS[fam])
+        fol = None
+        marks = [None] * n_docs
+        try:
+            replication.enable(lead, "leader")
+
+            def push(di, r=None):
+                d = docs[di]
+                if marks[di] is None:
+                    chs = d.oplog.changes_in_causal_order()
+                else:
+                    crash.apply_edit(d, fam, r)
+                    chs = d.oplog.changes_between(marks[di], d.oplog_vv())
+                marks[di] = d.oplog_vv()
+                ups = [None] * n_docs
+                ups[di] = chs
+                lead.ingest(ups, cid)
+
+            for di in range(n_docs):
+                push(di)
+            lead.checkpoint()
+            # hot_slots=1 leaves two warm docs: freeze one cold, then
+            # checkpoint so the newest rung carries the cold tier map
+            # (what the follower's recover_server restores from)
+            cold_di = lead.residency.tiers()["warm"][0]
+            lead.batch.demote(cold_di)
+            lead.checkpoint()
+            assert lead.residency.tier_of(cold_di) == "cold"
+            before = obs.counter("residency.cold_flattens_total").total()
+            fol = Follower(ldir, str(tmp_path / "F"), leader=lead)
+            # the bootstrap flattened: no cold docs on the follower,
+            # and the formerly-cold doc reads without the durable log
+            assert obs.counter(
+                "residency.cold_flattens_total").total() == before + 1
+            assert fol.resident.residency.tiers()["cold"] == []
+            assert fol.resident.texts() == [
+                crash.read_oracle(d, fam)[0] for d in docs
+            ]
+            # a shipped checkpoint marker folds the anchor through the
+            # rehydrate path — the exact call the soak crashed in
+            for r in range(2, 6):
+                push(r % n_docs, r)
+            lead.checkpoint()
+            fol.catch_up()
+            assert fol.lag_epochs == 0
+            assert fol.ckpts_applied >= 1
+            assert fol.resident.texts() == [
+                crash.read_oracle(d, fam)[0] for d in docs
+            ]
+        finally:
+            if fol is not None:
+                fol.close()
+            lead.close()
+
+
 # ---------------------------------------------------------------------------
 # read-only serving: NotLeader, read-your-writes, promotion flip
 # ---------------------------------------------------------------------------

@@ -166,7 +166,7 @@ _CORE_KEYS = (
 # always routed to the sidecar line: prose, dict sidecars, series
 _SIDECAR_KEYS = (
     "metrics", "resilience", "pipeline", "rank", "sync", "shard", "tier",
-    "readplane", "repl",
+    "readplane", "repl", "trace",
     "baseline_note", "latency_note", "roofline_note",
     "roofline_measured_note", "resident_note", "resident_durable_note",
     "resident_pipeline_note", "e2e_note", "e2e_unit", "richtext_unit",
@@ -1628,6 +1628,49 @@ def main() -> None:
                 ),
             )
             _ssrv.close()
+            # trace sidecar (ISSUE 14): the stage decomposition of the
+            # push-to-visible headline — per-stage mean ms (the stages
+            # telescope, so their means sum to the p2v mean over the
+            # same tickets), one exemplar trace id per stage, and the
+            # flight ring state
+            from loro_tpu.obs import flight as _flight
+
+            _stage_h = _obsm.histogram("trace.push_stage_seconds")
+            _tstages = {}
+            for _row in _stage_h.snapshot()["values"]:
+                _stg = _row["labels"].get("stage")
+                if _stg is None:
+                    continue
+                _n = _row["count"]
+                _ent = _tstages.setdefault(
+                    _stg, {"count": 0, "sum_ms": 0.0}
+                )
+                _ent["count"] += _n
+                _ent["sum_ms"] += _row["sum"] * 1e3
+                _ex = _row.get("exemplars") or {}
+                if _ex:
+                    _ent["exemplar"] = list(_ex.values())[-1]
+            for _ent in _tstages.values():
+                _ent["mean_ms"] = round(
+                    _ent.pop("sum_ms") / max(_ent["count"], 1), 3
+                )
+            _trace_side = {
+                "stages": _tstages,
+                "stage_sum_mean_ms": round(
+                    sum(s["mean_ms"] for s in _tstages.values()), 3
+                ),
+                "p2v_mean_ms": round(_p2v.summary()["mean"] * 1e3, 3),
+                "flight_recorded": _flight.recorder().recorded_total,
+                "flight_capacity": _flight.recorder().capacity,
+                "note": (
+                    "per-stage push latency attribution "
+                    "(trace.push_stage_seconds): queue_wait -> "
+                    "coalesce_wait -> stage -> commit -> fsync -> "
+                    "fanout telescope to push-to-visible; exemplar = "
+                    "a trace id that landed in the stage's slowest "
+                    "populated bucket"
+                ),
+            }
             bank(
                 "sync",
                 sync_sessions=n_sess,
@@ -1635,6 +1678,7 @@ def main() -> None:
                 sync_push_to_visible_ms_p50=round(_p50 * 1e3, 2),
                 sync_push_to_visible_ms_p99=round(_p99 * 1e3, 2),
                 sync=_srep,
+                trace=_trace_side,
             )
             note(
                 f"sync: {n_sess} sessions, {_pushes/_ssec:.0f} pushes/s, "

@@ -56,6 +56,12 @@ The order, with the paths that establish each edge:
   (route→dev→…→epoch on every fleet commit).
 - ``supervisor.state`` — DeviceSupervisor counters; a strict leaf
   under every launch (dev→supervisor).
+- ``obs.flight``       — the flight-recorder ring (obs/flight.py); the
+  innermost level by construction: ``flight.record()`` is called from
+  every plane (WAL appends, supervised launches, commit hooks) while
+  their locks are held, and the recorder calls nothing while holding
+  it (a thread-local reentrancy guard drops nested records, so even
+  the lock witness observing this lock cannot re-enter it).
 """
 from __future__ import annotations
 
@@ -74,6 +80,7 @@ LEVELS: Dict[str, int] = {
     "fleet.dev": 60,
     "sharded.epoch": 70,
     "supervisor.state": 80,
+    "obs.flight": 90,
 }
 
 # explicitly-allowed extra edges that the pure level order forbids —

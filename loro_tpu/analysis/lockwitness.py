@@ -95,12 +95,30 @@ class LockWitness:
         held.order.append(name)
         if new_edges or bad:
             tname = threading.current_thread().name
+            first: List[Tuple[str, str]] = []
             with self._glock:
                 for e in new_edges:
-                    self._edges[e] = self._edges.get(e, 0) + 1
+                    n = self._edges.get(e, 0)
+                    if n == 0:
+                        first.append(e)
+                    self._edges[e] = n + 1
                     self._first_thread.setdefault(e, tname)
                 self._violations.extend(bad)
             self._obs_update(len(bad))
+            # flight recorder: first-seen edges are rare, structural
+            # events — exactly what a post-mortem wants.  Fired OUTSIDE
+            # _glock; the recorder's reentrancy guard drops the nested
+            # record its own lock acquisition would otherwise produce.
+            if first or bad:
+                try:
+                    from ..obs import flight
+
+                    for a, b in first:
+                        flight.record("lock.edge", held=a, acquired=b)
+                    for msg in bad:
+                        flight.record("lock.violation", detail=msg)
+                except Exception:  # tpulint: disable=LT-EXC(the flight ring must never break a lock acquire)
+                    pass
         if bad and self.strict:
             raise LockOrderViolation("; ".join(bad))
 

@@ -30,6 +30,11 @@ The invariants (the ISSUE 13 list):
 - ``obs_sanity``    — no raw (untyped) error ever reached a session,
   every client operation eventually landed, and the serving oracle
   never failed an apply (``sync.oracle_apply_errors_total``)
+- ``attribution``   — every resolved push ticket's per-stage timing
+  breakdown telescopes to its end-to-end total (stages sum == total
+  within float tolerance, no stage negative beyond jitter) — the
+  request-tracing plane's own sanity gate (ISSUE 14,
+  docs/OBSERVABILITY.md "Request tracing")
 """
 from __future__ import annotations
 
@@ -251,6 +256,32 @@ class InvariantChecker:
                 "applies (planes can diverge)", step))
         return out
 
+    def _attribution(self, step: int) -> List[Violation]:
+        """Stage sums must telescope to the end-to-end total: a stage
+        mark recorded out of order (or a path that double-counts a
+        boundary) makes the breakdown lie, and a lying attribution
+        plane is worse than none.  Tolerance covers float summation
+        only — the marks are constructed telescoping."""
+        out: List[Violation] = []
+        for bd in self.stack.breakdowns:
+            stages = {k: v for k, v in bd.items()
+                      if k.endswith("_ms") and k != "total_ms"}
+            ssum = sum(stages.values())
+            if abs(ssum - bd.get("total_ms", 0.0)) > 0.01:
+                out.append(Violation(
+                    "attribution", bd.get("family", "*"),
+                    f"push {bd.get('trace_id')}: stage sum "
+                    f"{ssum:.3f}ms != total {bd.get('total_ms'):.3f}ms "
+                    f"(stages {sorted(stages)})", step))
+            for k, v in stages.items():
+                if v < -0.01:
+                    out.append(Violation(
+                        "attribution", bd.get("family", "*"),
+                        f"push {bd.get('trace_id')}: negative stage "
+                        f"{k}={v:.3f}ms (marks out of order)", step))
+        self.stack.breakdowns = []
+        return out
+
     # -- the barrier ----------------------------------------------------
     def check(self, step: int = -1) -> List[Violation]:
         """One barrier: settle, then run every invariant.  Returns all
@@ -265,6 +296,7 @@ class InvariantChecker:
         out += self._inspect(step)
         out += self._lock_witness(step)
         out += self._obs_sanity(step)
+        out += self._attribution(step)
         for v in out:
             obs.counter("chaos.violations_total",
                         "invariant violations detected at barriers").inc(

@@ -63,6 +63,8 @@ class ChaosReport:
         return not self.violations
 
     def to_artifact(self) -> dict:
+        from ..obs import flight
+
         return {
             "version": ARTIFACT_VERSION,
             "config": self.config.to_json(),
@@ -72,6 +74,11 @@ class ChaosReport:
             "checks": self.checks,
             "fired": dict(self.fired),
             "verdict": "clean" if self.clean else "violation",
+            # black box: the flight-recorder tail at artifact time —
+            # what was in flight when the violation surfaced.  Replay
+            # ignores it (the executable plan is `trace`), so the
+            # determinism gate (trace bytes) is unaffected.
+            "flight": flight.tail(200),
         }
 
     def trace_json(self) -> str:

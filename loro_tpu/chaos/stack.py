@@ -192,6 +192,10 @@ class ChaosStack:
         # obs-sanity invariant reads and drains this
         self.raw_errors: List[str] = []
         self.unresolved: List[str] = []  # ops retries could not land
+        # resolved push-ticket breakdowns (bounded window) — the
+        # attribution invariant checks each one's stages telescope to
+        # its end-to-end total (docs/OBSERVABILITY.md)
+        self.breakdowns: List[dict] = []
         os.makedirs(root, exist_ok=True)
         for fam in cfg.families:
             p = FamilyPlane(fam)
@@ -375,6 +379,11 @@ class ChaosStack:
                     tk = self._session_of(c, fam).push(di, payload)
                     acked[fam] = tk.epoch(120)
                     p.max_acked = max(p.max_acked, acked[fam])
+                    bd = tk.breakdown()
+                    bd["family"] = fam
+                    self.breakdowns.append(bd)
+                    if len(self.breakdowns) > 128:
+                        del self.breakdowns[:64]
                     err = None
                     break
                 except _TYPED_CLIENT_ERRORS as e:

@@ -126,6 +126,14 @@ class ReplicaLag(ReplicationError):
     from the leader."""
 
 
+class ObsError(LoroError):
+    """Observability-tooling failure (loro_tpu/obs/): an unreadable or
+    malformed trace/flight artifact handed to ``python -m
+    loro_tpu.obs.trace``, or a merge over artifacts with no common
+    epoch stamps.  Always raised typed so the CLI exits with a legible
+    message instead of a stack trace."""
+
+
 class AnalysisError(LoroError):
     """Base for the static-analysis / invariant-witness subsystem
     (loro_tpu/analysis/, docs/ANALYSIS.md)."""

@@ -15,6 +15,7 @@ Quick use::
 """
 from __future__ import annotations
 
+from . import flight
 from .exposition import prometheus_text, serve, sidecar, snapshot_json
 from .metrics import (
     Registry,
@@ -43,6 +44,7 @@ __all__ = [
     "enable_span_metrics",
     "disable_span_metrics",
     "measure_tunnel_rtt",
+    "flight",
 ]
 
 # -- tracing bridge ----------------------------------------------------

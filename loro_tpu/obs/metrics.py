@@ -110,12 +110,15 @@ class Gauge(_Metric):
 
 
 class _HistState:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
         self.sum = 0.0
         self.count = 0
+        # per-bucket exemplar trace ids (lazily allocated: observations
+        # without exemplars pay nothing — the common case)
+        self.exemplars: Optional[list] = None
 
 
 class Histogram(_Metric):
@@ -129,7 +132,13 @@ class Histogram(_Metric):
             raise ValueError(f"histogram {name}: empty bucket list")
         self.buckets = bs  # upper bounds; +Inf is implicit
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """Record one observation.  ``exemplar`` (a trace id) is
+        retained per BUCKET (last-writer-wins, one string slot per
+        bucket — bounded memory), so a p99 bucket in a dashboard is
+        explorable: ``exemplars()`` hands back a concrete request id
+        that landed there (docs/OBSERVABILITY.md "Request tracing")."""
         k = _label_key(labels)
         with self._lock:
             st = self._values.get(k)
@@ -142,6 +151,10 @@ class Histogram(_Metric):
             st.counts[i] += 1
             st.sum += v
             st.count += 1
+            if exemplar is not None:
+                if st.exemplars is None:
+                    st.exemplars = [None] * (n + 1)
+                st.exemplars[i] = str(exemplar)
 
     @contextmanager
     def time(self, **labels):
@@ -183,6 +196,22 @@ class Histogram(_Metric):
             "p99": _hist_quantile(self.buckets, st.counts, st.count, 0.99),
         }
 
+    def exemplars(self, **labels) -> Dict[str, str]:
+        """Per-bucket exemplar trace ids for one label set:
+        ``{"le_0.05": "t1a2f-3", ..., "le_+Inf": ...}`` (only buckets
+        that retained one).  Empty when no observation ever carried an
+        exemplar."""
+        with self._lock:
+            st = self._values.get(_label_key(labels))
+            if st is None or st.exemplars is None:
+                return {}
+            out = {}
+            for i, ex in enumerate(st.exemplars):
+                if ex is not None:
+                    le = self.buckets[i] if i < len(self.buckets) else "+Inf"
+                    out[f"le_{le}"] = ex
+            return out
+
     def _value_rows(self) -> List[dict]:
         rows = []
         with self._lock:
@@ -194,12 +223,18 @@ class Histogram(_Metric):
                 cum += st.counts[i]
                 buckets.append([le, cum])
             buckets.append(["+Inf", cum + st.counts[-1]])
-            rows.append({
+            row = {
                 "labels": dict(k),
                 "count": st.count,
                 "sum": st.sum,
                 "buckets": buckets,
-            })
+            }
+            if st.exemplars is not None:
+                row["exemplars"] = {
+                    str(self.buckets[i] if i < len(self.buckets) else "+Inf"): ex
+                    for i, ex in enumerate(st.exemplars) if ex is not None
+                }
+            rows.append(row)
         return rows
 
 

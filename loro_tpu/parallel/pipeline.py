@@ -48,19 +48,28 @@ from typing import List, Optional, Sequence
 
 from ..analysis.lockwitness import named_lock
 from ..obs import metrics as obs
+from ..utils import tracing
 
 
 class PendingRound:
     """Handle for one submitted round: ``epoch()`` blocks until the
     round's group has been applied (and, in group-commit mode, fsynced)
-    and returns the visible epoch clients ack."""
+    and returns the visible epoch clients ack.
 
-    __slots__ = ("_ev", "_epoch", "_error")
+    ``trace_id`` (set by the submitter) rides into the commit thread's
+    ambient trace context so the WAL round record is stamped with the
+    request that caused it; ``marks`` carries the stage-boundary
+    timestamps the owning PushTickets fold into their breakdowns
+    (docs/OBSERVABILITY.md "Request tracing")."""
+
+    __slots__ = ("_ev", "_epoch", "_error", "trace_id", "marks")
 
     def __init__(self):
         self._ev = threading.Event()
         self._epoch: Optional[int] = None
         self._error: Optional[BaseException] = None
+        self.trace_id: Optional[str] = None
+        self.marks: List[tuple] = []  # (stage_name, perf_counter)
 
     def _resolve(self, epoch: int) -> None:
         self._epoch = epoch
@@ -130,7 +139,8 @@ class PipelinedIngest:
         self._t0: Optional[float] = None
 
     # -- producer side -------------------------------------------------
-    def submit(self, per_doc_updates: Sequence, cid=None) -> PendingRound:
+    def submit(self, per_doc_updates: Sequence, cid=None,
+               trace: Optional[str] = None) -> PendingRound:
         """Queue one sync round (same payload contract as
         ``ResidentServer.ingest``).  Blocks while the queue is at the
         backpressure bound; returns a ``PendingRound`` whose
@@ -152,6 +162,9 @@ class PipelinedIngest:
             for u in per_doc_updates
         ]
         pr = PendingRound()
+        # set BEFORE the round is visible to the workers: the commit
+        # thread reads it for the ambient WAL trace stamp
+        pr.trace_id = trace if trace is not None else tracing.current()
         with self._cv:
             self._check_open()
             if self._t0 is None:
@@ -299,6 +312,10 @@ class PipelinedIngest:
                 return
             dt = time.perf_counter() - t0
             futs = [pr for _ups, _c, pr in group]
+            for pr in futs:
+                # attribution: waited-for-grouping, then host staging
+                pr.marks.append(("coalesce_wait", t0))
+                pr.marks.append(("stage", t0 + dt))
             exclusive = (
                 handle.mode != "group" or handle.error_index is not None
             )
@@ -346,7 +363,13 @@ class PipelinedIngest:
                 self._cv.notify_all()
             t0 = time.perf_counter()
             try:
-                epochs = srv.ingest_commit(handle)
+                # ambient trace: the WAL appends inside ingest_commit
+                # stamp their round records with the request that led
+                # the group (group granularity — one fsync window)
+                with tracing.ambient(next(
+                    (pr.trace_id for pr in futs if pr.trace_id), None
+                )):
+                    epochs = srv.ingest_commit(handle)
             except BaseException as e:  # noqa: BLE001 — fail every waiter
                 with self._cv:
                     self._commit_q.popleft()
@@ -362,7 +385,9 @@ class PipelinedIngest:
                 self._max_group = max(self._max_group, len(futs))
                 if len(futs) > 1:
                     self._coalesced_rounds += len(futs)
+                now = t0 + dt
                 for pr, ep in zip(futs, epochs):
+                    pr.marks.append(("commit", now))
                     pr._resolve(ep)
                 self._committing = False
                 self._cv.notify_all()

@@ -32,11 +32,14 @@ server-side at fleet scale.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Dict, List, Optional, Sequence
 
 from ..errors import DeviceFailure, ResilienceError
+from ..obs import flight
 from ..obs import metrics as obs
 from ..resilience import faultinject, get_supervisor
+from ..utils import tracing
 
 faultinject.register_site(
     "poison_doc", "ResidentServer.ingest: corrupt one doc's payload in "
@@ -135,6 +138,10 @@ class ResidentServer:
     ``persist.recover_server(durable_dir)`` reopens after a crash with
     bounded replay (docs/PERSISTENCE.md).
     """
+
+    # wall clock for the WAL round stamps (replication-lag attribution);
+    # a class-level reference so tests can inject a fake
+    _wall = staticmethod(_time.time)
 
     def __init__(self, family: str, n_docs: int, mesh=None,
                  auto_grow: bool = True, supervisor=None,
@@ -521,7 +528,15 @@ class ResidentServer:
             # and surface typed; the in-memory paths stay consistent,
             # the operator recovers durability from the last checkpoint.
             try:
-                self._durable.append_round(epoch, cid, frozen)
+                # request-tracing stamps: the ambient trace id of the
+                # committing thread (the pipeline/fan-in set it from
+                # the round-leading push) and the leader wall clock —
+                # a follower turns the stamp into measured apply lag
+                self._durable.append_round(
+                    epoch, cid, frozen,
+                    trace=tracing.current(),
+                    stamp_us=int(self._wall() * 1e6),
+                )
             except BaseException as e:
                 from ..errors import FencedLeader, PersistError
 
@@ -1007,6 +1022,8 @@ class ResidentServer:
         return lambda: self._epoch_subs.remove(cb)
 
     def _notify_epoch(self, epoch: int) -> None:
+        flight.record("server.epoch", family=self.family, epoch=epoch,
+                      trace=tracing.current())
         for cb in list(self._epoch_subs):
             try:
                 cb(epoch)

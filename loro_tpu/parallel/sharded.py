@@ -54,6 +54,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time as _time
 from collections import deque
 from typing import List, Optional, Sequence
 
@@ -133,8 +134,10 @@ class ShardedPipeline:
         self._thread: Optional[threading.Thread] = None
         self._rounds = 0
 
-    def submit(self, per_doc_updates: Sequence, cid=None) -> PendingRound:
+    def submit(self, per_doc_updates: Sequence, cid=None,
+               trace: Optional[str] = None) -> PendingRound:
         agg = PendingRound()
+        agg.trace_id = trace
         with self._server._route_lock:
             with self._cv:
                 self._check_open()
@@ -147,7 +150,7 @@ class ShardedPipeline:
             self._server._tick_shard_rounds(parts)
             try:
                 prs = [
-                    pipe.submit(part, cid)
+                    pipe.submit(part, cid, trace=trace)
                     for pipe, part in zip(self._pipes, parts)
                 ]
             except BaseException as e:  # noqa: BLE001 — fail-stop
@@ -208,6 +211,9 @@ class ShardedPipeline:
                     self._cv.notify_all()
                 return
             g = self._server._commit_global(eps)
+            # attribution: one commit boundary for the aggregate round
+            # (per-shard stage/commit detail lives in the shard pipes)
+            agg.marks.append(("commit", _time.perf_counter()))
             agg._resolve(g)
             with self._cv:
                 self._collecting = False

@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import os
 import struct
+import time as _time
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -65,6 +66,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..codec.binary import Reader, Writer
 from ..core.ids import ContainerID, ContainerType
 from ..errors import CodecDecodeError, PersistError
+from ..obs import flight
 from ..obs import metrics as obs
 from ..resilience import faultinject
 
@@ -224,7 +226,12 @@ class WalMeta:
 
 @dataclass
 class WalRecord:
-    """One decoded frame (``rtype`` selects which fields are set)."""
+    """One decoded frame (``rtype`` selects which fields are set).
+    ``trace``/``stamp_us`` are the request-tracing stamps round records
+    optionally carry (docs/OBSERVABILITY.md "Request tracing"): the
+    trace id of the request that committed the round and the leader's
+    wall clock at journal time in microseconds — what a follower's
+    apply loop turns into measured replication-lag attribution."""
 
     rtype: int
     epoch: int = 0
@@ -232,9 +239,12 @@ class WalRecord:
     updates: Optional[List[Optional[bytes]]] = None
     meta: Optional[WalMeta] = None
     ckpt_name: str = ""
+    trace: Optional[str] = None
+    stamp_us: int = 0
 
 
-def _encode_round(epoch: int, cid, updates) -> bytes:
+def _encode_round(epoch: int, cid, updates, trace: Optional[str] = None,
+                  stamp_us: int = 0) -> bytes:
     w = Writer()
     w.u8(R_ROUND)
     w.varint(epoch)
@@ -246,6 +256,17 @@ def _encode_round(epoch: int, cid, updates) -> bytes:
         else:
             w.u8(1)
             w.bytes_(bytes(u))
+    # trailing trace stamps: flags byte + optional fields.  Readers
+    # that predate them stop after the updates (frame length delimits
+    # the payload), and the decoder below checks eof() first — both
+    # directions stay compatible without a record-version bump.
+    if trace is not None or stamp_us:
+        flags = (1 if trace is not None else 0) | (2 if stamp_us else 0)
+        w.u8(flags)
+        if trace is not None:
+            w.str_(trace)
+        if stamp_us:
+            w.u64le(stamp_us)
     return bytes(w.buf)
 
 
@@ -261,7 +282,16 @@ def _decode_payload(payload: bytes) -> WalRecord:
             ups: List[Optional[bytes]] = []
             for _ in range(r.varint()):
                 ups.append(r.bytes_() if r.u8() else None)
-            return WalRecord(R_ROUND, epoch=epoch, cid=cid, updates=ups)
+            trace: Optional[str] = None
+            stamp_us = 0
+            if not r.eof():
+                flags = r.u8()
+                if flags & 1:
+                    trace = r.str_()
+                if flags & 2:
+                    stamp_us = r.u64le()
+            return WalRecord(R_ROUND, epoch=epoch, cid=cid, updates=ups,
+                             trace=trace, stamp_us=stamp_us)
         if rtype == R_CKPT:
             return WalRecord(R_CKPT, epoch=r.varint(), ckpt_name=r.str_())
         if rtype == R_PRUNE:
@@ -537,6 +567,7 @@ class WriteAheadLog:
         """fsync the active segment handle (timed + counted: the
         bench A/B and the count-based perf guard compare fsyncs/round
         across commit modes)."""
+        t0 = _time.perf_counter()
         with obs.histogram(
             "persist.wal_fsync_seconds", "WAL fsync wall time"
         ).time():
@@ -544,6 +575,10 @@ class WriteAheadLog:
         obs.counter(
             "persist.wal_fsyncs_total", "WAL data fsyncs issued"
         ).inc(mode=self.fsync_mode)
+        flight.record(
+            "wal.fsync", mode=self.fsync_mode,
+            ms=round((_time.perf_counter() - t0) * 1e3, 3),
+        )
 
     def sync(self) -> int:
         """Group-commit flush point: fsync the active segment if any
@@ -588,10 +623,18 @@ class WriteAheadLog:
         # it (the rotation/prune paths sync their copies the same way)
         self.sync()
 
-    def append_round(self, epoch: int, cid, updates) -> None:
+    def append_round(self, epoch: int, cid, updates,
+                     trace: Optional[str] = None,
+                     stamp_us: int = 0) -> None:
         """Journal one applied round (``updates``: per-doc frozen wire
-        bytes, None = no update for that doc)."""
-        self._append(_encode_round(epoch, cid, updates), rtype="round")
+        bytes, None = no update for that doc).  ``trace``/``stamp_us``
+        optionally stamp the record with the committing request's trace
+        id and the leader wall clock (replication-lag attribution —
+        docs/OBSERVABILITY.md)."""
+        self._append(
+            _encode_round(epoch, cid, updates, trace, stamp_us),
+            rtype="round",
+        )
         a = self._active
         a.min_epoch = epoch if a.min_epoch is None else a.min_epoch
         a.max_epoch = epoch
@@ -804,8 +847,10 @@ class DurableLog:
             s.max_epoch is not None for s in self.wal.segments()
         ) or bool(self.checkpoints.list())
 
-    def append_round(self, epoch: int, cid, updates) -> None:
-        self.wal.append_round(epoch, cid, updates)
+    def append_round(self, epoch: int, cid, updates,
+                     trace: Optional[str] = None,
+                     stamp_us: int = 0) -> None:
+        self.wal.append_round(epoch, cid, updates, trace, stamp_us)
 
     def record_checkpoint(self, epoch: int, blob: bytes) -> str:
         name = self.checkpoints.save(epoch, blob)

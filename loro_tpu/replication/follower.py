@@ -43,9 +43,11 @@ from __future__ import annotations
 import json
 import os
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..analysis.lockwitness import named_rlock
+from ..obs import flight
 from ..errors import (
     FencedLeader,
     ReplicationError,
@@ -159,6 +161,11 @@ class Follower:
         self.ckpts_applied = 0
         self.catch_ups = 0
         self.leader_epoch_seen = 0
+        # replication-lag attribution (docs/OBSERVABILITY.md): shipped
+        # round records carry the leader's wall-clock stamp + trace id;
+        # each apply measures leader-commit -> follower-apply lag and
+        # keeps a bounded sample window (``lag_samples()``)
+        self._lag_samples: deque = deque(maxlen=256)
         # segment indexes whose full SEALED extent we hold (sealed at
         # source = rotation fsync'd it closed, and we shipped to its
         # size).  The continuity check below needs it: a source segment
@@ -382,6 +389,28 @@ class Follower:
                     self.applied_epoch = srv.epoch
                     applied += 1
                     self.rounds_applied += 1
+                    if rec.stamp_us:
+                        # measured leader-commit -> follower-apply lag:
+                        # the shipped wall stamp against our clock (same
+                        # machine or NTP-close hosts; negative skew
+                        # clamps to 0 — lag is never negative)
+                        lag_s = max(
+                            0.0, self._clock() - rec.stamp_us * 1e-6
+                        )
+                        self._lag_samples.append(
+                            (rec.epoch, rec.trace, lag_s * 1e3)
+                        )
+                        obs.histogram(
+                            "repl.apply_lag_seconds",
+                            "leader WAL-stamp -> follower apply "
+                            "(measured replication lag attribution)",
+                        ).observe(lag_s, follower=self.follower_id,
+                                  exemplar=rec.trace)
+                        flight.record(
+                            "repl.apply", epoch=rec.epoch,
+                            trace=rec.trace,
+                            lag_ms=round(lag_s * 1e3, 3),
+                        )
                     if self.sync is not None:
                         self.sync._apply_replicated(
                             self.applied_epoch, rec.cid, rec.updates
@@ -504,8 +533,17 @@ class Follower:
                 return 0
             return self.sync.warm_read_plane(max_window, max_peers)
 
+    def lag_samples(self) -> List[Tuple[int, Optional[str], float]]:
+        """Recent ``(epoch, trace_id, lag_ms)`` apply-lag attributions
+        (bounded window; empty before the first stamped round applies
+        — e.g. a leader that predates round stamping).  Snapshotted
+        under the follower lock: catch_up() appends concurrently (the
+        lock is reentrant, so catch_up's own report() call is fine)."""
+        with self._lock:
+            return list(self._lag_samples)
+
     def report(self) -> dict:
-        return {
+        out = {
             "follower_id": self.follower_id,
             "applied_epoch": self.applied_epoch,
             "leader_epoch_seen": self.leader_epoch_seen,
@@ -516,6 +554,11 @@ class Follower:
             "catch_ups": self.catch_ups,
             "promoted": self.promoted,
         }
+        lags = sorted(ms for _e, _t, ms in self.lag_samples())
+        if lags:
+            out["apply_lag_ms_p50"] = round(lags[len(lags) // 2], 3)
+            out["apply_lag_ms_max"] = round(lags[-1], 3)
+        return out
 
     def promote(self, leader_id: Optional[str] = None,
                 fsync=True) -> "object":

@@ -101,6 +101,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ..errors import ConfigError
+from ..obs import flight as _flight
 from ..obs import metrics as _obs
 
 # -- fault-site registry ----------------------------------------------
@@ -306,6 +307,8 @@ def _take(site: str, doc: Optional[int] = None,
                 continue
             f.fired += 1
             _obs.counter("faultinject.fired_total").inc(site=site, action=f.action)
+            _flight.record("fault.fired", site=site, action=f.action,
+                           doc=doc)
             return f
     return None
 

@@ -29,6 +29,7 @@ import time
 from typing import Callable, Optional
 
 from ..errors import BackendUnavailable
+from ..obs import flight
 from ..obs import metrics as obs
 from .faultinject import register_site
 
@@ -142,6 +143,9 @@ def wait_for_backend(deadline_s: float,
         if st is not None and st.get("step") == "done":
             out = dict(st, ok=True, probes=probes, waited_s=clock() - t0)
             obs.gauge("probe.backend_up").set(1)
+            flight.record("probe.done", probes=probes,
+                          waited_s=round(out["waited_s"], 3),
+                          platform=st.get("platform"))
             return out
         now = clock()
         if now >= deadline:
@@ -149,6 +153,8 @@ def wait_for_backend(deadline_s: float,
         if now - last_spawn >= stagger_s:
             # the previous probe is stale (hung init or died): abandon
             # it unsignaled and start a fresh attempt — the lottery
+            flight.record("probe.respawn", probes=probes,
+                          last_step=(st or {}).get("step"))
             spawn(status_path)
             probes += 1
             last_spawn = now
@@ -156,6 +162,13 @@ def wait_for_backend(deadline_s: float,
     st = read_status(status_path) or {}
     obs.gauge("probe.backend_up").set(0)
     out = dict(st, ok=False, probes=probes, waited_s=clock() - t0)
+    # the ladder timing out IS the TPU-pool-lottery post-mortem case
+    # that used to die with nothing: log it and (when armed) dump the
+    # black box
+    flight.record("probe.timeout", probes=probes,
+                  last_step=st.get("step"),
+                  waited_s=round(out["waited_s"], 3))
+    flight.dump_on("probe_timeout")
     if raise_on_timeout:
         raise BackendUnavailable(
             "backend_init", probes,
@@ -188,4 +201,9 @@ def tunnel_alive(timeout_s: float = 75.0) -> bool:
     except subprocess.TimeoutExpired:
         ok = False  # abandoned, not signaled
     obs.gauge("probe.tunnel_alive").set(1 if ok else 0)
+    flight.record("probe.tunnel", alive=ok)
+    if not ok:
+        # a dead tunnel probe is the wedge signature — the black box
+        # is the only record of what was in flight when it happened
+        flight.dump_on("tunnel_wedge")
     return ok

@@ -37,6 +37,7 @@ from typing import Callable, Optional
 
 from ..analysis.lockwitness import named_lock
 from ..errors import DeadlineExceeded, DeviceFailure, LoroError
+from ..obs import flight
 from ..obs import metrics as obs
 from . import faultinject
 
@@ -172,11 +173,17 @@ class DeviceSupervisor:
                     with self._lock:
                         self._retries += 1
                     obs.counter("resilience.retries_total").inc(label=label)
+                    flight.record("sup.retry", label=label,
+                                  attempt=attempts,
+                                  error=f"{type(e).__name__}: {e}"[:160])
                     self.sleep(self.retry.backoff(attempts - 1))
                     continue
                 with self._lock:
                     self._failures += 1
                 obs.counter("resilience.launch_failures_total").inc(label=label)
+                flight.record("sup.failure", label=label,
+                              attempts=attempts,
+                              error=f"{type(e).__name__}: {e}"[:160])
                 raise DeviceFailure(
                     label, attempts, f"{type(e).__name__}: {e}"
                 ) from e
@@ -280,10 +287,16 @@ class DeviceSupervisor:
     # -- degradation accounting ---------------------------------------
     def note_degradation(self, where: str) -> None:
         """Callers report a host-fallback degradation so the bench
-        sidecar captures it."""
+        sidecar captures it.  The flight recorder logs the event and —
+        when auto-dumping is armed (``LORO_FLIGHT_DIR``) — writes the
+        black-box snapshot: the last N structured events BEFORE the
+        degradation, which is exactly what the post-mortems never had
+        (docs/OBSERVABILITY.md "Flight recorder")."""
         with self._lock:
             self._degradations += 1
         obs.counter("resilience.degradations_total").inc(where=where)
+        flight.record("sup.degrade", where=where)
+        flight.dump_on(f"degradation:{where}")
 
     # -- tunnel probe --------------------------------------------------
     def tunnel_alive(self, timeout_s: float = 75.0) -> bool:

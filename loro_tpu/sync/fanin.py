@@ -37,15 +37,27 @@ from ..obs import metrics as obs
 
 class PushTicket:
     """Handle for one submitted push: ``epoch()`` blocks until the
-    push's round committed and returns the visible epoch to ack."""
+    push's round committed and returns the visible epoch to ack.
 
-    __slots__ = ("_ev", "_epoch", "_error", "t0")
+    Request tracing (docs/OBSERVABILITY.md): ``trace_id`` is minted at
+    push entry and carried through every stage; ``marks`` accumulates
+    ``(stage_name, perf_counter)`` pairs at the stage BOUNDARIES the
+    push crosses (fan-in dequeue, pipeline stage/commit, fsync,
+    visibility), so ``breakdown()`` telescopes them into per-stage
+    durations that sum EXACTLY to the push-to-visible total."""
 
-    def __init__(self):
+    __slots__ = ("_ev", "_epoch", "_error", "t0", "trace_id", "marks")
+
+    def __init__(self, trace_id: Optional[str] = None):
         self._ev = threading.Event()
         self._epoch: Optional[int] = None
         self._error: Optional[BaseException] = None
         self.t0 = time.perf_counter()  # push-to-visible clock start
+        self.trace_id = trace_id
+        self.marks: List[tuple] = []   # (stage_name, t) in crossing order
+
+    def mark(self, stage: str, t: Optional[float] = None) -> None:
+        self.marks.append((stage, time.perf_counter() if t is None else t))
 
     def _resolve(self, epoch: int) -> None:
         self._epoch = epoch
@@ -65,6 +77,21 @@ class PushTicket:
         if self._error is not None:
             raise self._error
         return self._epoch
+
+    def breakdown(self) -> dict:
+        """Per-stage timing attribution (milliseconds): the durations
+        between consecutive marks, named by the stage each mark closes,
+        plus ``total_ms`` (creation -> last mark).  Telescoping by
+        construction: ``sum(stages) == total_ms`` exactly (the chaos
+        ``attribution`` invariant gates this).  Stages a path skipped
+        (e.g. no pipeline -> no stage/coalesce split) are absent."""
+        out: dict = {"trace_id": self.trace_id}
+        prev = self.t0
+        for name, t in self.marks:
+            out[f"{name}_ms"] = (t - prev) * 1e3
+            prev = t
+        out["total_ms"] = (prev - self.t0) * 1e3
+        return out
 
 
 class FanIn:
@@ -178,6 +205,10 @@ class FanIn:
                 batch: List[tuple] = []
                 while self._q and len(batch) < self._max_batch:
                     batch.append(self._q.popleft())
+                now = time.perf_counter()
+                for _di, _pl, tk, _s in batch:
+                    # attribution: time queued behind the fan-in worker
+                    tk.mark("queue_wait", now)
                 self._busy = True
                 self._batches += 1
                 self._max_batch_seen = max(self._max_batch_seen, len(batch))

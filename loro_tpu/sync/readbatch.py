@@ -76,17 +76,25 @@ class PullTicket:
     """Handle for one batched pull: ``result()`` blocks until the
     window serving it resolves, then returns ``(data, new_vv, epoch)``
     — the wire bytes, the client's advanced frontier (a private copy),
-    and the committed epoch the pull covers (the ack watermark)."""
+    and the committed epoch the pull covers (the ack watermark).
 
-    __slots__ = ("_ev", "_data", "_vv", "_epoch", "_error", "t0")
+    ``trace_id`` and ``stages`` carry the read-side attribution
+    (window-wait / launch / frame, or the cache-hit and degraded
+    paths) the serving window fills in before resolving — the pull
+    dual of ``fanin.PushTicket.breakdown()``."""
 
-    def __init__(self):
+    __slots__ = ("_ev", "_data", "_vv", "_epoch", "_error", "t0",
+                 "trace_id", "stages")
+
+    def __init__(self, trace_id: Optional[str] = None):
         self._ev = threading.Event()
         self._data: Optional[bytes] = None
         self._vv: Optional[VersionVector] = None
         self._epoch = 0
         self._error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        self.trace_id = trace_id
+        self.stages: Optional[dict] = None
 
     def _resolve(self, data: bytes, vv: VersionVector, epoch: int) -> None:
         self._data, self._vv, self._epoch = data, vv, epoch
@@ -243,12 +251,13 @@ class ReadBatcher:
         ).inc(family=self._server.family)
         return data, head_vv.copy(), epoch
 
-    def submit(self, di: int, from_vv: VersionVector) -> PullTicket:
+    def submit(self, di: int, from_vv: VersionVector,
+               trace: Optional[str] = None) -> PullTicket:
         """Enqueue one pull (cheap — callers may hold the server
         lock).  The caller must then ``drive()`` the ticket OUTSIDE
         the server lock: leadership can run the degraded-window
         fallback, which re-enters the oracle under that lock."""
-        tk = PullTicket()
+        tk = PullTicket(trace_id=trace)
         with self._cv:
             if self._stop:
                 raise SyncError("read batcher is closed")
@@ -416,9 +425,19 @@ class ReadBatcher:
             "sync.pull_wait_seconds",
             "pull submit -> batched window served (device path)",
         )
+        stage_h = obs.histogram(
+            "trace.pull_stage_seconds",
+            "per-stage pull latency attribution (read plane)",
+        )
         for tk, data, vv, ep in resolved:
             tk._resolve(data, vv, ep)
-            wait.observe(now - tk.t0, family=srv.family)
+            wait.observe(now - tk.t0, family=srv.family,
+                         exemplar=tk.trace_id)
+            for name, ms in (tk.stages or {}).items():
+                if name.endswith("_ms"):
+                    stage_h.observe(ms * 1e-3, family=srv.family,
+                                    stage=name[:-3],
+                                    exemplar=tk.trace_id)
 
     def _process_device(self, window: List[tuple]) -> List[tuple]:
         """One launch for the whole window; frames deduped by (doc,
@@ -427,6 +446,7 @@ class ReadBatcher:
         from ..oplog.oplog import trim_known_prefix
 
         srv = self._server
+        t_win = time.perf_counter()  # attribution: window drain time
         groups: Dict[tuple, list] = {}
         order: List[tuple] = []
         for di, vv, tk in window:
@@ -470,12 +490,18 @@ class ReadBatcher:
                     win_hits += len(g[2])
                     data, head, ep0 = hit
                     for tk in g[2]:
+                        tk.stages = {
+                            "window_wait_ms": (t_win - tk.t0) * 1e3,
+                            "cache_hit": True,
+                        }
                         out.append((tk, data, head.copy(), ep0))
             sel = self._launch(
                 [(g[0], g[1]) for g in misses]
             ) if misses else []
+            t_sel = time.perf_counter()
             for g, idx in zip(misses, sel):
                 di, from_vv, tks, key = g
+                t_f0 = time.perf_counter()
                 log = self.plane.index.changes[di]
                 picked = []
                 for i in idx:
@@ -489,7 +515,13 @@ class ReadBatcher:
                 self._frames += 1
                 win_shared += len(tks) - 1
                 self.plane.store_frame(di, key, data, head, epoch)
+                t_f1 = time.perf_counter()
                 for tk in tks:
+                    tk.stages = {
+                        "window_wait_ms": (t_win - tk.t0) * 1e3,
+                        "launch_ms": (t_sel - t_win) * 1e3,
+                        "frame_ms": (t_f1 - t_f0) * 1e3,
+                    }
                     # per-ticket VV copy: sessions mutate their
                     # frontier in place on later pushes
                     out.append((tk, data, head.copy(), epoch))
@@ -497,15 +529,22 @@ class ReadBatcher:
         # plane lock (the server lock must never nest under readplane)
         for g in stale:
             di, from_vv, tks = g[0], g[1], g[2]
+            t_o0 = time.perf_counter()
             with srv._lock:
                 data, new_vv, _first = srv._oracle_pull(di, from_vv, None)
                 ep1 = srv._committed_epoch
+            t_o1 = time.perf_counter()
             obs.counter(
                 "readbatch.floor_reroutes_total",
                 "window pulls re-routed to the oracle because "
                 "compaction pruned their index rows mid-flight",
             ).inc(len(tks), family=srv.family)
             for tk in tks:
+                tk.stages = {
+                    "window_wait_ms": (t_win - tk.t0) * 1e3,
+                    "oracle_ms": (t_o1 - t_o0) * 1e3,
+                    "rerouted": True,
+                }
                 out.append((tk, data, new_vv.copy(), ep1))
         # counter updates AFTER the plane lock (readbatch < readplane
         # in the declared order, so never nest the queue lock under it)
@@ -571,6 +610,7 @@ class ReadBatcher:
         self._supervisor().note_degradation(f"sync.read_batch.{srv.family}")
         for di, from_vv, tk in window:
             try:
+                t_o0 = time.perf_counter()
                 with srv._lock:
                     data, new_vv, _first = srv._oracle_pull(di, from_vv, None)
                     epoch = srv._committed_epoch
@@ -579,6 +619,10 @@ class ReadBatcher:
                     "readbatch.degraded_pulls_total",
                     "pulls served by the oracle inside degraded windows",
                 ).inc(family=srv.family)
+                tk.stages = {
+                    "oracle_ms": (time.perf_counter() - t_o0) * 1e3,
+                    "degraded": True,
+                }
                 tk._resolve(data, new_vv, epoch)
             except BaseException as e:  # noqa: BLE001 — per-ticket isolation on the fallback path
                 tk._fail(e)

@@ -45,8 +45,10 @@ from typing import Dict, List, Optional
 
 from ..errors import DecodeError, PushRejected, StaleFrontier, SyncError
 from ..analysis.lockwitness import named_rlock
+from ..obs import flight
 from ..obs import metrics as obs
 from ..resilience import faultinject
+from ..utils import tracing
 from .fanin import FanIn, PushTicket
 
 faultinject.register_site(
@@ -293,10 +295,12 @@ class SyncServer:
                 f"doc {di}: push is not a valid updates blob: "
                 f"{type(e).__name__}: {e}"
             ) from e
-        tk = PushTicket()
+        tk = PushTicket(trace_id=tracing.new_trace_id("p"))
         with self._lock:
             session._touch()
         obs.counter("sync.pushes_total").inc(family=self.family)
+        flight.record("sync.push", family=self.family, doc=di,
+                      trace=tk.trace_id, bytes=len(data))
         self._fanin.submit(di, payload, tk, session)
         return tk
 
@@ -373,21 +377,52 @@ class SyncServer:
             return
         self._rounds += len(rounds)
         if self._pipe is not None and not self._pipe.closed:
-            prs = [self._pipe.submit(list(r)) for r in rounds]
+            # each round rides the trace of its FIRST push (the round
+            # leader) into the pipeline and the WAL stamp
+            prs = [
+                self._pipe.submit(list(r), trace=next(
+                    (tk.trace_id for tk, _c, _s in m.values()), None
+                ))
+                for r, m in zip(rounds, metas)
+            ]
             epochs = [pr.epoch() for pr in prs]
+            # attribution: fold the round's stage-boundary marks into
+            # every push ticket the round carried
+            for pr, m in zip(prs, metas):
+                marks = list(pr.marks)
+                for tk, _chs, _sess in m.values():
+                    tk.marks.extend(marks)
         else:
-            epochs = self.resident.ingest_coalesced(
-                [list(r) for r in rounds], self.cid
-            )
+            with tracing.ambient(next(
+                (tk.trace_id for m in metas
+                 for tk, _c, _s in m.values() if tk.trace_id), None
+            )):
+                epochs = self.resident.ingest_coalesced(
+                    [list(r) for r in rounds], self.cid
+                )
+            t_commit = time.perf_counter()
+            for m in metas:
+                for tk, _chs, _sess in m.values():
+                    tk.mark("commit", t_commit)
         # durable watermark: a resolved ticket is an ACK — it must
         # never outrun the fsync covering its round (group mode defers
         # them; pipeline groups flush at commit, serial singles do not)
         srv = self.resident
-        if srv._durable is not None and srv.durable_epoch < epochs[-1]:
-            srv.flush_durable()
+        if srv._durable is not None:
+            if srv.durable_epoch < epochs[-1]:
+                srv.flush_durable()
+            t_fsync = time.perf_counter()
+            for m in metas:
+                for tk, _chs, _sess in m.values():
+                    tk.mark("fsync", t_fsync)
         p2v = obs.histogram(
             "sync.push_to_visible_seconds",
             "push submit -> committed + oracle-visible + ticket resolved",
+        )
+        stage_h = obs.histogram(
+            "trace.push_stage_seconds",
+            "per-stage push latency attribution (stages telescope to "
+            "sync.push_to_visible_seconds)",
         )
         dirty: Dict[int, int] = {}
         resolved: List[tuple] = []
@@ -447,8 +482,22 @@ class SyncServer:
         now = time.perf_counter()
         for tk, ep in resolved:
             if not tk.done:
+                # the fanout mark and the p2v observation share `now`,
+                # so breakdown() stages sum EXACTLY to the histogram's
+                # end-to-end sample (the chaos attribution invariant)
+                tk.mark("fanout", now)
                 tk._resolve(ep)
-                p2v.observe(now - tk.t0, family=self.family)
+                p2v.observe(now - tk.t0, family=self.family,
+                            exemplar=tk.trace_id)
+                prev = tk.t0
+                for name, t in tk.marks:
+                    stage_h.observe(t - prev, family=self.family,
+                                    stage=name, exemplar=tk.trace_id)
+                    prev = t
+        if epochs:
+            flight.record("sync.commit", family=self.family,
+                          epoch=epochs[-1], rounds=len(rounds),
+                          pushes=len(resolved))
         self._fan_out_deltas(dirty)
         self.expire_sessions()
 

@@ -34,8 +34,10 @@ from typing import Dict, Optional
 
 from ..core.version import VersionVector
 from ..errors import SessionClosed
+from ..obs import flight
 from ..obs import metrics as obs
 from ..resilience import faultinject
+from ..utils import tracing
 
 faultinject.register_site(
     "sync_pull", "Session.pull: raise/delay before the delta export "
@@ -66,6 +68,10 @@ class Session:
         self._dirty: Dict[int, int] = {}  # di -> newest committed epoch
         self._presence: deque = deque()   # encoded presence blobs
         self._dropped_presence = 0
+        # attribution of the session's most recent pull (trace id,
+        # serving path, per-stage ms — docs/OBSERVABILITY.md "Request
+        # tracing"); None until the first pull
+        self.last_pull: Optional[dict] = None
 
     # -- internal (called by the server under its lock) ----------------
     def _touch(self) -> None:
@@ -124,6 +130,8 @@ class Session:
         self._check_open()
         faultinject.check("sync_pull", doc=di)
         srv = self._server
+        trace_id = tracing.new_trace_id("g")
+        t_pull0 = time.perf_counter()
         if min_epoch is not None:
             self._wait_min_epoch(di, int(min_epoch), wait_s)
         tk = hit = None
@@ -142,13 +150,32 @@ class Session:
                         # enqueue under the lock (frontier snapshot is
                         # atomic with the routing decision); the window
                         # drive runs OUTSIDE it
-                        tk = srv._readbatch.submit(di, from_vv.copy())
+                        tk = srv._readbatch.submit(
+                            di, from_vv.copy(), trace=trace_id
+                        )
                     except SyncError:
                         tk = None  # closed under us: oracle path below
         if tk is not None or hit is not None:
             data, new_vv, epoch = (
                 hit if hit is not None else srv._readbatch.drive(tk)
             )
+            stages = dict(tk.stages) if tk is not None and tk.stages \
+                else {"cache_hit": True}
+            if hit is not None or stages.get("cache_hit"):
+                path = "cache"
+            elif stages.get("degraded"):
+                path = "oracle_degraded"
+            elif stages.get("rerouted"):
+                path = "oracle_reroute"
+            else:
+                path = "device"
+            stages.update(
+                trace_id=trace_id, path=path,
+                total_ms=(time.perf_counter() - t_pull0) * 1e3,
+            )
+            self.last_pull = stages
+            flight.record("sync.pull", family=srv.family, doc=di,
+                          trace=trace_id, path=path, bytes=len(data))
             with srv._lock:
                 self._touch()
                 cur = self._vv.get(di)
@@ -172,6 +199,7 @@ class Session:
                 buckets=(64, 256, 1024, 4096, 16384, 65536, 262144, 1048576),
             ).observe(len(data), family=srv.family)
             return data
+        t_o0 = time.perf_counter()
         with srv._lock:
             self._touch()
             from_vv = self._vv.get(di) or VersionVector()
@@ -188,6 +216,16 @@ class Session:
                 # needs (ResidentServer.ack's contract), so it never
                 # acks and the dirty flag survives for the catch-up
                 srv._ack(self, di)
+        now = time.perf_counter()
+        self.last_pull = {
+            "trace_id": trace_id,
+            "path": "snapshot" if first_sync else "oracle",
+            "oracle_ms": (now - t_o0) * 1e3,
+            "total_ms": (now - t_pull0) * 1e3,
+        }
+        flight.record("sync.pull", family=srv.family, doc=di,
+                      trace=trace_id, path=self.last_pull["path"],
+                      bytes=len(data))
         obs.counter("sync.pulls_total").inc(
             family=srv.family, kind="snapshot" if first_sync else "delta"
         )

@@ -101,6 +101,18 @@ def _fat_checkpoint():
         sync_pushes_per_sec=90.4,
         sync_push_to_visible_ms_p50=47.7,
         sync_push_to_visible_ms_p99=952.7,
+        trace={"stages": {
+                   "queue_wait": {"count": 104, "mean_ms": 0.4,
+                                  "exemplar": "p1a2b-3f"},
+                   "coalesce_wait": {"count": 104, "mean_ms": 1.1},
+                   "stage": {"count": 104, "mean_ms": 12.9},
+                   "commit": {"count": 104, "mean_ms": 30.1},
+                   "fsync": {"count": 104, "mean_ms": 2.2},
+                   "fanout": {"count": 104, "mean_ms": 1.0,
+                              "exemplar": "p1a2b-68"}},
+               "stage_sum_mean_ms": 47.7, "p2v_mean_ms": 47.7,
+               "flight_recorded": 4096, "flight_capacity": 1024,
+               "note": "x" * 300},
         sync={"pushes": 104, "batches": 14, "max_batch": 13,
               "queue_bound": 128, "max_queue_seen": 13,
               "backpressure_waits": 0, "sessions": 16, "rounds": 26,
@@ -201,8 +213,9 @@ class TestFlagshipLine:
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
         for k in ("metrics", "resilience", "pipeline", "rank", "sync",
-                  "shard", "tier", "readplane", "repl", "baseline_note",
-                  "roofline_note", "resident_pipeline_note"):
+                  "shard", "tier", "readplane", "repl", "trace",
+                  "baseline_note", "roofline_note",
+                  "resident_pipeline_note"):
             assert k in side, k
             assert k not in back, k
         assert side["sidecars_for"] == back["metric"]

@@ -2,6 +2,8 @@
 bridge, and counters observed ticking through the real fleet/server
 paths — all on the CPU mesh, no device access."""
 import json
+import os
+import sys
 import threading
 
 import pytest
@@ -318,3 +320,357 @@ def test_host_fallback_counter_ticks(monkeypatch):
     n0 = obs.counter("fleet.host_fallback_total").get(kind="idmap")
     assert isinstance(make_idmap(), PyIdMap)
     assert obs.counter("fleet.host_fallback_total").get(kind="idmap") == n0 + 1
+
+
+# ---------------------------------------------------------------------------
+# tracing satellites (ISSUE 14): observer COW race, instant observers,
+# dump collision guard
+# ---------------------------------------------------------------------------
+
+
+def test_observer_cow_survives_mid_span_unregister():
+    """The ISSUE 14 race: removing an observer while span() iterates
+    must neither skip other observers nor raise.  COW means the span
+    that started with N observers fires all N; registrations landing
+    mid-span apply to the NEXT span."""
+    fired = []
+
+    def self_removing(name, dur):
+        fired.append("a")
+        tracing.remove_span_observer(self_removing)
+
+    def stable(name, dur):
+        fired.append("b")
+
+    tracing.add_span_observer(self_removing)
+    tracing.add_span_observer(stable)
+    try:
+        with tracing.span("obs.cow.probe"):
+            pass
+        assert fired == ["a", "b"]  # removal mid-iteration skipped nothing
+        fired.clear()
+        with tracing.span("obs.cow.probe2"):
+            pass
+        assert fired == ["b"]  # the removal took effect for later spans
+    finally:
+        tracing.remove_span_observer(stable)
+        tracing.remove_span_observer(self_removing)
+
+
+def test_observer_registration_concurrent_with_spans():
+    """Hammer add/remove against concurrent span() iterations — the
+    pre-fix list mutation raced the unlocked iteration."""
+    stop = []
+
+    def obs_fn(name, dur):
+        pass
+
+    def churn():
+        for _ in range(300):
+            tracing.add_span_observer(obs_fn)
+            tracing.remove_span_observer(obs_fn)
+
+    def spans():
+        while not stop:
+            with tracing.span("obs.race.probe"):
+                pass
+
+    ts = [threading.Thread(target=churn) for _ in range(4)]
+    sp = threading.Thread(target=spans)
+    sp.start()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.append(True)
+    sp.join()
+    tracing.remove_span_observer(obs_fn)
+
+
+def test_instant_fires_observers():
+    seen = []
+    tracing.add_span_observer(lambda n, d: seen.append((n, d)))
+    fn = tracing._span_observers[-1]
+    try:
+        tracing.instant("obs.instant.probe", k=1)
+        assert ("obs.instant.probe", 0.0) in seen
+    finally:
+        tracing.remove_span_observer(fn)
+
+
+def test_dump_paths_never_collide(tmp_path, monkeypatch):
+    """Two dumps in the same wall second used to overwrite each other
+    — the default filename now carries pid + a monotonic counter."""
+    monkeypatch.chdir(tmp_path)
+    tracing.enable()
+    try:
+        with tracing.span("dump.probe"):
+            pass
+        p1 = tracing.dump()
+        p2 = tracing.dump()
+        assert p1 != p2
+        assert os.path.exists(p1) and os.path.exists(p2)
+        assert str(os.getpid()) in os.path.basename(p1)
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exemplars_per_bucket(reg):
+    h = reg.histogram("x.ex_seconds", buckets=[0.1, 1.0])
+    h.observe(0.05, exemplar="fast-1", family="text")
+    h.observe(0.5, exemplar="mid-1", family="text")
+    h.observe(0.5, exemplar="mid-2", family="text")  # last-writer-wins
+    h.observe(5.0, family="text")  # no exemplar: slot stays empty
+    ex = h.exemplars(family="text")
+    assert ex == {"le_0.1": "fast-1", "le_1.0": "mid-2"}
+    # snapshot carries them (the dashboard read path)
+    row = h.snapshot()["values"][0]
+    assert row["exemplars"]["1.0"] == "mid-2"
+    # label sets that never carried one stay exemplar-free
+    h.observe(0.5, family="map")
+    assert h.exemplars(family="map") == {}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (ISSUE 14): bounded ring + the count-based perf guards
+# ---------------------------------------------------------------------------
+
+
+def _fresh_flight(cap=16):
+    from loro_tpu.obs.flight import FlightRecorder
+
+    return FlightRecorder(capacity=cap)
+
+
+def test_flight_ring_bounded_and_ordered():
+    fr = _fresh_flight(cap=8)
+    for i in range(20):
+        fr.record("probe", n=i)
+    evs = fr.events()
+    assert len(evs) == 8  # bounded by capacity, oldest overwritten
+    assert [e["n"] for e in evs] == list(range(12, 20))
+    assert [e["i"] for e in evs] == list(range(12, 20))
+    assert fr.recorded_total == 20
+    assert fr.tail(3) == evs[-3:]
+
+
+def test_flight_disabled_path_zero_net_allocations():
+    """The count-based perf guard: with the recorder disabled, a
+    record() call allocates nothing that survives the call — the ring
+    must be leavable ON in production with a literal no-op off switch."""
+    import gc
+
+    fr = _fresh_flight(cap=64)
+    fr.disable()
+    fr.record("warm", a=1)  # warm any lazy state
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        fr.record("probe", a=1, b="x")
+    gc.collect()
+    grew = sys.getallocatedblocks() - before
+    assert grew <= 16, f"disabled flight path leaked {grew} blocks"
+    assert fr.events() == [] and fr.recorded_total == 0
+
+
+def test_flight_enabled_path_bounded_by_capacity():
+    """Enabled-path guard: memory is bounded by the ring — 50x the
+    capacity in events retains exactly `capacity` and the block count
+    plateaus instead of growing with the event count."""
+    import gc
+
+    fr = _fresh_flight(cap=32)
+    for i in range(64):  # fill + wrap once: steady state
+        fr.record("probe", n=i)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for i in range(32 * 50):
+        fr.record("probe", n=i)
+    gc.collect()
+    grew = sys.getallocatedblocks() - before
+    assert grew <= 64, f"flight ring grew {grew} blocks past capacity"
+    assert len(fr.events()) == 32
+
+
+def test_flight_reentrant_record_is_dropped():
+    fr = _fresh_flight(cap=8)
+    fr._guard.held = True
+    try:
+        fr.record("nested")
+    finally:
+        fr._guard.held = False
+    assert fr.recorded_total == 0
+
+
+def test_flight_snapshot_and_dump(tmp_path):
+    fr = _fresh_flight(cap=8)
+    fr.record("alpha", x=1)
+    snap = fr.snapshot()
+    assert snap["flight"] == 1 and snap["capacity"] == 8
+    assert snap["events"][0]["kind"] == "alpha"
+    path = fr.dump(str(tmp_path / "f.json"))
+    assert json.load(open(path))["events"][0]["x"] == 1
+
+
+def test_flight_cap_knob_typed_at_first_use(monkeypatch):
+    """LORO_FLIGHT_CAP=abc must raise typed ConfigError at the first
+    recorder() use (the knob convention) — and importing the package
+    must never crash on it (the default recorder builds lazily)."""
+    from loro_tpu import obs as obs_pkg  # import survives a bad knob
+    from loro_tpu.errors import ConfigError
+    from loro_tpu.obs import flight
+
+    assert obs_pkg.flight is flight
+    monkeypatch.setenv("LORO_FLIGHT_CAP", "abc")
+    monkeypatch.setattr(flight, "_default", None)
+    with pytest.raises(ConfigError, match="LORO_FLIGHT_CAP"):
+        flight.recorder()
+    monkeypatch.setenv("LORO_FLIGHT_CAP", "64")
+    assert flight.recorder().capacity == 64
+    monkeypatch.setattr(flight, "_default", None)  # next test rebuilds
+
+
+def test_flight_dump_on_gated_by_auto_dir(tmp_path):
+    from loro_tpu.obs import flight
+
+    flight.set_auto_dump(None)
+    try:
+        assert flight.dump_on("test_disarmed") is None
+        flight.set_auto_dump(str(tmp_path / "bb"))
+        p = flight.dump_on("test_armed")
+        assert p is not None and os.path.exists(p)
+        art = json.load(open(p))
+        assert any(e.get("kind") == "flight.trigger" and
+                   e.get("reason") == "test_armed"
+                   for e in art["events"])
+    finally:
+        flight.set_auto_dump(None)
+
+
+def test_degradation_records_flight_event():
+    from loro_tpu.obs import flight
+    from loro_tpu.resilience.supervisor import DeviceSupervisor
+
+    sup = DeviceSupervisor()
+    n0 = len([e for e in flight.events() if e["kind"] == "sup.degrade"])
+    sup.note_degradation("test.site")
+    evs = [e for e in flight.events() if e["kind"] == "sup.degrade"]
+    assert len(evs) == n0 + 1
+    assert evs[-1]["where"] == "test.site"
+
+
+# ---------------------------------------------------------------------------
+# CLI coverage (ISSUE 14 satellite): obs.report and obs.trace
+# ---------------------------------------------------------------------------
+
+
+class TestReportCli:
+    def test_live_registry_mode(self, capsys):
+        from loro_tpu.obs import report
+
+        obs.counter("fleet.ops_merged_total").inc(5, family="text")
+        rc = report.main([])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "loro_tpu.obs" in out and "fleet.ops_merged_total" in out
+
+    def test_snapshot_file_mode(self, tmp_path, capsys):
+        from loro_tpu.obs import report
+        from loro_tpu.obs.exposition import snapshot_json
+
+        reg = m.Registry()
+        reg.counter("fleet.ops_merged_total", "rows").inc(7, family="map")
+        reg.histogram("server.epoch_seconds", buckets=[1.0]).observe(0.2)
+        p = tmp_path / "snap.json"
+        p.write_text(snapshot_json(reg))
+        rc = report.main([str(p)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet.ops_merged_total" in out
+        assert "server.epoch_seconds" in out
+        # JSON mode round-trip: the written snapshot is schema-stable
+        snap = json.loads(p.read_text())
+        e = snap["fleet.ops_merged_total"]
+        assert e["type"] == "counter"
+        assert e["values"][0]["labels"] == {"family": "map"}
+        assert e["values"][0]["value"] == 7
+
+
+class TestTraceCli:
+    def _flight_file(self, tmp_path, name="f.json"):
+        from loro_tpu.obs.flight import FlightRecorder
+
+        fr = FlightRecorder(capacity=16)
+        fr.record("server.epoch", family="text", epoch=3, trace="t-x")
+        fr.record("repl.apply", epoch=3, trace="t-x", lag_ms=4.2)
+        return fr.dump(str(tmp_path / name))
+
+    def test_inspect_flight(self, tmp_path, capsys):
+        from loro_tpu.obs import trace as tcli
+
+        p = self._flight_file(tmp_path)
+        rc = tcli.main(["inspect", p])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "flight" in out and "repl.apply" in out
+
+    def test_inspect_chrome(self, tmp_path, capsys):
+        from loro_tpu.obs import trace as tcli
+
+        tracing.enable()
+        try:
+            with tracing.span("cli.probe"):
+                pass
+            p = tracing.dump(str(tmp_path / "t.json"))
+        finally:
+            tracing.disable()
+            tracing.clear()
+        rc = tcli.main(["inspect", p])
+        out = capsys.readouterr().out
+        assert rc == 0 and "cli.probe" in out
+
+    def test_merge_lag_attribution(self, tmp_path, capsys):
+        from loro_tpu.obs import trace as tcli
+
+        p = self._flight_file(tmp_path)
+        out_path = str(tmp_path / "merged.json")
+        rc = tcli.main(["merge", p, p, "-o", out_path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "replication-lag attribution" in out
+        assert "epoch 3" in out
+        merged = json.load(open(out_path))
+        assert {e["pid"] for e in merged["traceEvents"]} == {1, 2}
+
+    def test_malformed_artifact_rc2(self, tmp_path, capsys):
+        from loro_tpu.obs import trace as tcli
+
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"neither": 1}')
+        rc = tcli.main(["inspect", str(bad)])
+        err = capsys.readouterr().err
+        assert rc == 2 and "obs.trace:" in err
+        rc = tcli.main(["inspect", str(tmp_path / "missing.json")])
+        assert rc == 2
+
+    def test_help_and_unknown(self, capsys):
+        from loro_tpu.obs import trace as tcli
+
+        assert tcli.main([]) == 0
+        assert "Subcommands" in capsys.readouterr().out
+        assert tcli.main(["wat"]) == 2
+
+    def test_dump_subcommand(self, tmp_path, capsys):
+        from loro_tpu.obs import trace as tcli
+
+        p = str(tmp_path / "proc.json")
+        rc = tcli.main(["dump", p])
+        out = capsys.readouterr().out
+        assert rc == 0 and p in out
+        assert json.load(open(p))["flight"] == 1

@@ -1,0 +1,292 @@
+"""Request-scoped tracing (ISSUE 14): end-to-end attribution gates.
+
+The tentpole contract: a trace id minted at push()/pull() entry rides
+the FanIn ticket, the pipeline round, the WAL round stamp and (via
+shipped bytes) the follower apply — and the per-stage breakdown on a
+resolved PushTicket telescopes EXACTLY to the measured push-to-visible
+latency.  Plus the exposition legs: per-bucket histogram exemplars,
+the flight tail in chaos artifacts, and the ``obs.trace`` merge that
+turns leader+follower flight snapshots into measured replication-lag
+attribution.
+"""
+import json
+import time
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.obs import flight
+from loro_tpu.obs import metrics as m
+from loro_tpu.persist.wal import WriteAheadLog
+from loro_tpu.sync import SyncServer
+from loro_tpu.utils import tracing
+
+
+def _seed_text(peer: int, txt: str) -> LoroDoc:
+    d = LoroDoc(peer=peer)
+    d.get_text("t").insert(0, txt)
+    d.commit()
+    return d
+
+
+def _stage_sum(bd: dict) -> float:
+    return sum(v for k, v in bd.items()
+               if k.endswith("_ms") and k != "total_ms")
+
+
+class TestPushBreakdown:
+    def test_pipelined_durable_breakdown_telescopes(self, tmp_path):
+        """The acceptance gate: a pipelined durable push's stage
+        breakdown sums to the end-to-end total, covers the full stage
+        ladder, and the total agrees with an independent wall-clock
+        measurement."""
+        d = _seed_text(11, "attribution")
+        srv = SyncServer(
+            "text", 2, cid=d.get_text("t").id, capacity=1 << 12,
+            durable_dir=str(tmp_path / "dur"), durable_fsync="group",
+            fsync_window=4,
+        )
+        try:
+            s = srv.connect()
+            t0 = time.perf_counter()
+            tk = s.push(0, d.export_updates({}))
+            tk.epoch(60)
+            elapsed_ms = (time.perf_counter() - t0) * 1e3
+            bd = tk.breakdown()
+            assert bd["trace_id"], "push must mint a trace id"
+            # stages telescope: the sum IS the total, exactly
+            assert _stage_sum(bd) == pytest.approx(bd["total_ms"], abs=1e-6)
+            # the full pipelined+durable ladder is attributed
+            for stage in ("queue_wait", "coalesce_wait", "stage",
+                          "commit", "fsync", "fanout"):
+                assert f"{stage}_ms" in bd, stage
+            # the total is the p2v measurement (ticket create ->
+            # resolve), which an outside wall clock must bound
+            assert 0.0 < bd["total_ms"] <= elapsed_ms + 5.0
+        finally:
+            srv.close()
+
+    def test_serial_path_breakdown_telescopes(self):
+        """pipeline=False: no stage/coalesce split, but the breakdown
+        still telescopes (queue_wait -> commit -> fanout)."""
+        d = _seed_text(12, "serial")
+        srv = SyncServer("text", 1, cid=d.get_text("t").id,
+                         capacity=1 << 12, pipeline=False)
+        try:
+            s = srv.connect()
+            tk = s.push(0, d.export_updates({}))
+            tk.epoch(60)
+            bd = tk.breakdown()
+            assert _stage_sum(bd) == pytest.approx(bd["total_ms"], abs=1e-6)
+            assert "commit_ms" in bd and "coalesce_wait_ms" not in bd
+        finally:
+            srv.close()
+
+    def test_p2v_histogram_carries_exemplar_trace_ids(self):
+        d = _seed_text(13, "exemplar")
+        srv = SyncServer("text", 1, cid=d.get_text("t").id,
+                         capacity=1 << 12)
+        try:
+            s = srv.connect()
+            tk = s.push(0, d.export_updates({}))
+            tk.epoch(60)
+            ex = m.histogram("sync.push_to_visible_seconds").exemplars(
+                family="text"
+            )
+            assert tk.trace_id in ex.values()
+            # the stage histogram carries them per stage too
+            rows = m.histogram("trace.push_stage_seconds").snapshot()["values"]
+            stages = {r["labels"].get("stage") for r in rows
+                      if r["labels"].get("family") == "text"
+                      and r.get("exemplars")}
+            assert "queue_wait" in stages
+        finally:
+            srv.close()
+
+
+class TestPullAttribution:
+    def test_last_pull_paths_and_stages(self):
+        d = _seed_text(21, "pull attribution")
+        srv = SyncServer("text", 1, cid=d.get_text("t").id,
+                         capacity=1 << 12)
+        try:
+            s = srv.connect()
+            s.push(0, d.export_updates({})).epoch(60)
+            s2 = srv.connect()
+            s2.pull(0)
+            lp = s2.last_pull
+            assert lp["trace_id"].startswith("g")
+            assert lp["path"] in ("device", "cache")
+            assert lp["total_ms"] > 0.0
+            if lp["path"] == "device":
+                assert "launch_ms" in lp and "window_wait_ms" in lp
+                assert _stage_sum(lp) <= lp["total_ms"] + 0.5
+            # a repeat pull at the same frontier rides the frame cache
+            s3 = srv.connect()
+            s3.pull(0)
+            assert s3.last_pull["path"] in ("cache", "device")
+        finally:
+            srv.close()
+
+    def test_oracle_pull_attributed(self):
+        d = _seed_text(22, "oracle path")
+        srv = SyncServer("text", 1, cid=d.get_text("t").id,
+                         capacity=1 << 12, read_batch=False)
+        try:
+            s = srv.connect()
+            s.push(0, d.export_updates({})).epoch(60)
+            s.pull(0)
+            lp = s.last_pull
+            assert lp["path"] == "oracle"
+            assert "oracle_ms" in lp and lp["oracle_ms"] <= lp["total_ms"]
+        finally:
+            srv.close()
+
+
+class TestWalStamps:
+    def test_rounds_carry_trace_and_wall_stamp(self, tmp_path):
+        d = _seed_text(31, "wal stamps")
+        srv = SyncServer(
+            "text", 1, cid=d.get_text("t").id, capacity=1 << 12,
+            durable_dir=str(tmp_path / "dur"),
+        )
+        try:
+            s = srv.connect()
+            tk = s.push(0, d.export_updates({}))
+            tk.epoch(60)
+            trace = tk.trace_id
+        finally:
+            srv.close()
+        wal = WriteAheadLog(str(tmp_path / "dur" / "wal"), fsync=False)
+        try:
+            rounds = [r for r in wal.records() if r.rtype == 1]
+            assert rounds, "the push's round must be journaled"
+            assert rounds[-1].trace == trace
+            # the wall stamp is wall-clock-recent (microseconds)
+            assert abs(rounds[-1].stamp_us * 1e-6 - time.time()) < 300
+        finally:
+            wal.close()
+
+    def test_unstamped_rounds_still_decode(self, tmp_path):
+        """Back-compat: rounds appended without stamps read back with
+        trace None / stamp 0 (the pre-ISSUE-14 wire layout)."""
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync=False)
+        wal.append_round(1, None, [b"x", None])
+        wal.append_round(2, None, [None, b"y"], trace="t-abc",
+                         stamp_us=123456)
+        wal.close()
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync=False)
+        try:
+            r1, r2 = [r for r in wal.records() if r.rtype == 1]
+            assert r1.trace is None and r1.stamp_us == 0
+            assert r2.trace == "t-abc" and r2.stamp_us == 123456
+            assert r2.updates == [None, b"y"]
+        finally:
+            wal.close()
+
+
+class TestFollowerLagAttribution:
+    def test_apply_lag_measured_and_mergeable(self, tmp_path):
+        """The cross-process leg: shipped WAL stamps become measured
+        apply-lag samples on the follower, and ``obs.trace.merge_lag``
+        joins leader commits to follower applies on the epoch stamps."""
+        from loro_tpu import replication
+        from loro_tpu.obs import trace as trace_cli
+        from loro_tpu.parallel.server import ResidentServer
+
+        d = _seed_text(41, "replication lag")
+        cid = d.get_text("t").id
+        leader = ResidentServer("text", 1, capacity=1 << 12,
+                                durable_dir=str(tmp_path / "lead"))
+        replication.enable(leader, "L")
+        srv = SyncServer.over(leader, cid=cid)
+        fol = None
+        try:
+            s = srv.connect()
+            s.push(0, d.export_updates({})).epoch(60)
+            srv.flush()
+            leader.flush_durable()
+            fol = replication.Follower(
+                str(tmp_path / "lead"), str(tmp_path / "fol"),
+                leader=leader,
+            )
+            # a post-attach push: bootstrap consumed the first round
+            # through recover_server, the apply LOOP measures this one
+            mark = d.oplog_vv()
+            d.get_text("t").insert(0, "lagged ")
+            d.commit()
+            tk = s.push(0, d.export_updates(mark))
+            tk.epoch(60)
+            srv.flush()
+            leader.flush_durable()
+            fol.catch_up()
+            samples = fol.lag_samples()
+            assert samples, "stamped rounds must yield lag samples"
+            ep, trace, lag_ms = samples[-1]
+            assert trace == tk.trace_id
+            assert 0.0 <= lag_ms < 600_000.0
+            rep = fol.report()
+            assert "apply_lag_ms_p50" in rep
+            # the merge leg: one process hosts both roles here, so one
+            # flight snapshot carries both streams — merge still keys
+            # strictly on the epoch stamps, as it would across files
+            snap = flight.snapshot()
+            snap_l = dict(snap, _kind="flight")
+            snap_f = dict(snap, _kind="flight")
+            merged = trace_cli.merge_lag(snap_l, snap_f)
+            assert merged["count"] >= 1
+            assert any(row["epoch"] == ep for row in merged["epochs"])
+            assert merged["lag_ms_p50"] is not None
+        finally:
+            if fol is not None:
+                fol.close()
+            srv.close()
+
+
+class TestChaosIntegration:
+    def test_artifact_embeds_flight_tail(self):
+        from loro_tpu.chaos.plan import ChaosConfig
+        from loro_tpu.chaos.runner import ChaosReport
+
+        flight.record("chaos.test_marker", n=1)
+        art = ChaosReport(config=ChaosConfig(seed=1)).to_artifact()
+        assert isinstance(art["flight"], list)
+        assert any(e.get("kind") == "chaos.test_marker"
+                   for e in art["flight"])
+
+    def test_attribution_invariant_flags_lying_breakdown(self):
+        """A breakdown whose stages do not telescope is a violation;
+        telescoping ones pass."""
+        from loro_tpu.chaos.invariants import InvariantChecker
+
+        class _Stack:
+            breakdowns = [
+                {"trace_id": "ok", "family": "text", "queue_wait_ms": 1.0,
+                 "commit_ms": 2.0, "total_ms": 3.0},
+            ]
+
+        chk = InvariantChecker.__new__(InvariantChecker)
+        chk.stack = _Stack()
+        assert chk._attribution(0) == []
+        _Stack.breakdowns = [
+            {"trace_id": "liar", "family": "text", "queue_wait_ms": 1.0,
+             "commit_ms": 2.0, "total_ms": 9.0},
+        ]
+        chk.stack = _Stack()
+        out = chk._attribution(1)
+        assert len(out) == 1 and out[0].invariant == "attribution"
+
+
+class TestAmbientTrace:
+    def test_ambient_scoping(self):
+        assert tracing.current() is None
+        with tracing.ambient("outer"):
+            assert tracing.current() == "outer"
+            with tracing.ambient("inner"):
+                assert tracing.current() == "inner"
+            assert tracing.current() == "outer"
+        assert tracing.current() is None
+
+    def test_trace_ids_unique(self):
+        ids = {tracing.new_trace_id() for _ in range(1000)}
+        assert len(ids) == 1000

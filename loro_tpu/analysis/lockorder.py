@@ -22,6 +22,14 @@ The order, with the paths that establish each edge:
   (→ ``fleet.dev``/``supervisor.state`` through the resident) and the
   read-only sync feed (→ ``sync.server`` → ``sync.readplane``).
   Nothing acquires it while holding anything below.
+- ``net.accept``       — NetServer connection registry + pending-poll
+  slots (loro_tpu/net/server.py): taken from the asyncio loop thread
+  (accept/teardown), the notifier thread (claim a pending poll, then
+  RELEASE before ``session.poll`` → ``sync.server``) and the acker
+  thread (report snapshots).  Nothing is held while acquiring it, and
+  every session call under it is made AFTER release — the declared
+  edge net.accept→sync.server exists only for the teardown path that
+  snapshots the registry before disconnecting sessions.
 - ``sync.server``      — SyncServer session/oracle lock; a root for
   everything below: _commit_batch submits to the pipeline BEFORE
   taking it and epoch subscribers are lock-free by contract.  The
@@ -69,6 +77,7 @@ from typing import Dict, Iterable, List, Sequence, Set, Tuple
 
 LEVELS: Dict[str, int] = {
     "repl.follower": 5,
+    "net.accept": 8,
     "sync.server": 10,
     "sync.readbatch": 14,
     "sync.readplane": 16,

@@ -20,6 +20,16 @@ executed by the single-threaded ``chaos.runner``) is a pure function of
                   one family
 - ``demote``    — push a warm doc to the cold tier (tiered servers)
 - ``migrate``   — live-migrate one doc to the next shard
+- ``net``       — socket-edge nemesis: front one family's SyncServer
+                  with a ``net.NetServer``, pull over a REAL TCP
+                  socket byte-identity-gated against the oracle's own
+                  export, inject a seeded connection fault (writer
+                  stall / frame bitflip / accept refusal), kill the
+                  connection abruptly and reconnect-with-frontier —
+                  the resumed pull is gated the same way.  Read-only
+                  by construction: pushes stay on the in-process
+                  sessions, so the reference oracle's acked-payload
+                  bookkeeping is untouched
 - ``reopen``    — graceful close + ``recover_sharded_server`` +
                   re-front + follower resume + client reset (the
                   in-process recovery nemesis)
@@ -186,7 +196,7 @@ def generate_plan(cfg: ChaosConfig) -> List[Step]:
     table: List[Tuple[str, float]] = [
         ("edit", 8.0), ("pull", 3.0), ("fault", 3.0), ("join", 0.7),
         ("leave", 0.7), ("stall", 1.0), ("checkpoint", 1.0),
-        ("compact", 0.7),
+        ("compact", 0.7), ("net", 0.6),
     ]
     if cfg.hot_slots is not None:
         table.append(("demote", 1.5))
@@ -235,6 +245,9 @@ def generate_plan(cfg: ChaosConfig) -> List[Step]:
                 emit("checkpoint", family=rng.choice(cfg.families))
             elif kind == "compact":
                 emit("compact", family=rng.choice(cfg.families))
+            elif kind == "net":
+                emit("net", family=rng.choice(cfg.families),
+                     seed=rng.randrange(1 << 30))
             elif kind == "demote":
                 emit("demote", family=rng.choice(cfg.families),
                      pick=rng.randrange(1 << 30))

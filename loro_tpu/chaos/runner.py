@@ -303,6 +303,11 @@ class ChaosRunner:
         elif kind == "compact":
             stack.compact(pr["family"])
             self._log(step)
+        elif kind == "net":
+            for detail in stack.net_nemesis(pr["family"], int(pr["seed"])):
+                report.violations.append(
+                    Violation("net_identity", pr["family"], detail, step.i))
+            self._log(step)
         elif kind == "demote":
             ok = stack.demote(pr["family"], int(pr["pick"]))
             self._log(step, demoted=ok)

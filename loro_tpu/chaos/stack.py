@@ -458,6 +458,104 @@ class ChaosStack:
         return bad
 
     # -- nemesis helpers ------------------------------------------------
+    def net_nemesis(self, family: str, seed: int) -> List[str]:
+        """Socket-edge nemesis (docs/NET.md): front ``family``'s LIVE
+        SyncServer with a ``net.NetServer`` on an ephemeral port, pull
+        one doc over a real TCP socket with the byte-identity gate
+        (served bytes == the oracle's own export from the client's
+        frontier), inject one seeded connection fault, kill the
+        connection abruptly (the in-process SIGKILL stand-in) and
+        reconnect-with-frontier — the resumed pull is gated the same
+        way.  Pull-only by construction: pushes stay on the in-process
+        sessions, so the reference oracle's acked-payload bookkeeping
+        is untouched.  Returns violation detail strings."""
+        import random as _random
+
+        from ..doc import ExportMode
+        from ..errors import DecodeError, NetError
+        from ..net import NetClient, NetServer
+
+        rng = _random.Random(seed)
+        bad: List[str] = []
+        p = self.planes[family]
+        p.sync.flush()
+        di = rng.randrange(self.cfg.docs)
+        srv = cli = None
+        try:
+            srv = NetServer(p.sync)
+            cli = NetClient("127.0.0.1", srv.port, family,
+                            client_id=f"chaos-net-{seed}")
+            cli.connect()
+
+            def gate(tag: str) -> None:
+                from ..core.version import VersionVector
+
+                od = p.sync.oracle_doc(di)
+                fvv = cli.frontiers.get(di) or VersionVector()
+                if od.is_shallow() and not (od.shallow_since_vv() <= fvv) \
+                        and len(fvv) == 0:
+                    want = bytes(od.export(ExportMode.Snapshot))
+                else:
+                    want = bytes(od.export(ExportMode.Updates(fvv)))
+                got = bytes(cli.pull(di))
+                if got != want:
+                    bad.append(
+                        f"net {family}/doc{di} {tag}: socket pull "
+                        f"{len(got)}B != oracle export {len(want)}B")
+
+            gate("pre")
+            arm = rng.randrange(3)
+            if arm == 0:
+                # writer stall: the pull's DELTA is delayed, never lost
+                faultinject.inject("conn_stall", action="delay",
+                                   delay_s=0.005, times=1)
+                gate("stalled")
+            elif arm == 1:
+                # a bit-flipped inbound frame fails ONLY this
+                # connection, typed; the reconnect below is the resume
+                faultinject.inject("net_frame", action="bitflip", times=1)
+                try:
+                    cli.pull(di)
+                    bad.append(
+                        f"net {family}/doc{di}: bit-flipped frame was "
+                        "served instead of failing typed")
+                except (NetError, DecodeError):
+                    pass
+            else:
+                # accept refusal: the FIRST reconnect attempt is
+                # refused typed; the retry (fault exhausted) serves
+                faultinject.inject("net_accept", action="raise", times=1)
+                cli.kill()  # tpulint: disable=LT-TUNNEL(NetClient.kill = abrupt socket close, not a process signal)
+                try:
+                    cli.reconnect()
+                    bad.append(
+                        f"net {family}/doc{di}: accept fault did not "
+                        "refuse the connection")
+                except (NetError, DecodeError):
+                    pass
+            # abrupt kill + reconnect-with-frontier resume (retry once:
+            # the armed fault above may have already torn the socket)
+            cli.kill()  # tpulint: disable=LT-TUNNEL(NetClient.kill = abrupt socket close, not a process signal)
+            for attempt in range(2):
+                try:
+                    cli.reconnect()
+                    break
+                except (NetError, DecodeError):
+                    if attempt:
+                        raise
+            gate("resumed")
+            obs.counter("chaos.net_nemeses_total",
+                        "socket-edge nemesis executions").inc(
+                family=family)
+        finally:
+            for site in ("conn_stall", "net_frame", "net_accept"):
+                faultinject.clear(site)
+            if cli is not None:
+                cli.kill()  # tpulint: disable=LT-TUNNEL(NetClient.kill = abrupt socket close, not a process signal)
+            if srv is not None:
+                srv.close()
+        return bad
+
     def checkpoint(self, family: str) -> bool:
         p = self.planes[family]
         try:

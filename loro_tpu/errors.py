@@ -68,6 +68,24 @@ class SessionClosed(SyncError):
     """Operation on a session that was closed or TTL-expired."""
 
 
+class NetError(LoroError):
+    """Base for the network edge (loro_tpu/net/, docs/NET.md): frame-
+    layer violations (oversized frames, send-queue overflow, a closed
+    or refused connection) and client-side transport failures.  A
+    NetError fails exactly ONE connection — the accept loop and every
+    other live session keep serving.  Truncated / bit-flipped frame
+    *bytes* raise ``CodecDecodeError`` (the codec-harden contract);
+    sync-layer outcomes crossing the wire re-raise their own types
+    (``PushRejected``, ``StaleFrontier``, ``NotLeader``, ...)."""
+
+
+class NetProtocolError(NetError):
+    """The peer spoke the wrong protocol: bad HELLO magic, an
+    unsupported protocol version, an unknown message type, or a frame
+    whose declared length exceeds the negotiated maximum.  The
+    connection closes typed; reconnect-with-frontier resume applies."""
+
+
 class ShardingError(LoroError):
     """Sharded-fleet lifecycle misuse (loro_tpu/parallel/sharded.py,
     docs/SHARDING.md): migrating to a shard with no free slot, moving a

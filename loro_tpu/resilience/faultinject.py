@@ -57,6 +57,16 @@ instrumented choke points of the device pipeline:
 - ``repl_promote`` — replication.Follower.promote entry: fires before
                      the fencing token bump (promotion races / crash-
                      before-fence; a retried promote starts clean)
+- ``net_accept``   — net.NetServer accept path: refuse the next
+                     accepted connection(s) typed — live connections
+                     and their sessions keep serving
+- ``net_frame``    — net.NetServer frame reader: mangle one received
+                     frame's bytes before the crc gate (typed
+                     CodecDecodeError fails ONLY that connection)
+- ``conn_stall``   — net.NetServer per-connection writer: delay = a
+                     stalled/slow reader socket (bounded send-queue
+                     backpressure); raise = typed teardown of that
+                     one connection
 
 Arm programmatically::
 
@@ -126,6 +136,7 @@ _SITE_MODULES = (
     "loro_tpu.sync.readbatch",
     "loro_tpu.replication.shipper",
     "loro_tpu.replication.follower",
+    "loro_tpu.net.server",
 )
 
 _ACTIONS = ("raise", "delay", "hang", "truncate", "bitflip", "poison")

@@ -1,0 +1,278 @@
+"""Net-edge soak — NOT collected by pytest.
+
+Run: python tests/soak_net.py  (~1-3 min at defaults)
+
+The soak_sync churn pattern pushed over REAL TCP sockets: a fleet of
+NetServers (one per resident family, each fronting a SyncServer) and
+NetClients whose per-doc frontiers are their complete resume token
+(docs/NET.md):
+
+- SOAK_NET_CLIENTS (8) writer clients over SOAK_NET_DOCS (3) docs
+  (multiple writers per doc merge through the server);
+  SOAK_NET_EPOCHS (6), SOAK_NET_SEED (0).  Every client holds one TCP
+  connection per family server — SOAK_NET_CLIENTS=40 is a
+  200-connection run;
+- every epoch, each live client edits all five container families in
+  its replica and pushes the delta over the wire (blocking PUSH_ACK);
+  a random subset KILLS its sockets (the abrupt no-BYE close — the
+  in-process SIGKILL) and reconnects with its frontiers: the HELLO
+  must count as a resume and the next pull is exactly the missed
+  delta; a random client LEAVES (graceful BYE), a random fresh client
+  JOINS mid-run (first pull reconstructs its replica), and a random
+  subset STALLS its pull;
+- per-epoch gate: every family server's reads match an independent
+  host oracle replaying the same pushed payloads, and every
+  non-stalled client replica converges to it;
+- the run asserts every NetServer actually saw the churn: resumes >=
+  the kill/reconnect events, zero frame errors, and the final
+  connection count returns to zero after the drain.
+"""
+import os
+import os.path as _p
+import random
+import sys
+import time
+
+_here = _p.dirname(_p.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, _p.dirname(_here))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from loro_tpu import LoroDoc  # noqa: E402
+from loro_tpu.net import NetClient, NetServer  # noqa: E402
+from loro_tpu.sync import SyncServer  # noqa: E402
+
+CLIENTS = int(os.environ.get("SOAK_NET_CLIENTS", "8"))
+DOCS = int(os.environ.get("SOAK_NET_DOCS", "3"))
+EPOCHS = int(os.environ.get("SOAK_NET_EPOCHS", "6"))
+SEED = int(os.environ.get("SOAK_NET_SEED", "0"))
+
+FAMILIES = ("text", "map", "tree", "counter", "movable")
+CAPS = {
+    "text": dict(capacity=1 << 13),
+    "map": dict(slot_capacity=128),
+    "tree": dict(move_capacity=1 << 12, node_capacity=512),
+    "counter": dict(slot_capacity=32),
+    "movable": dict(capacity=1 << 12, elem_capacity=512),
+}
+PUSH_TIMEOUT = 240.0
+
+t0 = time.time()
+rng = random.Random(SEED)
+
+base = []
+for i in range(DOCS):
+    d = LoroDoc(peer=1000 + i)
+    d.get_text("t").insert(0, f"net soak base {i}")
+    d.get_map("m").set("k", i)
+    d.get_tree("tr").create()
+    d.get_counter("c").increment(i + 1)
+    d.get_movable_list("ml").push("a", "b")
+    d.commit()
+    base.append(d)
+cids = {
+    "text": base[0].get_text("t").id,
+    "tree": base[0].get_tree("tr").id,
+    "movable": base[0].get_movable_list("ml").id,
+    "map": None,
+    "counter": None,
+}
+
+servers = {fam: SyncServer(fam, DOCS, cid=cids[fam], coalesce=4,
+                           **CAPS[fam])
+           for fam in FAMILIES}
+nets = {fam: NetServer(servers[fam],
+                       max_connections=max(64, CLIENTS * 2 + 8))
+        for fam in FAMILIES}
+oracle = [LoroDoc(peer=2000 + i) for i in range(DOCS)]
+kills = 0
+
+
+class Client:
+    """One writer replica with one TCP connection per family server."""
+
+    _next = 0
+
+    def __init__(self, di, seed_from_server: bool):
+        Client._next += 1
+        self.n = Client._next
+        self.di = di
+        self.doc = LoroDoc(peer=100 + self.n)
+        self.cli = {
+            fam: NetClient("127.0.0.1", nets[fam].port, fam,
+                           client_id=f"c{self.n}", timeout=120.0)
+            for fam in FAMILIES
+        }
+        if seed_from_server:
+            for c in self.cli.values():
+                c.connect()
+            self.doc.import_(self.cli["text"].pull(di))
+            # every family server holds the same op history: the
+            # reconstructed replica's vv is the resume token for ALL
+            # five connections, not just the one that pulled
+            for fam in FAMILIES:
+                if fam != "text":
+                    self.cli[fam].set_frontier(di, self.doc.oplog_vv())
+        else:
+            self.doc.import_(base[di].export_snapshot())
+            for c in self.cli.values():
+                c.set_frontier(di, self.doc.oplog_vv())
+                c.connect()
+        self.mark = self.doc.oplog_vv()
+
+    def edit_and_push(self, rng):
+        d = self.doc
+        for _ in range(rng.randint(2, 5)):
+            kind = rng.randint(0, 4)
+            if kind == 0:
+                t = d.get_text("t")
+                L = len(t)
+                if L > 4 and rng.random() < 0.3:
+                    t.delete(rng.randrange(L - 2), 2)
+                else:
+                    t.insert(rng.randint(0, L), rng.choice(["xy", "q ", "lo"]))
+            elif kind == 1:
+                d.get_map("m").set(rng.choice(["k1", "k2"]), rng.randrange(99))
+            elif kind == 2:
+                tr = d.get_tree("tr")
+                nodes = tr.nodes()
+                if not nodes or rng.random() < 0.5:
+                    tr.create(rng.choice(nodes) if nodes else None)
+                else:
+                    tr.delete(rng.choice(nodes))
+            elif kind == 3:
+                d.get_counter("c").increment(rng.randint(-9, 9))
+            else:
+                ml = d.get_movable_list("ml")
+                L = len(ml)
+                if L >= 2 and rng.random() < 0.4:
+                    ml.move(rng.randrange(L), rng.randrange(L))
+                else:
+                    ml.insert(rng.randint(0, L), f"s{self.n}")
+        d.commit()
+        payload = d.export_updates(self.mark)
+        self.mark = d.oplog_vv()
+        oracle[self.di].import_(bytes(payload))
+        for fam in FAMILIES:
+            self.cli[fam].push(self.di, payload, timeout=PUSH_TIMEOUT)
+            # the ack proves the push landed; advance the resume token
+            # so a crash-right-now resumes past our own ops
+            self.cli[fam].set_frontier(self.di, self.doc.oplog_vv())
+
+    def pull(self):
+        self.doc.import_(self.cli["text"].pull(self.di))
+        self.mark = self.doc.oplog_vv()
+        for fam in FAMILIES:
+            if fam != "text":
+                self.cli[fam].pull(self.di)
+
+    def crash_and_resume(self):
+        """The abrupt disconnect: no BYE, the server learns from the
+        dead socket; reconnect = HELLO with the held frontiers."""
+        for c in self.cli.values():
+            c.kill()
+        for c in self.cli.values():
+            info = c.reconnect()
+            assert info["resumed"] >= 1, \
+                f"client c{self.n}: reconnect did not resume its frontier"
+
+    def leave(self):
+        for c in self.cli.values():
+            c.close()
+
+
+def _gate(epoch, clients):
+    for srv in servers.values():
+        srv.flush()
+    texts = servers["text"].texts()
+    segs = servers["text"].richtexts()
+    mvals = servers["map"].root_value_maps("m")
+    parents = servers["tree"].parent_maps()
+    cvals = servers["counter"].value_maps()
+    mls = servers["movable"].value_lists()
+    for i in range(DOCS):
+        o = oracle[i]
+        t = o.get_text("t")
+        assert texts[i] == t.to_string(), f"text epoch {epoch} doc {i}"
+        assert segs[i] == t.get_richtext_value(), \
+            f"richtext epoch {epoch} doc {i}"
+        assert mvals[i] == o.get_map("m").get_value(), \
+            f"map epoch {epoch} doc {i}"
+        tr = o.get_tree("tr")
+        assert parents[i] == {x: tr.parent(x) for x in tr.nodes()}, \
+            f"tree epoch {epoch} doc {i}"
+        c = o.get_counter("c")
+        assert cvals[i].get(c.id, 0.0) == c.get_value(), \
+            f"counter epoch {epoch} doc {i}"
+        assert mls[i] == o.get_movable_list("ml").get_value(), \
+            f"movable epoch {epoch} doc {i}"
+    for cl in clients:
+        assert cl.doc.get_deep_value() == oracle[cl.di].get_deep_value(), \
+            f"client c{cl.n} epoch {epoch} diverged"
+
+
+# seed the servers with the base history (writer 0 per doc pushes it)
+clients = [Client(i % DOCS, seed_from_server=False) for i in range(CLIENTS)]
+for i in range(DOCS):
+    payload = base[i].export_updates({})
+    oracle[i].import_(bytes(payload))
+    first = next(c for c in clients if c.di == i)
+    for fam in FAMILIES:
+        first.cli[fam].push(i, payload, timeout=PUSH_TIMEOUT)
+print(f"boot: {CLIENTS} clients x {len(FAMILIES)} families connected "
+      f"({sum(n.report()['connections'] for n in nets.values())} sockets)")
+
+for epoch in range(EPOCHS):
+    if len(clients) > 2 and rng.random() < 0.3:
+        gone = clients.pop(rng.randrange(len(clients)))
+        gone.leave()
+        print(f"  epoch {epoch}: client c{gone.n} left")
+    if rng.random() < 0.4:
+        joined = Client(rng.randrange(DOCS), seed_from_server=True)
+        clients.append(joined)
+        print(f"  epoch {epoch}: client c{joined.n} joined doc {joined.di}")
+    crashed = [c for c in clients if rng.random() < 0.25]
+    for cl in crashed:
+        cl.crash_and_resume()
+        kills += 1
+    if crashed:
+        print(f"  epoch {epoch}: {len(crashed)} client(s) killed their "
+              "sockets and resumed")
+    stalled = {c.n for c in clients if rng.random() < 0.2}
+    for cl in clients:
+        cl.edit_and_push(rng)
+    active = [cl for cl in clients if cl.n not in stalled]
+    for cl in active:
+        cl.pull()
+    if stalled:
+        print(f"  epoch {epoch}: {len(stalled)} client(s) stalled their pull")
+    _gate(epoch, active)
+    print(f"epoch {epoch}: {len(clients)} clients, all 5 family servers "
+          f"match the host oracle ({time.time()-t0:.0f}s)")
+
+for cl in clients:
+    cl.pull()
+_gate("final", clients)
+
+for cl in clients:
+    cl.leave()
+for fam, net in nets.items():
+    rep = net.report()
+    assert rep["frame_errors"] == 0, f"{fam}: frame errors under churn"
+    assert rep["resumes"] >= kills, \
+        f"{fam}: resumes {rep['resumes']} < kill/reconnects {kills}"
+    deadline = time.time() + 30
+    while rep["connections"] and time.time() < deadline:
+        time.sleep(0.05)
+        rep = net.report()
+    assert rep["connections"] == 0, f"{fam}: sockets leaked after drain"
+    net.close()
+for srv in servers.values():
+    srv.close()
+
+print(f"NET SOAK CLEAN: {CLIENTS} clients x {len(FAMILIES)} conns each x "
+      f"{DOCS} docs x {EPOCHS} epochs, {kills} kill/resumes in "
+      f"{time.time()-t0:.0f}s")

@@ -151,6 +151,21 @@ def _fat_checkpoint():
               "rows_per_round": 96, "skew": "85/15 over 4-doc core",
               "rows_per_sec_all_hot": 940_000,
               "rows_per_sec_tiered": 850_000, "note": "t" * 300},
+        net_connections=64,
+        net_pushes_per_sec=310.5,
+        net_push_to_visible_ms_p50=18.3,
+        net_push_to_visible_ms_p99=96.2,
+        net={"connections": 64, "docs": 8, "epochs": 4, "pushes": 256,
+             "pushes_per_sec": 310.5,
+             "push_to_ack_ms_p50_server": 12.1,
+             "push_to_ack_ms_p99_server": 80.4,
+             "net_stages": {"net.ack": {"count": 256, "mean_ms": 0.3},
+                            "net.send": {"count": 256, "mean_ms": 0.1}},
+             "server": {"addr": "127.0.0.1:4242", "connections": 64,
+                        "accepted": 64, "refused": 0, "frame_errors": 0,
+                        "resumes": 0, "max_frame": 8388608,
+                        "max_connections": 72},
+             "note": "n" * 300},
         repl_readers=32,
         repl_pulls_per_sec=1495.2,
         repl_pulls_per_sec_leader_only=749.5,
@@ -208,12 +223,15 @@ class TestFlagshipLine:
                   "repl_readers", "repl_pulls_per_sec",
                   "repl_pulls_per_sec_leader_only", "repl_read_scaling_x",
                   "repl_lag_ms_p50", "repl_lag_ms_p99",
-                  "repl_promotion_downtime_ms"):
+                  "repl_promotion_downtime_ms",
+                  "net_connections", "net_pushes_per_sec",
+                  "net_push_to_visible_ms_p50",
+                  "net_push_to_visible_ms_p99"):
             assert k in back, k
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
         for k in ("metrics", "resilience", "pipeline", "rank", "sync",
-                  "shard", "tier", "readplane", "repl", "trace",
+                  "shard", "tier", "readplane", "repl", "trace", "net",
                   "baseline_note", "roofline_note",
                   "resident_pipeline_note"):
             assert k in side, k

@@ -65,11 +65,13 @@ ALL_SITES = {
     "read_batch", "export_launch",
     "evict_flush", "revive_replay",
     "repl_ship", "repl_apply", "repl_promote",
+    "net_accept", "net_frame", "conn_stall",
 }
 
 DOC_FILES = [
     "docs/RESILIENCE.md", "docs/PERSISTENCE.md", "docs/SYNC.md",
-    "docs/REPLICATION.md", "docs/RESIDENCY.md", "CLAUDE.md",
+    "docs/REPLICATION.md", "docs/RESIDENCY.md", "docs/NET.md",
+    "CLAUDE.md",
 ]
 
 

@@ -1,0 +1,380 @@
+"""NetServer edge contracts (ISSUE 16): typed config knobs, the three
+net fault sites, follower NOT_LEADER redirect, idle reaping with an
+injected clock, the connection cap, and the lock-witness audit.
+
+The failure contract under test: a damaged frame / injected fault
+fails ONLY the connection it hit — the accept loop, every other
+connection, and the SyncServer underneath keep serving, typed and
+counted.  (The codec fuzz + byte-identity + SIGKILL-reconnect gates
+live in tests/test_net_wire.py.)
+"""
+import threading
+import time
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.errors import (
+    CodecDecodeError, ConfigError, NetError, NotLeader,
+)
+from loro_tpu.net import NetClient, NetServer
+from loro_tpu.net import config as netcfg
+from loro_tpu.obs import metrics as obs
+from loro_tpu.replication.readonly import ReadOnlySyncServer
+from loro_tpu.resilience import faultinject
+from loro_tpu.sync import SyncServer
+
+from test_sync import CAPS, _cid_of, _seed_doc
+
+
+def _text_server(n_docs=1, **kw):
+    """A booted text SyncServer with base content in every doc."""
+    base = _seed_doc(61, 0)
+    caps = dict(CAPS["text"])
+    caps.update(kw)
+    srv = SyncServer("text", n_docs, cid=_cid_of("text", base), **caps)
+    boot = srv.connect(sid="boot")
+    for di in range(n_docs):
+        boot.push(di, base.export_updates({})).epoch(60)
+    return srv, base
+
+
+def _client(net, client_id=""):
+    cli = NetClient("127.0.0.1", net.port, "text", client_id=client_id)
+    cli.connect()
+    return cli
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# config knobs: typed ConfigError at first use
+# ---------------------------------------------------------------------------
+class TestConfigKnobs:
+    @pytest.mark.parametrize("knob,resolve,bad", [
+        ("LORO_NET_PORT", netcfg.resolve_port, "not-a-port"),
+        ("LORO_NET_PORT", netcfg.resolve_port, "70000"),
+        ("LORO_NET_MAX_FRAME", netcfg.resolve_max_frame, "12"),
+        ("LORO_NET_MAX_FRAME", netcfg.resolve_max_frame, "huge"),
+        ("LORO_NET_BACKLOG", netcfg.resolve_backlog, "0"),
+        ("LORO_NET_MAX_CONNS", netcfg.resolve_max_conns, "0"),
+        ("LORO_NET_MAX_CONNS", netcfg.resolve_max_conns, "many"),
+        ("LORO_NET_IDLE_S", netcfg.resolve_idle_s, "-1"),
+        ("LORO_NET_IDLE_S", netcfg.resolve_idle_s, "soon"),
+    ])
+    def test_bad_env_raises_typed_at_first_use(self, monkeypatch, knob,
+                                               resolve, bad):
+        monkeypatch.setenv(knob, bad)
+        with pytest.raises(ConfigError) as ei:
+            resolve()
+        assert knob in str(ei.value)
+
+    def test_good_env_resolves(self, monkeypatch):
+        monkeypatch.setenv("LORO_NET_MAX_FRAME", "65536")
+        monkeypatch.setenv("LORO_NET_IDLE_S", "2.5")
+        monkeypatch.setenv("LORO_NET_MAX_CONNS", "7")
+        monkeypatch.setenv("LORO_NET_BACKLOG", "9")
+        monkeypatch.setenv("LORO_NET_PORT", "0")
+        assert netcfg.resolve_max_frame() == 65536
+        assert netcfg.resolve_idle_s() == 2.5
+        assert netcfg.resolve_max_conns() == 7
+        assert netcfg.resolve_backlog() == 9
+        assert netcfg.resolve_port() == 0
+
+    def test_explicit_arg_beats_env(self, monkeypatch):
+        # a malformed env var a caller never consults must not explode
+        monkeypatch.setenv("LORO_NET_MAX_FRAME", "not-an-int")
+        assert netcfg.resolve_max_frame(4096) == 4096
+
+    def test_explicit_bad_arg_raises_typed(self):
+        with pytest.raises(ConfigError):
+            netcfg.resolve_port(70000)
+        with pytest.raises(ConfigError):
+            netcfg.resolve_max_frame(10)
+        with pytest.raises(ConfigError):
+            netcfg.resolve_backlog(0)
+        with pytest.raises(ConfigError):
+            netcfg.resolve_max_conns(0)
+        with pytest.raises(ConfigError):
+            netcfg.resolve_idle_s(-2)
+
+    def test_server_surfaces_config_error_at_construction(self, monkeypatch):
+        monkeypatch.setenv("LORO_NET_MAX_CONNS", "0")
+        srv, _ = _text_server()
+        try:
+            with pytest.raises(ConfigError):
+                NetServer(srv)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# fault sites: net_frame / net_accept / conn_stall
+# ---------------------------------------------------------------------------
+@pytest.mark.faultinject
+class TestFaultSites:
+    def test_net_frame_fails_only_that_connection(self):
+        srv, _ = _text_server()
+        net = NetServer(srv)
+        a = b = None
+        try:
+            a = _client(net, "a")
+            b = _client(net, "b")
+            a.pull(0)
+            b.pull(0)
+            faultinject.inject("net_frame", action="bitflip", flip_at=2,
+                               times=1)
+            try:
+                # the server mangles a's next frame on its way to the
+                # crc gate -> typed ERROR + that connection closes
+                with pytest.raises((CodecDecodeError, NetError)):
+                    a.pull(0)
+            finally:
+                faultinject.clear()
+            # the OTHER connection and the accept loop keep serving
+            b.pull(0)
+            assert _wait(lambda: net.report()["frame_errors"] == 1)
+            # the failed client reconnects with its frontier and resumes
+            a.reconnect()
+            assert a.hello_info["resumed"] >= 1
+            a.pull(0)
+        finally:
+            for c in (a, b):
+                if c is not None:
+                    c.kill()
+            net.close()
+            srv.close()
+
+    def test_net_accept_refuses_new_keeps_live(self):
+        srv, _ = _text_server()
+        net = NetServer(srv)
+        a = late = None
+        try:
+            a = _client(net, "a")
+            a.pull(0)
+            faultinject.inject("net_accept", times=1)
+            try:
+                with pytest.raises(NetError):
+                    _client(net, "refused")
+            finally:
+                faultinject.clear()
+            assert net.report()["refused"] == 1
+            # the live session never noticed; new connections accept again
+            a.pull(0)
+            late = _client(net, "late")
+            late.pull(0)
+        finally:
+            for c in (a, late):
+                if c is not None:
+                    c.kill()
+            net.close()
+            srv.close()
+
+    def test_conn_stall_delay_is_backpressure_not_failure(self):
+        srv, _ = _text_server()
+        net = NetServer(srv)
+        a = None
+        try:
+            a = _client(net, "a")
+            a.pull(0)
+            faultinject.inject("conn_stall", action="delay", delay_s=0.4,
+                               times=1)
+            try:
+                t0 = time.perf_counter()
+                a.pull(0)  # served — just late (a slow reader socket)
+                assert time.perf_counter() - t0 >= 0.3
+            finally:
+                faultinject.clear()
+            a.pull(0)
+        finally:
+            if a is not None:
+                a.kill()
+            net.close()
+            srv.close()
+
+    def test_conn_stall_raise_tears_down_exactly_one_conn(self):
+        srv, _ = _text_server()
+        net = NetServer(srv)
+        a = b = None
+        try:
+            a = _client(net, "a")
+            b = _client(net, "b")
+            a.pull(0)
+            b.pull(0)
+            faultinject.inject("conn_stall", action="raise",
+                               exc=RuntimeError("injected writer stall"),
+                               times=1)
+            try:
+                # a's DELTA is the only outbound frame: its writer trips
+                # the fault and the connection dies typed
+                with pytest.raises(NetError):
+                    a.pull(0)
+            finally:
+                faultinject.clear()
+            b.pull(0)
+            a.reconnect()
+            a.pull(0)
+        finally:
+            for c in (a, b):
+                if c is not None:
+                    c.kill()
+            net.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# follower redirect: NOT_LEADER carries the leader address
+# ---------------------------------------------------------------------------
+class TestNotLeaderRedirect:
+    def _payload(self):
+        d = LoroDoc(peer=900)
+        d.get_text("t").insert(0, "from the client")
+        d.commit()
+        return d.export_updates({})
+
+    def test_push_redirects_with_leader_identity(self):
+        base = _seed_doc(62, 0)
+        ro = ReadOnlySyncServer("text", 1, cid=_cid_of("text", base),
+                                leader_id="10.0.0.9:7007", **CAPS["text"])
+        net = NetServer(ro)
+        cli = None
+        try:
+            cli = _client(net, "reader")
+            cli.pull(0)  # reads serve fine on a follower
+            with pytest.raises(NotLeader) as ei:
+                cli.push(0, self._payload())
+            assert ei.value.leader == "10.0.0.9:7007"
+            # a sync-layer outcome: the connection LIVES
+            cli.pull(0)
+        finally:
+            if cli is not None:
+                cli.kill()
+            net.close()
+            ro.close()
+
+    def test_leader_addr_fallback_when_follower_has_none(self):
+        base = _seed_doc(63, 0)
+        ro = ReadOnlySyncServer("text", 1, cid=_cid_of("text", base),
+                                leader_id=None, **CAPS["text"])
+        net = NetServer(ro, leader_addr="10.1.1.1:9")
+        cli = None
+        try:
+            cli = _client(net, "reader")
+            with pytest.raises(NotLeader) as ei:
+                cli.push(0, self._payload())
+            assert ei.value.leader == "10.1.1.1:9"
+        finally:
+            if cli is not None:
+                cli.kill()
+            net.close()
+            ro.close()
+
+
+# ---------------------------------------------------------------------------
+# idle reaping (injected clock) + the connection cap
+# ---------------------------------------------------------------------------
+class TestIdleAndCap:
+    def test_idle_timeout_reaps_with_injected_clock(self):
+        fake = [0.0]
+        srv, _ = _text_server()
+        net = NetServer(srv, idle_timeout=1.0, clock=lambda: fake[0])
+        cli = again = None
+        try:
+            n0 = obs.counter("net.idle_closes_total").get(family="text")
+            cli = _client(net, "idler")
+            cli.pull(0)
+            assert net.report()["connections"] == 1
+            fake[0] += 100.0  # way past the idle cutoff
+            assert _wait(lambda: net.report()["connections"] == 0)
+            assert obs.counter("net.idle_closes_total").get(
+                family="text") == n0 + 1
+            with pytest.raises(NetError):
+                cli.pull(0)
+            # the server itself is healthy: fresh connections serve
+            again = _client(net, "again")
+            again.pull(0)
+        finally:
+            for c in (cli, again):
+                if c is not None:
+                    c.kill()
+            net.close()
+            srv.close()
+
+    def test_connection_cap_refuses_then_frees(self):
+        srv, _ = _text_server()
+        net = NetServer(srv, max_connections=1)
+        a = b = None
+        try:
+            a = _client(net, "a")
+            with pytest.raises(NetError):
+                _client(net, "over-cap")
+            assert net.report()["refused"] == 1
+            a.pull(0)  # the capped-out accept never touched the live conn
+            a.close()
+            assert _wait(lambda: net.report()["connections"] == 0)
+            b = _client(net, "b")  # the slot freed
+            b.pull(0)
+        finally:
+            for c in (a, b):
+                if c is not None:
+                    c.kill()
+            net.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# lock witness: the net.accept lock nests conformantly under load
+# ---------------------------------------------------------------------------
+class TestWitness:
+    def test_net_edges_conform(self):
+        from loro_tpu.analysis import lockorder
+        from loro_tpu.analysis.lockwitness import witness
+
+        w = witness()
+        w.reset()
+        w.enable(strict=False)
+        try:
+            srv, base = _text_server(n_docs=2)
+            net = NetServer(srv)
+            clis = []
+            try:
+                clis = [_client(net, f"w{k}") for k in range(4)]
+
+                def _work(k):
+                    cli = clis[k]
+                    d = LoroDoc(peer=700 + k)
+                    d.import_(base.export_snapshot())
+                    mark = d.oplog_vv()
+                    for r in range(3):
+                        d.get_text("t").insert(0, f"w{k}r{r} ")
+                        d.commit()
+                        cli.push(k % 2, d.export_updates(mark))
+                        mark = d.oplog_vv()
+                        cli.pull(k % 2)
+                    cli.poll(timeout_s=0.05)
+
+                ths = [threading.Thread(target=_work, args=(k,))
+                       for k in range(4)]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join(60)
+            finally:
+                for c in clis:
+                    c.kill()
+                net.close()
+                srv.close()
+        finally:
+            w.disable()
+        assert w.check_declared() == []
+        w.assert_acyclic()
+        assert lockorder.level("net.accept") is not None
+        assert lockorder.level("net.accept") < lockorder.level("sync.server")
+        w.reset()

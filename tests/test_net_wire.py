@@ -1,0 +1,373 @@
+"""Wire protocol + net-edge differential gates (ISSUE 16).
+
+Three contracts:
+
+1. **Codec fuzz** — every message type round-trips through the
+   length+crc envelope; truncation at EVERY byte offset, bit-flips,
+   oversized frames, unknown types and wrong HELLO magic all raise
+   typed ``CodecDecodeError``/``NetProtocolError`` (never a silent
+   mis-decode, never an untyped crash).
+2. **Five-family differential gate** — a socket ``NetClient.pull`` is
+   byte-identical to the in-process ``Session.pull`` at the same
+   frontier (the wire layer ships columnar-updates bytes VERBATIM).
+3. **SIGKILL reconnect** — a client process killed with SIGKILL
+   (CPU-only child, per docs/RESILIENCE.md rule 1) resumes from its
+   persisted frontier and loses nothing that was PUSH_ACKed: the
+   regenerated replica + resumed pull converges with the server
+   oracle in both directions.
+"""
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from loro_tpu import LoroDoc
+from loro_tpu.core.version import VersionVector
+from loro_tpu.errors import CodecDecodeError, NetError, NetProtocolError
+from loro_tpu.net import NetClient, NetServer, wire
+from loro_tpu.sync import SyncServer
+
+from test_sync import CAPS, FAMILIES, _cid_of, _edit, _seed_doc
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _mk_server(family, n_docs, base, **kw):
+    caps = dict(CAPS[family])
+    caps.update(kw)
+    return SyncServer(family, n_docs, cid=_cid_of(family, base), **caps)
+
+
+def _sample_bodies():
+    """One representative encoded body per message type."""
+    vv = VersionVector({7: 3, 9: 12})
+    return {
+        wire.HELLO: wire.encode_hello("text", "cli-1", {0: vv, 2: vv}),
+        wire.HELLO_OK: wire.encode_hello_ok("text", 8, 41, "net-c-3", 2),
+        wire.PUSH: wire.encode_push(5, 2, b"\x00\x01payload bytes"),
+        wire.PUSH_ACK: wire.encode_push_ack(5, 41, 39, "p77-3"),
+        wire.PULL: wire.encode_pull(6, 1, min_epoch=12),
+        wire.DELTA: wire.encode_delta(6, 1, b"delta-bytes", vv, False),
+        wire.POLL: wire.encode_poll(7, 1500),
+        wire.EVENT: wire.encode_event(7, {0: 41, 3: 40}, [b"pres"]),
+        wire.PRESENCE: wire.encode_presence(b"aware-blob"),
+        wire.ERROR: wire.encode_error(
+            0, wire.E_NOT_LEADER, "read-only", "10.0.0.2:7777"),
+        wire.BYE: wire.encode_bye(),
+    }
+
+
+class TestCodecRoundtrip:
+    def test_every_type_roundtrips(self):
+        for t, body in _sample_bodies().items():
+            framed = wire.frame(body)
+            body_len, crc = wire.parse_header(
+                framed[:wire.HEADER_LEN], len(body))
+            got_t, _fields = wire.decode(
+                wire.check_body(framed[wire.HEADER_LEN:], crc))
+            assert got_t == t and body_len == len(body)
+        # spot-check field fidelity on the interesting ones
+        t, f = wire.decode(_sample_bodies()[wire.HELLO])
+        assert f["family"] == "text" and f["client_id"] == "cli-1"
+        assert dict(f["frontiers"][0].items()) == {7: 3, 9: 12}
+        t, f = wire.decode(_sample_bodies()[wire.PUSH_ACK])
+        assert f == {"rid": 5, "epoch": 41, "durable_epoch": 39,
+                     "trace_id": "p77-3"}
+        t, f = wire.decode(_sample_bodies()[wire.PULL])
+        assert f["min_epoch"] == 12
+        t, f = wire.decode(wire.encode_pull(1, 0))  # None round-trips
+        assert f["min_epoch"] is None
+        t, f = wire.decode(wire.encode_push_ack(1, 3, None, ""))
+        assert f["durable_epoch"] is None
+        t, f = wire.decode(_sample_bodies()[wire.DELTA])
+        assert f["payload"] == b"delta-bytes" and f["first_sync"] is False
+        assert dict(f["new_vv"].items()) == {7: 3, 9: 12}
+        t, f = wire.decode(_sample_bodies()[wire.EVENT])
+        assert f["docs"] == {0: 41, 3: 40} and f["presence"] == [b"pres"]
+        t, f = wire.decode(_sample_bodies()[wire.ERROR])
+        assert f["code"] == wire.E_NOT_LEADER
+        assert f["leader"] == "10.0.0.2:7777"
+
+    def test_frame_envelope_roundtrip(self):
+        body = _sample_bodies()[wire.PUSH]
+        framed = wire.frame(body)
+        body_len, crc = wire.parse_header(framed[:wire.HEADER_LEN],
+                                          1 << 20)
+        assert body_len == len(body)
+        assert wire.check_body(framed[wire.HEADER_LEN:], crc) == body
+
+
+class TestCodecFuzz:
+    def test_truncation_at_every_offset_is_typed(self):
+        """body[:k] for EVERY k < len must raise typed — a truncated
+        frame can never silently decode to a different message."""
+        for t, body in _sample_bodies().items():
+            for cut in range(len(body)):
+                if t == wire.BYE and cut == 1:
+                    continue  # BYE is the 1-byte body itself
+                with pytest.raises((CodecDecodeError, NetProtocolError)):
+                    wire.decode(body[:cut])
+
+    def test_bitflips_fail_the_crc_gate(self):
+        rng = random.Random(0xF1)
+        body = _sample_bodies()[wire.DELTA]
+        framed = wire.frame(body)
+        _, crc = wire.parse_header(framed[:wire.HEADER_LEN], 1 << 20)
+        for _ in range(64):
+            flipped = bytearray(body)
+            flipped[rng.randrange(len(body))] ^= 1 << rng.randrange(8)
+            with pytest.raises(CodecDecodeError):
+                wire.check_body(bytes(flipped), crc)
+
+    def test_oversized_frame_refused_before_body(self):
+        with pytest.raises(NetProtocolError):
+            wire.frame(b"x" * 100, max_frame=64)
+        # a peer DECLARING an oversized body is refused from the
+        # header alone — no body bytes ever read
+        hdr = wire.frame(b"x" * 100)[:wire.HEADER_LEN]
+        with pytest.raises(NetProtocolError):
+            wire.parse_header(hdr, 64)
+
+    def test_unknown_type_and_empty_body(self):
+        with pytest.raises(NetProtocolError):
+            wire.decode(bytes([0x7F]) + b"junk")
+        with pytest.raises(CodecDecodeError):
+            wire.decode(b"")
+
+    def test_wrong_hello_magic_is_protocol_error(self):
+        body = bytearray(_sample_bodies()[wire.HELLO])
+        body[1:5] = b"HTTP"
+        with pytest.raises(NetProtocolError):
+            wire.decode(bytes(body))
+
+    def test_varint_overrun_is_typed(self):
+        with pytest.raises(CodecDecodeError):
+            wire.decode(bytes([wire.PUSH]) + b"\xff" * 12)
+
+    def test_error_frames_reraise_typed(self):
+        from loro_tpu.errors import (
+            NotLeader, PushRejected, ReplicaLag, SessionClosed,
+            StaleFrontier,
+        )
+
+        cases = [
+            (wire.E_PUSH_REJECTED, PushRejected),
+            (wire.E_STALE_FRONTIER, StaleFrontier),
+            (wire.E_NOT_LEADER, NotLeader),
+            (wire.E_REPLICA_LAG, ReplicaLag),
+            (wire.E_SESSION_CLOSED, SessionClosed),
+            (wire.E_BAD_VERSION, NetProtocolError),
+            (wire.E_BAD_FRAME, CodecDecodeError),
+            (wire.E_UNAVAILABLE, NetError),
+        ]
+        for code, exc_type in cases:
+            _, f = wire.decode(wire.encode_error(0, code, "msg", "l:1"))
+            with pytest.raises(exc_type):
+                wire.raise_error(f)
+        # NotLeader keeps the leader address for redirect
+        _, f = wire.decode(wire.encode_error(
+            0, wire.E_NOT_LEADER, "go away", "10.1.2.3:99"))
+        with pytest.raises(NotLeader) as ei:
+            wire.raise_error(f)
+        assert ei.value.leader == "10.1.2.3:99"
+
+
+class TestWrongVersionOverWire:
+    def test_server_refuses_future_protocol_typed(self):
+        base = _seed_doc(50, 0)
+        srv = _mk_server("text", 1, base)
+        net = NetServer(srv)
+        try:
+            s = socket.create_connection(("127.0.0.1", net.port),
+                                         timeout=10)
+            try:
+                s.sendall(wire.frame(wire.encode_hello(
+                    "text", "future", version=wire.PROTO_VERSION + 1)))
+                hdr = s.recv(wire.HEADER_LEN)
+                body_len, crc = wire.parse_header(hdr, 1 << 20)
+                body = b""
+                while len(body) < body_len:
+                    chunk = s.recv(body_len - len(body))
+                    assert chunk
+                    body += chunk
+                t, f = wire.decode(wire.check_body(body, crc))
+                assert t == wire.ERROR
+                assert f["code"] == wire.E_BAD_VERSION
+            finally:
+                s.close()
+            # the refusal killed only that connection: a well-versioned
+            # client still gets served
+            with NetClient("127.0.0.1", net.port, "text") as cli:
+                assert cli.hello_info["n_docs"] == 1
+        finally:
+            net.close()
+            srv.close()
+
+    def test_wrong_family_refused_typed(self):
+        base = _seed_doc(51, 0)
+        srv = _mk_server("map", 1, base)
+        net = NetServer(srv)
+        try:
+            cli = NetClient("127.0.0.1", net.port, "tree")
+            with pytest.raises(NetProtocolError):
+                cli.connect()
+            cli.kill()
+        finally:
+            net.close()
+            srv.close()
+
+
+class TestFamilyDifferential:
+    """Socket pulls == in-process Session.pull bytes, all five
+    families, frontiers walking the whole history lattice."""
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_socket_pull_byte_identical(self, family):
+        rng = random.Random(0x9E7 + hash(family) % 1000)
+        n_docs = 2
+        base = [_seed_doc(300 + i, i) for i in range(n_docs)]
+        srv = _mk_server(family, n_docs, base[0])
+        net = NetServer(srv)
+        clis = []
+        try:
+            writers = []
+            boot = []
+            for i in range(n_docs):
+                d = LoroDoc(peer=400 + 10 * i)
+                d.import_(base[i].export_snapshot())
+                s = srv.connect()
+                s._vv[i] = d.oplog_vv()
+                boot.append(s.push(i, d.export_updates({})))
+                writers.append((i, d, s, {"mark": d.oplog_vv()}))
+            for tk in boot:
+                tk.epoch(60)
+            clis = [NetClient("127.0.0.1", net.port, family,
+                              client_id=f"diff-{k}") for k in range(2)]
+            for cli in clis:
+                cli.connect()
+            for epoch in range(3):
+                tks = []
+                for i, d, s, st in writers:
+                    _edit(d, rng, f"n{epoch}")
+                    tks.append(s.push(i, d.export_updates(st["mark"])))
+                    st["mark"] = d.oplog_vv()
+                for tk in tks:
+                    tk.epoch(60)
+                for k, cli in enumerate(clis):
+                    for i in range(n_docs):
+                        # align an in-process session to the client's
+                        # exact frontier, then compare raw delta bytes
+                        cmp_s = srv.connect()
+                        fvv = cli.frontiers.get(i, VersionVector())
+                        with srv._lock:
+                            cmp_s._vv[i] = fvv.copy()
+                        want = cmp_s.pull(i)
+                        got = cli.pull(i)
+                        assert got == want, (family, epoch, k, i)
+                        cmp_s.close()
+                # empty delta: the immediate re-pull is byte-identical
+                # to the in-process empty envelope too
+                cli = clis[0]
+                cmp_s = srv.connect()
+                with srv._lock:
+                    cmp_s._vv[0] = cli.frontiers[0].copy()
+                assert cli.pull(0) == cmp_s.pull(0)
+                cmp_s.close()
+            # first-sync path: a brand-new client (empty frontier)
+            # gets the first-sync snapshot, same bytes as in-process
+            fresh = NetClient("127.0.0.1", net.port, family)
+            fresh.connect()
+            clis.append(fresh)
+            cmp_s = srv.connect()
+            want = cmp_s.pull(0)
+            got = fresh.pull(0)
+            assert got == want
+            # the wire first_sync flag mirrors the in-process path (a
+            # deep oracle serves full updates, not a snapshot; the
+            # shallow-reopen snapshot path is gated in soak_sync)
+            assert (fresh.last_pull["first_sync"]
+                    == (cmp_s.last_pull["path"] == "snapshot"))
+            cmp_s.close()
+            # the snapshot actually reconstructs a usable replica
+            d = LoroDoc(peer=999)
+            d.import_(got)
+            if family == "text":
+                assert (d.get_text("t").to_string()
+                        == srv.oracle_doc(0).get_text("t").to_string())
+        finally:
+            for cli in clis:
+                cli.close()
+            net.close()
+            srv.close()
+
+
+class TestCrashReconnect:
+    def test_sigkilled_client_resumes_without_loss(self, tmp_path):
+        """SIGKILL the pushing client PROCESS (CPU-only — never a
+        process mid-TPU-launch), then resume from its persisted
+        frontier: everything PUSH_ACKed before the kill must still be
+        on the server, and the resumed pull converges byte-for-byte
+        with a replica regenerated from the acked progress log."""
+        import _net_crash_child as crash
+
+        base = _seed_doc(60, 0)
+        srv = SyncServer("text", 1, cid=base.get_text("t").id,
+                         capacity=1 << 12)
+        net = NetServer(srv)
+        proc = None
+        try:
+            boot = srv.connect(sid="boot")
+            boot.push(0, base.export_updates({})).epoch(60)
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join(HERE, "_net_crash_child.py"),
+                 "127.0.0.1", str(net.port), "text", str(tmp_path),
+                 "6", "1234"],
+                env=env, cwd=HERE,
+            )
+            ready = os.path.join(str(tmp_path), "READY")
+            deadline = time.time() + 120
+            while not os.path.exists(ready):
+                assert proc.poll() is None, "crash child died early"
+                assert time.time() < deadline, "crash child never READY"
+                time.sleep(0.05)
+            # the child sleeps after READY; kill it abruptly there
+            # (a CPU-only client process — the sanctioned SIGKILL)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(30)
+
+            progress = open(os.path.join(
+                str(tmp_path), "progress.log")).read().splitlines()
+            acked = [ln.split() for ln in progress if ln.strip()]
+            assert len(acked) == 6, "child did not ack all rounds"
+            # regenerate the child's replica from the deterministic
+            # edit stream it acked
+            d2 = crash.regen_replica(base, int(acked[-1][0]) + 1, 1234)
+            # resume: a fresh client carrying the child's persisted
+            # frontier — the server holds NO session state, the HELLO
+            # frontier IS the resume token
+            fvv = VersionVector.decode(
+                open(os.path.join(str(tmp_path), "frontier.bin"),
+                     "rb").read())
+            cli = NetClient("127.0.0.1", net.port, "text",
+                            client_id="resumed")
+            cli.set_frontier(0, fvv)
+            info = cli.connect()
+            assert info["resumed"] >= 1
+            d2.import_(cli.pull(0))
+            cli.close()
+            # both directions: the server kept every acked op (d2
+            # replays them locally — a loss would leave d2 ahead) and
+            # the resumed client converged to the oracle
+            want = srv.oracle_doc(0).get_text("t").to_string()
+            assert d2.get_text("t").to_string() == want
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+            net.close()
+            srv.close()

@@ -168,7 +168,7 @@ _CORE_KEYS = (
 # notes (the flagship keeps the serving + kernel headline numbers)
 _SIDECAR_KEYS = (
     "metrics", "resilience", "pipeline", "rank", "sync", "shard", "tier",
-    "readplane", "repl", "trace", "net",
+    "readplane", "repl", "trace", "net", "health",
     "gather_rows_per_sec", "hbm_bytes_per_op_model",
     "achieved_hbm_gbps_model", "hbm_frac_model", "rank_ms_measured",
     "place_ms_measured", "gather_rows_per_sec_measured",
@@ -332,6 +332,9 @@ def assemble_record(ck: dict) -> dict:
         "tier_vs_all_hot",
         "tier_hot_path_ratio",
         "tier",
+        "health_tick_ns",
+        "health_skew_ratio",
+        "health",
         "trace",
         "metrics",
         "resilience",
@@ -2651,6 +2654,80 @@ def main() -> None:
             )
         except Exception as e:  # tpulint: disable=LT-EXC(tier extra, never the headline)
             note(f"tier phase failed ({type(e).__name__}: {e})")
+
+    # ---- phase: fleet health plane (BENCH_HEALTH=1, ISSUE 17) ---------
+    # the observability tax, measured: a HealthPlane sampling THIS
+    # process's full registry (every phase above left its counters,
+    # labeled rows and histograms behind) — mean/p50/p99 ns per tick
+    # over ~200 ticks, plus the heat accountant's rebalancer feed
+    # (top-K docs, per-shard skew ratio).  When no serving phase fed
+    # the accountant, a seeded zipfian stand-in load makes the skew
+    # number meaningful.  Count-guarded: the sampled device-launch
+    # counters must not move across the ticks (the sampler never
+    # touches the device).
+    if remaining() > 10 and os.environ.get("BENCH_HEALTH") == "1":
+        try:
+            from loro_tpu.obs import heat as _heat
+            from loro_tpu.obs import metrics as _obsm
+            from loro_tpu.obs.health import HealthPlane as _HealthPlane
+
+            def _launch_total() -> float:
+                out = 0.0
+                for _mm in _obsm.registry().metrics():
+                    if _mm.name in ("fleet.device_launches_total",
+                                    "resilience.launches_total"):
+                        out += sum(r["value"]
+                                   for r in _mm.snapshot()["values"])
+                return out
+
+            _acct = _heat.accountant()
+            if not _acct.report()["docs_top"]:
+                import random as _random
+
+                _hrng = _random.Random(17)
+                for _ in range(512):
+                    _di = min(int(_hrng.paretovariate(1.2)) - 1, 63)
+                    _heat.tick_doc(_di, "push")
+                    _heat.tick_shard(_di % 4, "ingest", of=4)
+            _plane = _HealthPlane(window_s=60.0)
+            _plane.tick()  # warm: first sample builds the flatten dicts
+            _hl0 = _launch_total()
+            _tick_ns = []
+            for _ in range(200):
+                _t0 = time.perf_counter_ns()
+                _plane.tick()
+                _tick_ns.append(time.perf_counter_ns() - _t0)
+            _hlaunches = _launch_total() - _hl0
+            _tick_ns.sort()
+            _hst = _plane.status()
+            _hrep = _hst["heat"]
+            _mean_ns = int(sum(_tick_ns) / len(_tick_ns))
+            bank(
+                "health",
+                health_tick_ns=_mean_ns,
+                health_skew_ratio=_hrep["skew_ratio"],
+                health={
+                    "ticks": _hst["ticks"],
+                    "tick_ns_p50": _tick_ns[len(_tick_ns) // 2],
+                    "tick_ns_p99": _tick_ns[int(len(_tick_ns) * 0.99)],
+                    "verdict": _hst["verdict"],
+                    "open_alerts": len(_hst["alerts"]),
+                    "tracked_docs": _hrep["tracked_docs"],
+                    "n_shards": _hrep["n_shards"],
+                    "skew_ratio": _hrep["skew_ratio"],
+                    "docs_top": _hrep["docs_top"][:4],
+                    "revive_per_s": _hrep["revive_per_s"],
+                    "launches_during_ticks": _hlaunches,
+                },
+            )
+            note(
+                f"health: {_mean_ns / 1e3:.0f}us/tick mean "
+                f"(p99 {_tick_ns[int(len(_tick_ns) * 0.99)] / 1e3:.0f}us "
+                f"over {len(_tick_ns)} ticks), skew {_hrep['skew_ratio']}"
+                f", launches during ticks {_hlaunches:.0f}"
+            )
+        except Exception as e:  # tpulint: disable=LT-EXC(health extra, never the headline)
+            note(f"health phase failed ({type(e).__name__}: {e})")
 
     bank("done", partial=None)
     emit_record(_final_record())

@@ -64,6 +64,14 @@ The order, with the paths that establish each edge:
   (route→dev→…→epoch on every fleet commit).
 - ``supervisor.state`` — DeviceSupervisor counters; a strict leaf
   under every launch (dev→supervisor).
+- ``obs.health``       — heat accountant + health plane state
+  (obs/heat.py, obs/health.py): ``heat.tick_doc/tick_shard`` is called
+  from the serving hot paths while their locks are held
+  (sync.server→…→health, residency.plan→health, sharded.route→health)
+  and the accountant calls nothing while holding it; the health
+  plane's detector/ring mutations share the level and may
+  ``flight.record`` beneath it (health→obs.flight), while attachment
+  ``report()`` calls and registry sampling run with it RELEASED.
 - ``obs.flight``       — the flight-recorder ring (obs/flight.py); the
   innermost level by construction: ``flight.record()`` is called from
   every plane (WAL appends, supervised launches, commit hooks) while
@@ -89,6 +97,7 @@ LEVELS: Dict[str, int] = {
     "fleet.dev": 60,
     "sharded.epoch": 70,
     "supervisor.state": 80,
+    "obs.health": 85,
     "obs.flight": 90,
 }
 

@@ -35,6 +35,12 @@ The invariants (the ISSUE 13 list):
   within float tolerance, no stage negative beyond jitter) — the
   request-tracing plane's own sanity gate (ISSUE 14,
   docs/OBSERVABILITY.md "Request tracing")
+- ``health``        — the stack's health plane stays sane at every
+  barrier: ``status()`` is JSON-serializable, the verdict is a known
+  severity at least as severe as every open alert, skipped sampler
+  ticks never exceed taken ones, and after ``settle()`` healed the
+  fleet the status must not still claim degraded shards
+  (docs/OBSERVABILITY.md "Health & heat")
 """
 from __future__ import annotations
 
@@ -282,6 +288,60 @@ class InvariantChecker:
         self.stack.breakdowns = []
         return out
 
+    def _health(self, step: int) -> List[Violation]:
+        """The health plane's own sanity at a settled barrier: the
+        status payload must serialize, compose severities correctly,
+        keep its tick accounting monotone, and agree with the healed
+        fleet.  Detector alerts themselves are NOT violations — faults
+        legitimately fire them mid-schedule; a status surface that
+        *lies* about them is the failure mode this gates."""
+        import json as _json
+
+        from ..obs import health as health_mod
+
+        out: List[Violation] = []
+        plane = self.stack.health
+        plane.tick()  # the barrier sample (settle took the base one)
+        st = plane.status()
+        try:
+            _json.dumps(st)
+        except (TypeError, ValueError) as e:
+            out.append(Violation(
+                "health", "*",
+                f"status payload is not JSON-serializable: {e}", step))
+            return out
+        if st["verdict"] not in health_mod.SEVERITIES:
+            out.append(Violation(
+                "health", "*",
+                f"unknown verdict {st['verdict']!r}", step))
+            return out
+        rank = health_mod.SEVERITIES.index
+        for a in st["alerts"]:
+            if rank(st["verdict"]) < rank(a["severity"]):
+                out.append(Violation(
+                    "health", "*",
+                    f"verdict {st['verdict']} milder than open "
+                    f"{a['severity']} alert {a['kind']} — the status "
+                    "surface understates a firing detector", step))
+        if st["ticks"] < 1:
+            out.append(Violation(
+                "health", "*",
+                "no sampler tick landed by the barrier "
+                f"(skipped {st['skipped_ticks']})", step))
+        sh = st.get("shards")
+        if sh and sh.get("degraded"):
+            out.append(Violation(
+                "health", "*",
+                f"status claims degraded shards {sh['degraded']} AFTER "
+                "settle healed the fleet — the surface is stale", step))
+        skew = (st.get("heat") or {}).get("skew_ratio")
+        if skew is not None and skew < 1.0 - 1e-6:
+            out.append(Violation(
+                "health", "*",
+                f"impossible skew ratio {skew} < 1.0 (max over uniform "
+                "share cannot be below 1)", step))
+        return out
+
     # -- the barrier ----------------------------------------------------
     def check(self, step: int = -1) -> List[Violation]:
         """One barrier: settle, then run every invariant.  Returns all
@@ -297,6 +357,7 @@ class InvariantChecker:
         out += self._lock_witness(step)
         out += self._obs_sanity(step)
         out += self._attribution(step)
+        out += self._health(step)
         for v in out:
             obs.counter("chaos.violations_total",
                         "invariant violations detected at barriers").inc(

@@ -96,6 +96,7 @@ SAFE_ARMS: Tuple[dict, ...] = (
     {"site": "export_launch", "action": "raise", "times": 1},
     {"site": "export_launch", "action": "raise", "times": 1,
      "msg": "injected fatal export"},
+    {"site": "health_tick", "action": "raise", "times": 1},
 )
 
 #: arms that only make sense when a follower is riding along

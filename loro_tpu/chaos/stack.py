@@ -205,6 +205,14 @@ class ChaosStack:
                 self._recover_plane(p)
             else:
                 self._build_plane(p)
+        # health plane riding the stack (docs/OBSERVABILITY.md "Health &
+        # heat"): ticked at settle + checked by the `health` invariant.
+        # Ticks read the stack, never steer it — the plan stays a pure
+        # function of (config, seed).
+        from ..obs import health as health_mod
+
+        self.health = health_mod.HealthPlane(window_s=300.0)
+        self._refresh_health()
         for i in range(cfg.sessions):
             self.new_client(i % cfg.docs)
 
@@ -252,6 +260,17 @@ class ChaosStack:
                 follower_id=f"chaos-fol-{p.family}", leader=p.resident,
             )
 
+    def _refresh_health(self) -> None:
+        """Point the health plane at the CURRENT topology (the first
+        family's serving pair + every live follower) — called after
+        build/recover/reopen/promote."""
+        p0 = self.planes[self.cfg.families[0]]
+        self.health.attach_resident(p0.resident)
+        self.health.attach_sync(p0.sync)
+        self.health.set_followers(
+            [p.follower for p in self.planes.values()
+             if p.follower is not None])
+
     def _teardown_plane(self, p: FamilyPlane) -> None:
         if p.follower is not None:
             p.follower.close()
@@ -285,6 +304,7 @@ class ChaosStack:
         p = self.planes[family]
         self._teardown_plane(p)
         self._recover_plane(p)
+        self._refresh_health()
         obs.counter("chaos.reopens_total",
                     "in-process close+recover nemesis executions").inc(
             family=family)
@@ -322,6 +342,7 @@ class ChaosStack:
         p.max_acked = 0
         p.fol_gen += 1
         self._front(p)
+        self._refresh_health()
         obs.counter("chaos.promotions_total",
                     "follower promotions executed").inc(family=family)
         self.reset_clients()
@@ -664,6 +685,9 @@ class ChaosStack:
         leftover armed faults (counted), heal degraded shards, bring
         followers to lag 0.  Mutates only toward the steady state the
         degradation contracts promise."""
+        # sample BEFORE quiescing: an armed health_tick fault must hit
+        # a real tick (the skip path), not be cleared unfired below
+        self.health.tick()
         self._quiesce_faults()
         for p in self.planes.values():
             p.sync.flush()

@@ -150,6 +150,19 @@ class NetClient:
         """Fire-and-forget presence relay (no acknowledgement)."""
         self._send(wire.encode_presence(bytes(blob)))
 
+    def status(self, timeout: Optional[float] = None) -> dict:
+        """Admin probe: the server's aggregated health verdict (the
+        ``/status.json`` object plus the server's ``net`` section —
+        docs/OBSERVABILITY.md "Health & heat").  A server with no
+        health plane installed answers ``{"verdict": "unknown", ...}``
+        rather than an error."""
+        import json
+
+        rid = self._next_rid()
+        self._send(wire.encode_status(rid))
+        t, fields = self._expect(wire.STATUS_OK, rid=rid, timeout=timeout)
+        return json.loads(fields["payload"].decode("utf-8"))
+
     def set_frontier(self, di: int, vv: VersionVector) -> None:
         """Install/advance the resume frontier for one doc (merge —
         never regresses)."""

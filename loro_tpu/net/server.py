@@ -118,8 +118,9 @@ class NetServer:
                  max_connections: Optional[int] = None,
                  idle_timeout: Optional[float] = None,
                  leader_addr: Optional[str] = None,
-                 clock=None):
+                 clock=None, health=None):
         self._sync = sync
+        self._health = health  # explicit plane beats health.active()
         self.host = host
         self.max_frame = netcfg.resolve_max_frame(max_frame)
         self._backlog = netcfg.resolve_backlog(backlog)
@@ -360,6 +361,10 @@ class NetServer:
                 await self._loop.run_in_executor(
                     self._pool, conn.session.broadcast_presence,
                     fields["blob"])
+            elif t == wire.STATUS:
+                body = await self._loop.run_in_executor(
+                    self._pool, self._status_payload)
+                self._enqueue(conn, wire.encode_status_ok(rid, body))
             elif t == wire.HELLO:
                 raise NetProtocolError("HELLO after the handshake")
             else:
@@ -660,6 +665,21 @@ class NetServer:
                 "max_frame": self.max_frame,
                 "max_connections": self.max_connections,
             }
+
+    def _status_payload(self) -> bytes:
+        """JSON bytes for a STATUS_OK frame: the aggregated health
+        verdict (explicit ``health=`` plane, else the process-installed
+        one, else the typed "unknown" stub) with THIS server's ``net``
+        section merged in — the same object ``/status.json`` serves."""
+        import json
+
+        from ..obs import health as _health
+
+        plane = self._health if self._health is not None else _health.active()
+        payload = (plane.status() if plane is not None
+                   else _health.status_payload())
+        payload["net"] = self.report()
+        return json.dumps(payload).encode()
 
     async def _shutdown(self) -> None:
         self._server.close()

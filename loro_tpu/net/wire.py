@@ -45,6 +45,11 @@ Message catalogue (client → server unless noted):
 - ``ERROR``     (server) request id (0 = connection-level) + typed
                 code + message + leader address (NOT_LEADER redirect)
 - ``BYE``       graceful close (either side)
+- ``STATUS``    request id — admin probe for the aggregated health
+                verdict (docs/OBSERVABILITY.md "Health & heat")
+- ``STATUS_OK`` (server) request id + JSON status payload blob (the
+                same object ``/status.json`` serves, plus the server's
+                own ``net`` section)
 """
 from __future__ import annotations
 
@@ -72,11 +77,14 @@ EVENT = 0x08
 PRESENCE = 0x09
 ERROR = 0x0A
 BYE = 0x0B
+STATUS = 0x0C
+STATUS_OK = 0x0D
 
 TYPE_NAMES = {
     HELLO: "HELLO", HELLO_OK: "HELLO_OK", PUSH: "PUSH",
     PUSH_ACK: "PUSH_ACK", PULL: "PULL", DELTA: "DELTA", POLL: "POLL",
     EVENT: "EVENT", PRESENCE: "PRESENCE", ERROR: "ERROR", BYE: "BYE",
+    STATUS: "STATUS", STATUS_OK: "STATUS_OK",
 }
 
 # typed error codes carried by ERROR frames; the client re-raises the
@@ -311,6 +319,21 @@ def encode_bye() -> bytes:
     return bytes([BYE])
 
 
+def encode_status(rid: int) -> bytes:
+    out = bytearray()
+    out.append(STATUS)
+    _uvarint(out, rid)
+    return bytes(out)
+
+
+def encode_status_ok(rid: int, payload: bytes) -> bytes:
+    out = bytearray()
+    out.append(STATUS_OK)
+    _uvarint(out, rid)
+    _put_bytes(out, bytes(payload))
+    return bytes(out)
+
+
 # -- decoder -----------------------------------------------------------
 def decode(body: bytes) -> Tuple[int, dict]:
     """``(msg_type, fields)`` for one crc-checked body.  Unknown types
@@ -401,6 +424,11 @@ def decode(body: bytes) -> Tuple[int, dict]:
                    "leader": _read_str(body, pos) or None}
     if t == BYE:
         return t, {}
+    if t == STATUS:
+        return t, {"rid": _read_uvarint(body, pos)}
+    if t == STATUS_OK:
+        return t, {"rid": _read_uvarint(body, pos),
+                   "payload": _read_bytes(body, pos)}
     raise NetProtocolError(f"unknown net message type 0x{t:02x}")
 
 

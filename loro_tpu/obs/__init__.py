@@ -1,8 +1,10 @@
 """loro_tpu.obs: metrics + profiling for the fleet merge path.
 
 Always-on process-wide registry (metrics.py), Prometheus/JSON/sidecar
-exposition (exposition.py), and a one-screen report (report.py; also
-``python -m loro_tpu.obs.report``).  See docs/OBSERVABILITY.md for the
+exposition (exposition.py), a one-screen report (report.py; also
+``python -m loro_tpu.obs.report``), EWMA heat accounting (heat.py),
+the windowed health plane (health.py, lazily imported; rendered by
+``python -m loro_tpu.obs.top``).  See docs/OBSERVABILITY.md for the
 metric catalogue and how the pieces fit the tracing subsystem.
 
 Quick use::
@@ -16,6 +18,7 @@ Quick use::
 from __future__ import annotations
 
 from . import flight
+from . import heat
 from .exposition import prometheus_text, serve, sidecar, snapshot_json
 from .metrics import (
     Registry,
@@ -45,7 +48,13 @@ __all__ = [
     "disable_span_metrics",
     "measure_tunnel_rtt",
     "flight",
+    "heat",
 ]
+
+# NOTE: loro_tpu.obs.health is imported lazily (`from loro_tpu.obs
+# import health`) — it registers the `health_tick` fault site, and
+# pulling resilience.faultinject into every bare `import loro_tpu.obs`
+# would be needless weight on the metrics hot path.
 
 # -- tracing bridge ----------------------------------------------------
 # One instrumentation point, two sinks: a tracing.span() on a hot path

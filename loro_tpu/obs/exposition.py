@@ -12,8 +12,9 @@ snapshot, the bench sidecar object, and an optional scrape server.
   name = cross-label total, ``name{k=v}`` per label set), histograms
   as ``{count, sum, mean, p50, p99}`` summaries.
 - ``serve(port)`` — a daemon-thread HTTP server exposing ``/metrics``
-  (Prometheus) and ``/metrics.json`` for live scrapes of a long-lived
-  fleet server process.
+  (Prometheus), ``/metrics.json`` and ``/status.json`` (the active
+  health plane's aggregated verdict — docs/OBSERVABILITY.md "Health &
+  heat") for live scrapes of a long-lived fleet server process.
 """
 from __future__ import annotations
 
@@ -115,14 +116,20 @@ def serve(port: int = 9464, addr: str = "127.0.0.1",
           registry: Optional[_m.Registry] = None):
     """Start a daemon-thread scrape endpoint; returns the HTTPServer
     (``.shutdown()`` to stop).  ``GET /metrics`` -> Prometheus text,
-    ``GET /metrics.json`` -> JSON snapshot."""
+    ``GET /metrics.json`` -> JSON snapshot, ``GET /status.json`` ->
+    the active health plane's verdict (``health.status_payload()``)."""
     from http.server import BaseHTTPRequestHandler, HTTPServer
 
     reg = registry or _m.registry()
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib naming)
-            if self.path.startswith("/metrics.json"):
+            if self.path.startswith("/status.json"):
+                from . import health as _health
+
+                body = json.dumps(_health.status_payload()).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/metrics.json"):
                 body = snapshot_json(reg).encode()
                 ctype = "application/json"
             elif self.path.startswith("/metrics"):

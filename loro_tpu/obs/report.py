@@ -48,9 +48,34 @@ def _labeled_rows(snap_entry: dict):
     return [r for r in snap_entry["values"] if r["labels"]]
 
 
+def _windowed_rates_lines() -> list:
+    """The "windowed rates" section (live render only): the active
+    health plane's per-second rates + open alerts.  Empty when no
+    plane is installed or it has too few samples."""
+    from . import health as _health
+
+    plane = _health.active()
+    if plane is None:
+        return []
+    rates = plane.rates_report()
+    alerts = plane.alerts()
+    if not rates and not alerts:
+        return []
+    lines = ["[windowed rates]  (health plane, last "
+             f"{plane.window_s:g}s window)"]
+    for name in sorted(rates):
+        lines.append(f"  {name:<44} {rates[name]:>10,.2f}/s")
+    for a in alerts:
+        lines.append(
+            f"  ALERT {a['kind']} ({a['severity']}): {a['detail']}")
+    return lines
+
+
 def render(snapshot: Optional[dict] = None) -> str:
     """Format a snapshot (default: the live default registry) as a
-    one-screen text report."""
+    one-screen text report.  The live render appends a "windowed
+    rates" section when a health plane is active."""
+    live = snapshot is None
     snap = snapshot if snapshot is not None else _m.snapshot()
     lines = []
     bar = "=" * _WIDTH
@@ -126,6 +151,11 @@ def render(snapshot: Optional[dict] = None) -> str:
                         f"    {{{lbl}}}".ljust(46)
                         + f"{_fmt_num(row['value']):>12}"
                     )
+    if live:
+        rl = _windowed_rates_lines()
+        if rl:
+            lines.append("-" * _WIDTH)
+            lines.extend(rl)
     lines.append(bar)
     return "\n".join(lines)
 

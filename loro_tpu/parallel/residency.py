@@ -66,6 +66,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..analysis.lockwitness import named_rlock
 from ..errors import LoroError, ResidencyError
+from ..obs import heat as heat_acct
 from ..obs import metrics as obs
 from ..resilience import faultinject
 from .server import _FAMILIES, ResidentServer
@@ -373,6 +374,7 @@ class TieredBatch:
             for di in touched:
                 mgr.last_touch_t[di] = now
                 mgr.touch_count[di] += 1
+                heat_acct.tick_doc(di, "touch")
 
     # -- promotion / revive ---------------------------------------------
     def _ensure_hot(self, di: int, cid) -> None:
@@ -387,6 +389,7 @@ class TieredBatch:
         obs.counter(
             "residency.touch_total", "ingest touches by tier outcome"
         ).inc(family=self.family, outcome="miss")
+        heat_acct.tick_revive()
         was_cold = di in mgr.cold
         t0 = mgr.clock()
         try:

@@ -60,6 +60,7 @@ from typing import List, Optional, Sequence
 
 from ..analysis.lockwitness import named_lock, named_rlock
 from ..errors import ConfigError, LoroError, PersistError, ShardingError
+from ..obs import heat as heat_acct
 from ..obs import metrics as obs
 from .mesh import make_mesh, shard_meshes
 from .pipeline import PendingRound
@@ -413,13 +414,17 @@ class ShardedResidentServer:
             parts[s][l] = u
         return parts
 
-    def _tick_shard_rounds(self, parts: List[list]) -> None:
+    def _tick_shard_rounds(self, parts: List[list],
+                           launches: bool = False) -> None:
         for s, part in enumerate(parts):
             if any(u is not None for u in part):
                 obs.counter(
                     "shard.rounds_total",
                     "ingest rounds carrying payloads for the shard",
                 ).inc(family=self.family, shard=str(s))
+                heat_acct.tick_shard(s, "ingest", of=self.n_shards)
+                if launches:
+                    heat_acct.tick_shard(s, "launch", of=self.n_shards)
 
     def _globals_of(self, shard: int, locals_: Sequence[int]) -> List[int]:
         back = {
@@ -438,7 +443,7 @@ class ShardedResidentServer:
             if cid is not None:
                 self._cid = cid
             parts = self._split(list(per_doc_updates))
-            self._tick_shard_rounds(parts)
+            self._tick_shard_rounds(parts, launches=True)
             eps = []
             poison: List[int] = []
             for s, srv in enumerate(self.shards):
@@ -466,6 +471,13 @@ class ShardedResidentServer:
                 parts = self._split(r)
                 self._tick_shard_rounds(parts)
                 split_rounds.append(parts)
+            # one device launch per shard per coalesced GROUP
+            for s in range(self.n_shards):
+                if any(
+                    any(u is not None for u in split_rounds[j][s])
+                    for j in range(len(rounds))
+                ):
+                    heat_acct.tick_shard(s, "launch", of=self.n_shards)
             self.last_poison_docs = []
             per_shard = []
             for s, srv in enumerate(self.shards):
@@ -490,9 +502,12 @@ class ShardedResidentServer:
             for s, e in enumerate(eps):
                 self._emaps[s].note(g, e)
         self._notify_epoch(g)
+        degraded = self.degraded_shards()
         obs.gauge(
             "shard.degraded_shards", "shards degraded to their host mirror"
-        ).set(len(self.degraded_shards()), family=self.family)
+        ).set(len(degraded), family=self.family)
+        for s in degraded:
+            heat_acct.tick_shard(s, "degradation", of=self.n_shards)
         return g
 
     # -- epoch-commit subscription (sync fan-out) ----------------------

@@ -136,6 +136,7 @@ _SITE_MODULES = (
     "loro_tpu.sync.readbatch",
     "loro_tpu.replication.shipper",
     "loro_tpu.replication.follower",
+    "loro_tpu.obs.health",
     "loro_tpu.net.server",
 )
 

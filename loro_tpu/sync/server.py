@@ -46,6 +46,7 @@ from typing import Dict, List, Optional
 from ..errors import DecodeError, PushRejected, StaleFrontier, SyncError
 from ..analysis.lockwitness import named_rlock
 from ..obs import flight
+from ..obs import heat as heat_acct
 from ..obs import metrics as obs
 from ..resilience import faultinject
 from ..utils import tracing
@@ -498,6 +499,11 @@ class SyncServer:
             flight.record("sync.commit", family=self.family,
                           epoch=epochs[-1], rounds=len(rounds),
                           pushes=len(resolved))
+        # per-doc push heat (docs/OBSERVABILITY.md "Health & heat"):
+        # one tick per resolved push, fed to the rebalancer accountant
+        for m in metas:
+            for di in m:
+                heat_acct.tick_doc(di, "push")
         self._fan_out_deltas(dirty)
         self.expire_sessions()
 

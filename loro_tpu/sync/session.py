@@ -35,6 +35,7 @@ from typing import Dict, Optional
 from ..core.version import VersionVector
 from ..errors import SessionClosed
 from ..obs import flight
+from ..obs import heat as heat_acct
 from ..obs import metrics as obs
 from ..resilience import faultinject
 from ..utils import tracing
@@ -189,6 +190,7 @@ class Session:
                 if self._dirty.get(di, -1) <= epoch:
                     self._dirty.pop(di, None)
                 srv._ack_at(self, di, epoch)
+            heat_acct.tick_doc(di, "pull")
             obs.counter("sync.pulls_total").inc(family=srv.family, kind="delta")
             obs.counter(
                 "sync.pulls_batched_total",
@@ -226,6 +228,7 @@ class Session:
         flight.record("sync.pull", family=srv.family, doc=di,
                       trace=trace_id, path=self.last_pull["path"],
                       bytes=len(data))
+        heat_acct.tick_doc(di, "pull")
         obs.counter("sync.pulls_total").inc(
             family=srv.family, kind="snapshot" if first_sync else "delta"
         )

@@ -151,6 +151,16 @@ def _fat_checkpoint():
               "rows_per_round": 96, "skew": "85/15 over 4-doc core",
               "rows_per_sec_all_hot": 940_000,
               "rows_per_sec_tiered": 850_000, "note": "t" * 300},
+        health_tick_ns=188_000,
+        health_skew_ratio=2.59,
+        health={"ticks": 201, "tick_ns_p50": 180_000,
+                "tick_ns_p99": 420_000, "verdict": "ok",
+                "open_alerts": 0, "tracked_docs": 24, "n_shards": 4,
+                "skew_ratio": 2.59,
+                "docs_top": [{"doc": 0, "heat": 309.7, "per_s": 7.2,
+                              "push": 309.7, "pull": 0.0, "touch": 0.0}],
+                "revive_per_s": 0.0, "launches_during_ticks": 0,
+                "note": "e" * 300},
         net_connections=64,
         net_pushes_per_sec=310.5,
         net_push_to_visible_ms_p50=18.3,
@@ -220,6 +230,7 @@ class TestFlagshipLine:
                   "tier_hit_rate", "tier_revive_ms_p50",
                   "tier_revive_ms_p99", "tier_vs_all_hot",
                   "tier_hot_path_ratio",
+                  "health_tick_ns", "health_skew_ratio",
                   "repl_readers", "repl_pulls_per_sec",
                   "repl_pulls_per_sec_leader_only", "repl_read_scaling_x",
                   "repl_lag_ms_p50", "repl_lag_ms_p99",
@@ -231,7 +242,8 @@ class TestFlagshipLine:
         # verbose prose + dict sidecars moved to the secondary line
         assert side is not None
         for k in ("metrics", "resilience", "pipeline", "rank", "sync",
-                  "shard", "tier", "readplane", "repl", "trace", "net",
+                  "shard", "tier", "health", "readplane", "repl",
+                  "trace", "net",
                   "baseline_note", "roofline_note",
                   "resident_pipeline_note"):
             assert k in side, k

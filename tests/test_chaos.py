@@ -66,6 +66,7 @@ ALL_SITES = {
     "evict_flush", "revive_replay",
     "repl_ship", "repl_apply", "repl_promote",
     "net_accept", "net_frame", "conn_stall",
+    "health_tick",
 }
 
 DOC_FILES = [

@@ -1,0 +1,799 @@
+"""Fleet health plane: heat accounting, windowed rates, detectors,
+the status surface and its fault/lock contracts
+(docs/OBSERVABILITY.md "Health & heat").
+
+Fake clocks drive every windowed assertion deterministically (LT-TIME:
+the plane takes ``clock=``); detector tests run against ISOLATED
+registries so parallel test pollution cannot flip a predicate.  The
+live acceptance test at the bottom rides a real composed
+sharded+tiered+durable+replicated stack (chaos.ChaosStack) and gates
+the ISSUE's end-to-end claims: verdict ``ok`` at rest, zipfian skew
+ratio > 1, alerts that fire under injected faults and clear after.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+import pytest
+
+from loro_tpu.analysis.lockwitness import named_rlock, witness
+from loro_tpu.obs import health as health_mod
+from loro_tpu.obs import heat as heat_mod
+from loro_tpu.obs import metrics as _m
+from loro_tpu.obs.health import HealthPlane
+from loro_tpu.obs.heat import HeatAccountant
+from loro_tpu.resilience import faultinject
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _mk_plane(clk, reg, **kw):
+    """Isolated plane: own registry AND own heat accountant (the
+    process-global one is fed by every other test's serving calls)."""
+    kw.setdefault("heat", HeatAccountant(clock=clk))
+    return HealthPlane(clock=clk, registry=reg, **kw)
+
+
+def _ctr_total(name: str) -> float:
+    """Sum over all label rows of a default-registry counter."""
+    for m in _m.registry().metrics():
+        if m.name == name:
+            return sum(r["value"] for r in m.snapshot()["values"])
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# heat accounting
+# ---------------------------------------------------------------------------
+
+
+class TestHeatAccountant:
+    def test_ewma_decay_halves_per_half_life(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk, half_life_s=10.0)
+        acc.tick_doc(0, "push", 8.0)
+        assert acc.doc_heat(0) == pytest.approx(8.0)
+        clk.advance(10.0)
+        assert acc.doc_heat(0) == pytest.approx(4.0)
+        clk.advance(20.0)
+        assert acc.doc_heat(0) == pytest.approx(1.0)
+
+    def test_top_k_ranks_by_total_heat(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk, top_k=3)
+        for di, n in ((0, 1), (1, 9), (2, 4), (3, 2)):
+            acc.tick_doc(di, "push", float(n))
+        acc.tick_doc(1, "pull", 2.0)
+        top = acc.report()["docs_top"]
+        assert [r["doc"] for r in top] == [1, 2, 3]
+        assert top[0]["push"] == pytest.approx(9.0)
+        assert top[0]["pull"] == pytest.approx(2.0)
+        assert top[0]["heat"] == pytest.approx(11.0)
+
+    def test_per_s_rate_matches_ewma_math(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk, half_life_s=30.0)
+        acc.tick_doc(7, "push", 30.0)
+        r = acc.report()["docs_top"][0]
+        # heat * ln2 / half_life
+        assert r["per_s"] == pytest.approx(30.0 * 0.6931 / 30.0, rel=1e-3)
+
+    def test_skew_ratio_none_until_shard_events_then_ratio(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk)
+        assert acc.skew_ratio() is None
+        acc.tick_shard(0, "ingest", 6.0, of=4)
+        # one hot shard of four: 6 / (6/4) = 4
+        assert acc.skew_ratio() == pytest.approx(4.0)
+        for s in (1, 2, 3):
+            acc.tick_shard(s, "ingest", 6.0, of=4)
+        assert acc.skew_ratio() == pytest.approx(1.0)
+
+    def test_zipfian_load_skews_above_one(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk)
+        for i, weight in enumerate((32, 16, 8, 4)):  # zipf-ish
+            acc.tick_shard(i, "ingest", float(weight), of=4)
+        rep = acc.report()
+        assert rep["skew_ratio"] > 1.0
+        assert rep["skew_ratio"] == pytest.approx(32 / (60 / 4), rel=1e-3)
+
+    def test_prune_keeps_hottest_half(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk, max_docs=8)
+        for di in range(8):
+            acc.tick_doc(di, "push", float(di + 1))
+        acc.tick_doc(99, "push", 50.0)  # 9th doc trips the prune
+        rep = acc.report()
+        assert rep["tracked_docs"] <= 5  # kept 8//2 plus the newcomer
+        assert acc.doc_heat(99) == pytest.approx(50.0)
+        assert acc.doc_heat(7) > 0.0     # hottest survivor
+        assert acc.doc_heat(0) == 0.0    # coldest was dropped
+
+    def test_revive_pressure_decays(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk, half_life_s=10.0)
+        for _ in range(4):
+            acc.tick_revive()
+        assert acc.report()["revive_heat"] == pytest.approx(4.0)
+        clk.advance(10.0)
+        assert acc.report()["revive_heat"] == pytest.approx(2.0)
+
+    def test_report_is_json_able(self):
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk)
+        acc.tick_doc(0, "push")
+        acc.tick_shard(0, "ingest", of=2)
+        acc.tick_revive()
+        json.dumps(acc.report())  # must not raise
+
+    def test_disabled_module_path_allocates_nothing(self):
+        """The ISSUE's count guard: with heat disabled, the module-level
+        hot-path call is one attribute check — zero allocations."""
+        was = heat_mod.accountant().on
+        heat_mod.disable()
+        try:
+            heat_mod.tick_doc(5, "push")  # warm any call-site caches
+            heat_mod.tick_shard(1, "ingest")
+            heat_mod.tick_revive()
+            best = None
+            for _ in range(3):
+                before = sys.getallocatedblocks()
+                for _ in range(100):
+                    heat_mod.tick_doc(5, "push")
+                    heat_mod.tick_shard(1, "ingest")
+                    heat_mod.tick_revive()
+                delta = sys.getallocatedblocks() - before
+                best = delta if best is None else min(best, delta)
+            assert best == 0
+        finally:
+            if was:
+                heat_mod.enable()
+
+    def test_bad_half_life_raises(self):
+        with pytest.raises(ValueError):
+            HeatAccountant(half_life_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# windowed rates
+# ---------------------------------------------------------------------------
+
+
+class TestWindowedRates:
+    def test_rate_and_delta_difference_ring_samples(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        reg.counter("x.ops_total").inc(5)
+        plane.tick()
+        assert plane.rate("x.ops_total") is None  # one sample: no window
+        reg.counter("x.ops_total").inc(30)
+        clk.advance(10.0)
+        plane.tick()
+        assert plane.delta("x.ops_total") == pytest.approx(30.0)
+        assert plane.rate("x.ops_total") == pytest.approx(3.0)
+
+    def test_labeled_series_flatten_with_outcome_rollup(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        plane.tick()
+        c = reg.counter("y.ops_total")
+        c.inc(2, family="map", outcome="hit")
+        c.inc(3, family="text", outcome="hit")
+        clk.advance(5.0)
+        plane.tick()
+        assert plane.delta(
+            "y.ops_total{family=map,outcome=hit}") == pytest.approx(2.0)
+        # the cross-family rollup the detectors difference
+        assert plane.delta("y.ops_total{outcome=hit}") == pytest.approx(5.0)
+        assert plane.delta("y.ops_total") == pytest.approx(5.0)
+
+    def test_window_bounds_which_samples_difference(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg, window_s=30.0)
+        c = reg.counter("z.ops_total")
+        plane.tick()                 # t=1000, total 0
+        c.inc(10)
+        clk.advance(70.0)
+        plane.tick()                 # t=1070, total 10
+        c.inc(7)
+        clk.advance(40.0)
+        plane.tick()                 # t=1110, total 17
+        # the 30s window's base is the latest sample at/before the
+        # cutoff (t=1080) -> t=1070, so only the last bump counts
+        assert plane.delta("z.ops_total") == pytest.approx(7.0)
+        # an explicit wide window reaches back to the first
+        assert plane.delta("z.ops_total", window=500.0) == pytest.approx(17.0)
+
+    def test_window_quantile_differences_bucket_counts(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        for _ in range(100):
+            h.observe(0.005)         # old traffic: fast
+        plane.tick()
+        for _ in range(10):
+            h.observe(0.5)           # the window's traffic: slow
+        clk.advance(5.0)
+        plane.tick()
+        assert plane.window_count("lat_seconds") == 10
+        # lifetime p50 is fast; the WINDOW's p50 is the slow bucket
+        assert plane.window_quantile("lat_seconds", 0.5) > 0.1
+
+    def test_rates_report_lists_only_moving_totals(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        reg.counter("a.ops_total").inc(1)
+        reg.counter("b.ops_total")           # never moves
+        reg.gauge("c.depth").set(9)          # not a _total
+        plane.tick()
+        reg.counter("a.ops_total").inc(20)
+        clk.advance(10.0)
+        plane.tick()
+        rr = plane.rates_report()
+        assert rr == {"a.ops_total": pytest.approx(2.0)}
+
+    def test_bad_window_raises(self):
+        with pytest.raises(ValueError):
+            HealthPlane(window_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# detectors: fire + clear + hysteresis (fake clocks, isolated registries)
+# ---------------------------------------------------------------------------
+
+
+class TestDetectors:
+    def _tick_n(self, plane, clk, n, dt=1.0):
+        fired = []
+        for _ in range(n):
+            clk.advance(dt)
+            fired += plane.tick()
+        return fired
+
+    def test_shard_saturation_fires_and_clears(self):
+        clk, reg = FakeClock(), _m.Registry()
+        acc = HeatAccountant(clock=clk)
+        plane = _mk_plane(clk, reg, heat=acc, shard_skew_max=2.0,
+                          shard_min_ingest_heat=1.0)
+        acc.tick_shard(0, "ingest", 8.0, of=4)   # skew 4x
+        fired = self._tick_n(plane, clk, 1)
+        assert fired == []                       # fire_after=2: not yet
+        fired = self._tick_n(plane, clk, 1)
+        assert fired == ["shard_saturation"]
+        alerts = plane.alerts()
+        assert alerts[0]["kind"] == "shard_saturation"
+        assert alerts[0]["severity"] == "degraded"
+        assert plane.status()["verdict"] == "degraded"
+        # balance the load -> clean ticks clear it
+        for s in (1, 2, 3):
+            acc.tick_shard(s, "ingest", 8.0, of=4)
+        self._tick_n(plane, clk, 2)
+        assert plane.alerts() == []
+        assert plane.status()["verdict"] == "ok"
+
+    def test_tier_hit_collapse_fires_on_windowed_miss_storm(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg, tier_hit_min=0.5, tier_min_touches=8)
+        touch = reg.counter("residency.touch_total")
+        plane.tick()
+        touch.inc(10, family="map", outcome="miss")
+        fired = self._tick_n(plane, clk, 2)
+        assert fired == ["tier_hit_collapse"]
+        # the storm ages out of the window -> too few touches -> clears
+        clk.advance(plane.window_s + 1.0)
+        self._tick_n(plane, clk, 2)
+        assert plane.alerts() == []
+
+    def test_tier_hit_rate_above_floor_stays_clean(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg, tier_hit_min=0.5, tier_min_touches=8)
+        touch = reg.counter("residency.touch_total")
+        plane.tick()
+        touch.inc(9, family="map", outcome="hit")
+        touch.inc(3, family="map", outcome="miss")
+        assert self._tick_n(plane, clk, 3) == []
+
+    def test_repl_lag_fires_while_not_shrinking_and_clears(self):
+        class Fol:
+            follower_id = "fol-a"
+            applied_epoch = 4
+            lag_epochs = 0
+
+        clk, reg = FakeClock(), _m.Registry()
+        fol = Fol()
+        plane = _mk_plane(clk, reg, repl_lag_epochs_max=2)
+        plane.attach_follower(fol)
+        self._tick_n(plane, clk, 1)              # baseline: lag 0
+        fol.lag_epochs = 3
+        fired = self._tick_n(plane, clk, 2)
+        assert fired == ["repl_lag"]
+        assert plane.alerts()[0]["severity"] == "critical"
+        assert plane.status()["verdict"] == "critical"
+        fol.lag_epochs = 0                       # caught up
+        self._tick_n(plane, clk, 2)
+        assert plane.alerts() == []
+
+    def test_repl_lag_shrinking_does_not_fire(self):
+        class Fol:
+            lag_epochs = 9
+
+        clk, reg = FakeClock(), _m.Registry()
+        fol = Fol()
+        plane = _mk_plane(clk, reg, repl_lag_epochs_max=2)
+        plane.attach_follower(fol)
+        self._tick_n(plane, clk, 1)
+        for lag in (7, 5, 3):                    # draining: above max but
+            fol.lag_epochs = lag                 # strictly shrinking
+            assert self._tick_n(plane, clk, 1) == []
+
+    def test_p2v_slo_fires_on_windowed_p99_and_clears(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg, p2v_slo_ms=50.0, p2v_min_samples=4)
+        h = reg.histogram("sync.push_to_visible_seconds",
+                          buckets=(0.01, 0.1, 1.0))
+        plane.tick()
+        for _ in range(8):
+            h.observe(0.5)                       # 500ms >> 50ms SLO
+        fired = self._tick_n(plane, clk, 2)
+        assert fired == ["p2v_slo"]
+        assert "p99" in plane.alerts()[0]["detail"]
+        clk.advance(plane.window_s + 1.0)        # pushes age out
+        self._tick_n(plane, clk, 2)
+        assert plane.alerts() == []
+
+    def test_p2v_below_min_samples_never_fires(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg, p2v_slo_ms=1.0, p2v_min_samples=4)
+        h = reg.histogram("sync.push_to_visible_seconds",
+                          buckets=(0.01, 0.1, 1.0))
+        plane.tick()
+        h.observe(5.0)                           # terrible, but n=1
+        assert self._tick_n(plane, clk, 3) == []
+
+    def test_degradation_spike_fires_on_burst_and_clears(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg, degradation_burst=3)
+        c = reg.counter("resilience.degradations_total")
+        plane.tick()
+        c.inc(3, family="map")
+        fired = self._tick_n(plane, clk, 2)
+        assert fired == ["degradation_spike"]
+        clk.advance(plane.window_s + 1.0)
+        self._tick_n(plane, clk, 2)
+        assert plane.alerts() == []
+
+    def test_hysteresis_fire_after_and_clear_after(self):
+        clk, reg = FakeClock(), _m.Registry()
+        acc = HeatAccountant(clock=clk)
+        plane = _mk_plane(clk, reg, heat=acc, shard_skew_max=2.0,
+                          shard_min_ingest_heat=1.0,
+                          fire_after=3, clear_after=3)
+        acc.tick_shard(0, "ingest", 8.0, of=4)
+        assert self._tick_n(plane, clk, 2) == []     # 2 breaches < 3
+        assert self._tick_n(plane, clk, 1) == ["shard_saturation"]
+        for s in (1, 2, 3):
+            acc.tick_shard(s, "ingest", 8.0, of=4)   # balanced now
+        self._tick_n(plane, clk, 2)
+        assert plane.alerts() != []                  # 2 clean < 3
+        self._tick_n(plane, clk, 1)
+        assert plane.alerts() == []
+
+    def test_alert_counters_land_in_default_registry(self):
+        clk, reg = FakeClock(), _m.Registry()
+        acc = HeatAccountant(clock=clk)
+        plane = _mk_plane(clk, reg, heat=acc, shard_skew_max=2.0,
+                          shard_min_ingest_heat=1.0)
+        before = _m.counter("health.alerts_total").get(
+            kind="shard_saturation")
+        acc.tick_shard(0, "ingest", 8.0, of=4)
+        self._tick_n(plane, clk, 2)
+        assert _m.counter("health.alerts_total").get(
+            kind="shard_saturation") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the health_tick fault site: blast radius = one skipped window
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faultinject
+class TestHealthTickFaultSite:
+    def test_site_is_registered(self):
+        assert "health_tick" in faultinject.sites()
+
+    def test_raise_skips_one_window_only(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        plane.tick()
+        skipped_before = _m.counter("health.ticks_skipped_total").get(
+            error="InjectedFault")
+        faultinject.inject("health_tick", times=1)
+        try:
+            clk.advance(1.0)
+            assert plane.tick() == []            # never raises to caller
+        finally:
+            faultinject.clear("health_tick")
+        st = plane.status()
+        assert st["ticks"] == 1                  # the window was skipped
+        assert st["skipped_ticks"] == 1
+        assert _m.counter("health.ticks_skipped_total").get(
+            error="InjectedFault") == skipped_before + 1
+        # the NEXT tick samples normally: blast radius was one window
+        clk.advance(1.0)
+        plane.tick()
+        assert plane.status()["ticks"] == 2
+
+    def test_delay_action_does_not_skip(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        faultinject.inject("health_tick", action="delay", delay_s=0.001,
+                           times=1)
+        try:
+            plane.tick()
+        finally:
+            faultinject.clear("health_tick")
+        assert plane.status()["ticks"] == 1
+        assert plane.status()["skipped_ticks"] == 0
+
+    def test_skip_leaves_detector_state_intact(self):
+        clk, reg = FakeClock(), _m.Registry()
+        acc = HeatAccountant(clock=clk)
+        plane = _mk_plane(clk, reg, heat=acc, shard_skew_max=2.0,
+                          shard_min_ingest_heat=1.0)
+        acc.tick_shard(0, "ingest", 8.0, of=4)
+        clk.advance(1.0)
+        plane.tick()                             # breach streak 1
+        faultinject.inject("health_tick", times=1)
+        try:
+            clk.advance(1.0)
+            plane.tick()                         # skipped: no evaluation
+        finally:
+            faultinject.clear("health_tick")
+        assert plane.alerts() == []              # streak did not advance
+        clk.advance(1.0)
+        assert plane.tick() == ["shard_saturation"]
+
+
+# ---------------------------------------------------------------------------
+# sampler overhead: no device traffic, tiny cost
+# ---------------------------------------------------------------------------
+
+
+class TestSamplerOverhead:
+    def test_ticks_launch_nothing_on_device(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        before = (_ctr_total("fleet.device_launches_total"),
+                  _ctr_total("resilience.launches_total"))
+        for _ in range(20):
+            clk.advance(1.0)
+            plane.tick()
+            plane.status()
+        after = (_ctr_total("fleet.device_launches_total"),
+                 _ctr_total("resilience.launches_total"))
+        assert after == before
+
+    def test_ring_is_bounded(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg, capacity=8)
+        for _ in range(50):
+            clk.advance(1.0)
+            plane.tick()
+        assert len(plane._ring) == 8
+        assert plane.status()["ticks"] == 50
+
+
+# ---------------------------------------------------------------------------
+# the status surface + module-level install
+# ---------------------------------------------------------------------------
+
+
+class TestStatusSurface:
+    def test_status_payload_without_plane_is_unknown(self):
+        prev = health_mod.install(None)
+        try:
+            st = health_mod.status_payload()
+            assert st["verdict"] == "unknown"
+            assert st["alerts"] == []
+        finally:
+            health_mod.install(prev)
+
+    def test_install_returns_previous_and_active_tracks(self):
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        prev = health_mod.install(plane)
+        try:
+            assert health_mod.active() is plane
+            plane.tick()
+            assert health_mod.status_payload()["verdict"] == "ok"
+        finally:
+            assert health_mod.install(prev) is plane
+
+    def test_status_is_json_able_and_carries_sections(self):
+        class Fol:
+            follower_id = "f0"
+            applied_epoch = 7
+            lag_epochs = 1
+
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        plane.attach_follower(Fol())
+        plane.tick()
+        st = plane.status()
+        json.dumps(st)
+        assert st["verdict"] == "ok"
+        assert st["repl"]["followers"][0]["lag_epochs"] == 1
+        assert "rates" in st and "heat" in st
+
+    def test_degraded_flat_resident_forces_critical(self):
+        class Res:
+            degraded = True
+
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        plane.attach_resident(Res())
+        plane.tick()
+        st = plane.status()
+        assert st["verdict"] == "critical"
+        assert any("degraded" in r for r in st["reasons"])
+
+    def test_broken_attachment_report_is_contained(self):
+        class Sync:
+            def report(self):
+                raise RuntimeError("torn down")
+
+        clk, reg = FakeClock(), _m.Registry()
+        plane = _mk_plane(clk, reg)
+        plane._sync = Sync()
+        st = plane.status()                      # must not raise
+        assert "unavailable" in st["serving"]
+
+    def test_status_json_endpoint_serves_the_plane(self):
+        import urllib.request
+
+        from loro_tpu.obs import exposition
+
+        clk, reg = FakeClock(), _m.Registry()
+        reg.counter("e.ops_total").inc(3)
+        plane = _mk_plane(clk, reg)
+        plane.tick()
+        prev = health_mod.install(plane)
+        srv = exposition.serve(port=0, registry=reg)
+        try:
+            port = srv.server_address[1]
+
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}") as r:
+                    return r.read()
+
+            st = json.loads(get("/status.json"))
+            assert st["verdict"] == "ok"
+            assert st["ticks"] == 1
+            # the scrape surfaces stay intact next to it
+            assert json.loads(get("/metrics.json"))[
+                "e.ops_total"]["values"][0]["value"] == 3
+            assert b"e_ops_total 3" in get("/metrics")
+        finally:
+            health_mod.install(prev)
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# obs.top rendering
+# ---------------------------------------------------------------------------
+
+
+class TestTopRender:
+    def _payload(self):
+        clk, reg = FakeClock(), _m.Registry()
+        acc = HeatAccountant(clock=clk)
+        plane = _mk_plane(clk, reg, heat=acc)
+        acc.tick_doc(3, "push", 5.0)
+        acc.tick_shard(0, "ingest", 4.0, of=2)
+        reg.counter("r.ops_total").inc(2)
+        plane.tick()
+        reg.counter("r.ops_total").inc(8)
+        clk.advance(4.0)
+        plane.tick()
+        return plane.status()
+
+    def test_render_one_screen_from_live_status(self):
+        from loro_tpu.obs import top
+
+        out = top.render_status(self._payload())
+        assert "OK" in out
+        assert "doc" in out and "3" in out        # the hot doc shows
+        assert "r.ops_total" in out               # windowed rates section
+        assert len(out.splitlines()) < 60         # one screen
+
+    def test_render_from_saved_snapshot_roundtrips(self, tmp_path):
+        from loro_tpu.obs import top
+
+        st = self._payload()
+        f = tmp_path / "status.json"
+        f.write_text(json.dumps(st))
+        loaded = top._load(str(f))
+        assert top.render_status(loaded) == top.render_status(
+            json.loads(json.dumps(st)))
+
+    def test_main_once_over_snapshot_file(self, tmp_path, capsys):
+        from loro_tpu.obs import top
+
+        f = tmp_path / "status.json"
+        f.write_text(json.dumps(self._payload()))
+        assert top.main([str(f)]) == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_main_once_live_renders_unknown_without_plane(self, capsys):
+        from loro_tpu.obs import top
+
+        prev = health_mod.install(None)
+        try:
+            assert top.main(["--once"]) == 0
+            assert "UNKNOWN" in capsys.readouterr().out
+        finally:
+            health_mod.install(prev)
+
+
+# ---------------------------------------------------------------------------
+# lock-witness conformance (obs.health is a near-leaf)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def clean_witness():
+    w = witness()
+    was = w.enabled
+    w.reset()
+    yield w
+    w.disable()
+    w.reset()
+    if was:
+        w.enable()
+
+
+class TestLockConformance:
+    def test_heat_ticks_under_serving_locks_conform(self, clean_witness):
+        w = clean_witness
+        w.enable()
+        clk = FakeClock()
+        acc = HeatAccountant(clock=clk)
+        # the real call sites hold these serving locks across tick_*
+        with named_rlock("sync.server"):
+            acc.tick_doc(0, "push")
+        with named_rlock("sharded.route"):
+            acc.tick_shard(0, "ingest", of=2)
+        with named_rlock("residency.plan"):
+            acc.tick_doc(0, "touch")
+            acc.tick_revive()
+        plane = HealthPlane(clock=clk, registry=_m.Registry(), heat=acc)
+        plane.tick()                      # detector path: health->flight
+        plane.status()
+        assert w.check_declared() == []
+        w.assert_acyclic()
+
+
+# ---------------------------------------------------------------------------
+# live acceptance: the composed stack
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faultinject
+class TestLiveStackAcceptance:
+    """ISSUE acceptance: over a live sharded+tiered+durable+replicated
+    stack, ``status()`` reports ok with zipfian skew > 1; alerts fire
+    under injected ``session_stall``/``repl_ship`` faults and clear
+    after the faults lift."""
+
+    def test_live_stack_status_skew_and_alert_lifecycle(self, tmp_path):
+        import random
+
+        from loro_tpu import LoroDoc
+        from loro_tpu.chaos.plan import ChaosConfig
+        from loro_tpu.chaos.stack import ChaosStack
+
+        heat_mod.reset()                 # drop other tests' global heat
+        cfg = ChaosConfig(seed=7, steps=1, families=("map",), docs=4,
+                          shards=2, sessions=3, hot_slots=2,
+                          follower=True)
+        stack = ChaosStack(cfg, str(tmp_path / "stack"))
+        try:
+            p = stack.planes["map"]
+            oracle = [LoroDoc(peer=9000 + i) for i in range(cfg.docs)]
+            rng = random.Random(7)
+
+            def push_n(c, n):
+                for _ in range(n):
+                    c.edit(rng)
+                    acked = stack.push_payload(c, c.export_delta(), oracle)
+                    assert acked, "push did not land"
+
+            # pick two clients whose docs live on DIFFERENT shards and
+            # load them zipfian-style (8:1) so one shard runs hot
+            by_shard = {}
+            for c in stack.clients:
+                by_shard.setdefault(p.resident.placement.place(c.di)[0], c)
+            clients = list(by_shard.values())
+            assert len(clients) == 2, "seeded docs landed on one shard"
+            push_n(clients[0], 8)
+            push_n(clients[1], 1)
+            for c in stack.clients:
+                stack.pull_client(c)
+            assert stack.catch_up(p) == 0
+
+            # -- at rest: ok verdict, zipfian skew > 1 ----------------
+            stack.health.tick()
+            stack.health.tick()
+            st = stack.health.status()
+            assert st["verdict"] == "ok", st["reasons"]
+            assert st["heat"]["skew_ratio"] > 1.0
+            assert st["heat"]["docs_top"][0]["doc"] == clients[0].di
+            assert st["shards"] == {"n_shards": 2, "degraded": []}
+            assert st["repl"]["followers"][0]["lag_epochs"] == 0
+            json.dumps(st)
+
+            # -- a tight-SLO plane over the SAME live stack -----------
+            clk = FakeClock()
+            plane = HealthPlane(clock=clk, p2v_slo_ms=5.0,
+                                p2v_min_samples=2, repl_lag_epochs_max=1,
+                                fire_after=1, clear_after=1)
+            plane.attach_sync(p.sync)
+            plane.attach_follower(p.follower)
+            clk.advance(1.0)
+            plane.tick()                             # baseline
+
+            # session_stall: the armed delay inflates push-to-visible
+            # past the 5ms SLO -> p2v_slo fires; the window aging out
+            # clears it
+            faultinject.inject("session_stall", action="delay",
+                               delay_s=0.02, times=4)
+            try:
+                push_n(clients[1], 2)
+            finally:
+                faultinject.clear("session_stall")
+            clk.advance(1.0)
+            fired = plane.tick()
+            assert "p2v_slo" in fired
+            clk.advance(plane.window_s + 1.0)        # stalls age out
+            plane.tick()
+            assert all(a["kind"] != "p2v_slo" for a in plane.alerts())
+
+            # repl_ship truncate: every catch_up pass ships a torn
+            # tail, so applied trails the leader's durable watermark
+            # the pass DID observe -> visible lag -> repl_lag fires;
+            # a clean catch_up after the fault -> clears.  (A raise
+            # arm aborts the pass before leader_epoch_seen advances —
+            # the follower would never SEE its lag.)
+            faultinject.inject("repl_ship", action="truncate", times=64)
+            try:
+                push_n(clients[0], 2)
+                # a checkpoint writes the manifest: the fleet-global
+                # epoch the sharded follower's lag is measured against
+                assert stack.checkpoint("map")
+                assert stack.catch_up(p, passes=2) != 0
+            finally:
+                faultinject.clear("repl_ship")
+            clk.advance(1.0)
+            fired = plane.tick()
+            assert "repl_lag" in fired
+            assert plane.status()["verdict"] == "critical"
+            assert stack.catch_up(p) == 0
+            clk.advance(1.0)
+            plane.tick()
+            assert all(a["kind"] != "repl_lag" for a in plane.alerts())
+        finally:
+            faultinject.clear()
+            stack.close()

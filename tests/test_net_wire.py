@@ -59,6 +59,9 @@ def _sample_bodies():
         wire.ERROR: wire.encode_error(
             0, wire.E_NOT_LEADER, "read-only", "10.0.0.2:7777"),
         wire.BYE: wire.encode_bye(),
+        wire.STATUS: wire.encode_status(9),
+        wire.STATUS_OK: wire.encode_status_ok(
+            9, b'{"verdict": "ok", "alerts": []}'),
     }
 
 
@@ -92,6 +95,11 @@ class TestCodecRoundtrip:
         t, f = wire.decode(_sample_bodies()[wire.ERROR])
         assert f["code"] == wire.E_NOT_LEADER
         assert f["leader"] == "10.0.0.2:7777"
+        t, f = wire.decode(_sample_bodies()[wire.STATUS])
+        assert f == {"rid": 9}
+        t, f = wire.decode(_sample_bodies()[wire.STATUS_OK])
+        assert f["rid"] == 9
+        assert f["payload"] == b'{"verdict": "ok", "alerts": []}'
 
     def test_frame_envelope_roundtrip(self):
         body = _sample_bodies()[wire.PUSH]
@@ -218,6 +226,49 @@ class TestWrongVersionOverWire:
                 cli.connect()
             cli.kill()
         finally:
+            net.close()
+            srv.close()
+
+
+class TestStatusOverWire:
+    """STATUS frame end-to-end: the socket answer is the
+    ``/status.json`` payload plus the server's own ``net`` section
+    (docs/OBSERVABILITY.md "Health & heat")."""
+
+    def test_status_without_plane_is_unknown(self):
+        base = _seed_doc(52, 0)
+        srv = _mk_server("map", 1, base)
+        net = NetServer(srv)
+        try:
+            with NetClient("127.0.0.1", net.port, "map") as cli:
+                st = cli.status()
+                assert st["verdict"] == "unknown"
+                assert st["net"]["addr"] == net.addr
+                assert st["net"]["connections"] == 1
+                # the admin probe leaves the data plane fully live
+                assert isinstance(cli.pull(0), bytes)
+        finally:
+            net.close()
+            srv.close()
+
+    def test_status_serves_the_installed_plane(self):
+        from loro_tpu.obs import health
+
+        base = _seed_doc(53, 0)
+        srv = _mk_server("map", 1, base)
+        plane = health.HealthPlane().attach_sync(srv)
+        net = NetServer(srv, health=plane)
+        prev = health.install(None)  # explicit kwarg must win anyway
+        try:
+            plane.tick()
+            with NetClient("127.0.0.1", net.port, "map") as cli:
+                st = cli.status()
+                assert st["verdict"] in health.SEVERITIES
+                assert st["ticks"] >= 1
+                assert "sessions" in st["serving"]
+                assert st["net"]["connections"] == 1
+        finally:
+            health.install(prev)
             net.close()
             srv.close()
 
